@@ -1,0 +1,20 @@
+"""quest_tpu — a TPU-native universal quantum-circuit simulation framework.
+
+A ground-up re-design of the capability surface of QuEST (reference:
+/root/reference, v3.2.0 — C99 statevector/density-matrix simulator with
+OpenMP/MPI/CUDA backends) for TPU: amplitudes are (optionally sharded)
+jax.Arrays, gates are fused XLA tensor contractions, distribution is
+jax.sharding + GSPMD collectives over the ICI mesh, and whole circuits can be
+compiled to single XLA programs via the circuit layer.
+
+Public API: the reference's full function surface (createQureg, hadamard,
+controlledNot, mixDamping, calcExpecPauliHamil, ...) plus TPU-native
+extensions (precision control, mesh control, circuit compilation).
+"""
+
+from .precision import set_precision, get_precision, real_eps  # noqa: F401  (configures x64)
+from .api import *  # noqa: F401,F403
+from .api import __all__ as _api_all
+
+__version__ = "0.1.0"
+__all__ = list(_api_all) + ["set_precision", "get_precision", "real_eps"]

@@ -1,0 +1,1338 @@
+"""The public, reference-compatible API surface.
+
+Every function follows the reference's dispatch contract
+(ref: QuEST/src/QuEST.c:5-10): validate inputs, invoke the backend op, apply
+the density-matrix shadow op (the conjugated gate on the column-side qubits,
+ref: QuEST.c:8-10 and e.g. rotateX at :188-197), and record QASM.
+
+Names are exported in both the reference's camelCase (``hadamard``,
+``controlledNot``, ``calcExpecPauliHamil``…) and used internally in
+snake_case.  The backend is the functional op layer in ``quest_tpu.ops`` —
+pure jitted jnp programs over (possibly sharded) amplitude arrays.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import rng
+from .environment import (QuESTEnv, create_quest_env, destroy_quest_env,
+                          get_environment_string, report_quest_env,
+                          sync_quest_env, sync_quest_success)
+from .matrices import (PAULI_MATRICES, Complex, ComplexMatrix2, ComplexMatrix4,
+                       DiagonalOp, PauliHamil, PauliOpType, Vector, as_matrix,
+                       create_complex_matrix_n, create_diagonal_op,
+                       create_pauli_hamil, create_pauli_hamil_from_file,
+                       destroy_diagonal_op, destroy_pauli_hamil,
+                       init_complex_matrix_n, init_diagonal_op,
+                       init_pauli_hamil, report_pauli_hamil,
+                       set_diagonal_op_elems, sync_diagonal_op)
+from .ops import apply as _ap
+from .ops import calc as _calc
+from .ops import decoherence as _deco
+from .ops import init as _init
+from .ops import measure as _meas
+from .precision import real_eps
+from .qureg import (Qureg, create_clone_qureg, create_density_qureg,
+                    create_qureg, destroy_qureg)
+from . import validation as V
+from .validation import QuESTError
+
+__all__ = [
+    # environment
+    "createQuESTEnv", "destroyQuESTEnv", "syncQuESTEnv", "syncQuESTSuccess",
+    "reportQuESTEnv", "getEnvironmentString", "seedQuEST", "seedQuESTDefault",
+    # registers
+    "createQureg", "createDensityQureg", "createCloneQureg", "destroyQureg",
+    "getNumQubits", "getNumAmps", "reportQuregParams",
+    # matrices / hamiltonians / diagonal ops
+    "createComplexMatrixN", "destroyComplexMatrixN", "initComplexMatrixN",
+    "createPauliHamil", "destroyPauliHamil", "createPauliHamilFromFile",
+    "initPauliHamil", "reportPauliHamil",
+    "createDiagonalOp", "destroyDiagonalOp", "syncDiagonalOp",
+    "initDiagonalOp", "setDiagonalOpElems", "applyDiagonalOp",
+    "calcExpecDiagonalOp",
+    # init
+    "initBlankState", "initZeroState", "initPlusState", "initClassicalState",
+    "initPureState", "initDebugState", "initStateFromAmps", "setAmps",
+    "cloneQureg", "setDensityAmps",
+    # amplitude access
+    "getAmp", "getRealAmp", "getImagAmp", "getProbAmp", "getDensityAmp",
+    # unitaries & gates
+    "compactUnitary", "unitary", "rotateX", "rotateY", "rotateZ",
+    "rotateAroundAxis", "controlledRotateX", "controlledRotateY",
+    "controlledRotateZ", "controlledRotateAroundAxis",
+    "controlledCompactUnitary", "controlledUnitary", "multiControlledUnitary",
+    "multiStateControlledUnitary", "pauliX", "pauliY", "pauliZ", "hadamard",
+    "sGate", "tGate", "phaseShift", "controlledPhaseShift",
+    "multiControlledPhaseShift", "controlledPhaseFlip",
+    "multiControlledPhaseFlip", "controlledNot", "controlledPauliY",
+    "swapGate", "sqrtSwapGate", "multiRotateZ", "multiRotatePauli",
+    "twoQubitUnitary", "controlledTwoQubitUnitary",
+    "multiControlledTwoQubitUnitary", "multiQubitUnitary",
+    "controlledMultiQubitUnitary", "multiControlledMultiQubitUnitary",
+    # measurement
+    "calcProbOfOutcome", "collapseToOutcome", "measure", "measureWithStats",
+    # calculations
+    "calcTotalProb", "calcInnerProduct", "calcDensityInnerProduct",
+    "calcPurity", "calcFidelity", "calcHilbertSchmidtDistance",
+    "calcExpecPauliProd", "calcExpecPauliSum", "calcExpecPauliHamil",
+    # decoherence
+    "mixDephasing", "mixTwoQubitDephasing", "mixDepolarising", "mixDamping",
+    "mixTwoQubitDepolarising", "mixPauli", "mixKrausMap", "mixTwoQubitKrausMap",
+    "mixMultiQubitKrausMap", "mixDensityMatrix",
+    # operators
+    "applyPauliSum", "applyPauliHamil", "applyTrotterCircuit", "applyMatrix2",
+    "applyMatrix4", "applyMatrixN", "applyMultiControlledMatrixN",
+    "setWeightedQureg",
+    # QASM
+    "startRecordingQASM", "stopRecordingQASM", "clearRecordedQASM",
+    "printRecordedQASM", "writeRecordedQASMToFile",
+    # reporting / debug
+    "reportState", "reportStateToScreen", "copyStateToGPU", "copyStateFromGPU",
+    "initStateDebug", "compareStates", "initStateOfSingleQubit",
+    # types
+    "Qureg", "QuESTEnv", "Complex", "ComplexMatrix2", "ComplexMatrix4",
+    "Vector", "PauliHamil", "DiagonalOp", "PauliOpType", "QuESTError",
+]
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _ts(x) -> tuple:
+    """Normalise a qubit / list of qubits to a tuple of ints."""
+    if isinstance(x, (int, np.integer)):
+        return (int(x),)
+    return tuple(int(q) for q in x)
+
+
+def _shift(ts: tuple, n: int) -> tuple:
+    return tuple(t + n for t in ts)
+
+
+def _apply_unitary(qureg: Qureg, u, targets, controls=(), control_states=()):
+    """Gate + conjugated shadow on the column side for density matrices
+    (ref: QuEST.c:8-10).  ``u`` is a complex host matrix; the op layer takes
+    (2, d, d) real pairs."""
+    up = _ap.mat_pair(u)
+    amps = _ap.apply_matrix(qureg.amps, up, targets, controls, control_states)
+    if qureg.is_density_matrix:
+        n = qureg.num_qubits_represented
+        conj = np.stack([up[0], -up[1]])
+        amps = _ap.apply_matrix(amps, conj, _shift(targets, n),
+                                _shift(controls, n), control_states)
+    qureg.amps = amps
+
+
+def _diag_pair(diag) -> np.ndarray:
+    d = np.asarray(diag, dtype=np.complex128)
+    return np.stack([d.real, d.imag])
+
+
+def _apply_diag(qureg: Qureg, diag, targets, controls=(), control_states=()):
+    dp = _diag_pair(diag)
+    amps = _ap.apply_diagonal(qureg.amps, dp, targets, controls, control_states)
+    if qureg.is_density_matrix:
+        n = qureg.num_qubits_represented
+        conj = np.stack([dp[0], -dp[1]])
+        amps = _ap.apply_diagonal(amps, conj, _shift(targets, n),
+                                  _shift(controls, n), control_states)
+    qureg.amps = amps
+
+
+def _rotation_matrix(angle: float, axis) -> np.ndarray:
+    """R(θ, n̂) = cos(θ/2) I − i sin(θ/2) n̂·σ (ref: getComplexPairFromRotation,
+    QuEST_common.c)."""
+    ux, uy, uz = axis
+    norm = math.sqrt(ux * ux + uy * uy + uz * uz)
+    ux, uy, uz = ux / norm, uy / norm, uz / norm
+    c, s = math.cos(angle / 2), math.sin(angle / 2)
+    return np.array([[c - 1j * s * uz, (-1j * ux - uy) * s],
+                     [(-1j * ux + uy) * s, c + 1j * s * uz]], dtype=np.complex128)
+
+
+def _compact_matrix(alpha: complex, beta: complex) -> np.ndarray:
+    return np.array([[alpha, -np.conj(beta)], [beta, np.conj(alpha)]],
+                    dtype=np.complex128)
+
+
+# ---------------------------------------------------------------------------
+# environment & registers
+# ---------------------------------------------------------------------------
+
+createQuESTEnv = create_quest_env
+destroyQuESTEnv = destroy_quest_env
+syncQuESTEnv = sync_quest_env
+syncQuESTSuccess = sync_quest_success
+reportQuESTEnv = report_quest_env
+getEnvironmentString = get_environment_string
+
+createQureg = create_qureg
+createDensityQureg = create_density_qureg
+createCloneQureg = create_clone_qureg
+destroyQureg = destroy_qureg
+
+createComplexMatrixN = create_complex_matrix_n
+initComplexMatrixN = init_complex_matrix_n
+createPauliHamil = create_pauli_hamil
+destroyPauliHamil = destroy_pauli_hamil
+createPauliHamilFromFile = create_pauli_hamil_from_file
+initPauliHamil = init_pauli_hamil
+reportPauliHamil = report_pauli_hamil
+createDiagonalOp = create_diagonal_op
+destroyDiagonalOp = destroy_diagonal_op
+syncDiagonalOp = sync_diagonal_op
+initDiagonalOp = init_diagonal_op
+setDiagonalOpElems = set_diagonal_op_elems
+
+
+def destroyComplexMatrixN(m) -> None:
+    """Ref parity only — ndarray lifetime is GC-managed."""
+
+
+def seedQuEST(seed_array, num_seeds: int | None = None):
+    if num_seeds is not None:
+        seed_array = list(seed_array)[:num_seeds]
+    rng.seed_quest(seed_array)
+
+
+def seedQuESTDefault():
+    rng.seed_quest_default()
+
+
+def getNumQubits(qureg: Qureg) -> int:
+    return qureg.num_qubits_represented
+
+
+def getNumAmps(qureg: Qureg) -> int:
+    V.validate_state_vec_qureg(qureg, "getNumAmps")
+    return qureg.num_amps_total
+
+
+def reportQuregParams(qureg: Qureg) -> None:
+    """Ref: reportQuregParams (QuEST_common.c:234-243)."""
+    print("QUBITS:")
+    print(f"Number of qubits is {qureg.num_qubits_represented}.")
+    print(f"Number of amps is {qureg.num_amps_total}.")
+
+
+# ---------------------------------------------------------------------------
+# state initialisation
+# ---------------------------------------------------------------------------
+
+def initBlankState(qureg: Qureg) -> None:
+    qureg.set_amps_array(_init.blank_state(qureg.num_amps_total, qureg.dtype))
+    qureg.qasm.record_comment("Here, the register was initialised to an unphysical all-zero-amplitudes state.")
+
+
+def initZeroState(qureg: Qureg) -> None:
+    qureg.set_amps_array(_init.zero_state(qureg.num_amps_total, qureg.dtype))
+    qureg.qasm.record_init_zero()
+
+
+def initPlusState(qureg: Qureg) -> None:
+    if qureg.is_density_matrix:
+        qureg.set_amps_array(_init.densmatr_plus_state(
+            qureg.num_qubits_represented, qureg.dtype))
+    else:
+        qureg.set_amps_array(_init.plus_state(qureg.num_amps_total, qureg.dtype))
+    qureg.qasm.record_init_plus()
+
+
+def initClassicalState(qureg: Qureg, state_ind: int) -> None:
+    V.validate_state_index(qureg, state_ind, "initClassicalState")
+    if qureg.is_density_matrix:
+        qureg.set_amps_array(_init.densmatr_classical_state(
+            qureg.num_qubits_represented, int(state_ind), qureg.dtype))
+    else:
+        qureg.set_amps_array(_init.classical_state(
+            qureg.num_amps_total, int(state_ind), qureg.dtype))
+    qureg.qasm.record_init_classical(int(state_ind))
+
+
+def initPureState(qureg: Qureg, pure: Qureg) -> None:
+    """Ref: initPureState (QuEST.c) — copy ψ, or form ρ=|ψ><ψ|."""
+    V.validate_second_qureg_state_vec(pure, "initPureState")
+    V.validate_matching_qureg_dims(qureg, pure, "initPureState")
+    if qureg.is_density_matrix:
+        qureg.set_amps_array(_init.densmatr_pure_state(
+            pure.amps, qureg.num_qubits_represented).astype(qureg.dtype))
+    else:
+        qureg.set_amps_array(pure.amps.astype(qureg.dtype))
+    qureg.qasm.record_comment("Here, the register was initialised to an undisclosed given pure state.")
+
+
+def initDebugState(qureg: Qureg) -> None:
+    qureg.set_amps_array(_init.debug_state(qureg.num_amps_total, qureg.dtype))
+    qureg.qasm.record_comment("Here, the register was initialised to an undisclosed debugging state.")
+
+
+initStateDebug = initDebugState
+
+
+def initStateOfSingleQubit(qureg: Qureg, qubit_id: int, outcome: int) -> None:
+    """Debug API (ref: QuEST_debug.h:25-54)."""
+    V.validate_state_vec_qureg(qureg, "initStateOfSingleQubit")
+    V.validate_target(qureg, qubit_id, "initStateOfSingleQubit")
+    V.validate_outcome(outcome, "initStateOfSingleQubit")
+    qureg.set_amps_array(_init.state_of_single_qubit(
+        qureg.num_qubits_in_state_vec, int(qubit_id), int(outcome), qureg.dtype))
+
+
+def _soa(reals, imags) -> np.ndarray:
+    return np.stack([np.asarray(reals, dtype=np.float64).ravel(),
+                     np.asarray(imags, dtype=np.float64).ravel()])
+
+
+def initStateFromAmps(qureg: Qureg, reals, imags) -> None:
+    V.validate_state_vec_qureg(qureg, "initStateFromAmps")
+    vals = _soa(reals, imags)
+    if vals.shape[1] != qureg.num_amps_total:
+        V._throw(V.ErrorCode.INVALID_NUM_AMPS, "initStateFromAmps")
+    qureg.set_amps_array(jnp.asarray(vals, dtype=qureg.dtype))
+
+
+def setAmps(qureg: Qureg, start_ind: int, reals, imags, num_amps: int) -> None:
+    V.validate_state_vec_qureg(qureg, "setAmps")
+    V.validate_num_amps(qureg, start_ind, num_amps, "setAmps")
+    vals = _soa(reals, imags)[:, :num_amps]
+    qureg.set_amps_array(
+        qureg.amps.at[:, start_ind:start_ind + num_amps].set(
+            jnp.asarray(vals, dtype=qureg.dtype)))
+
+
+def setDensityAmps(qureg: Qureg, reals, imags) -> None:
+    """Debug API (ref: QuEST_debug.h setDensityAmps) — overwrite all 4^N
+    elements, given in the flattened (row + col·2^N) storage order."""
+    V.validate_density_matr_qureg(qureg, "setDensityAmps")
+    qureg.set_amps_array(jnp.asarray(_soa(reals, imags), dtype=qureg.dtype))
+
+
+def cloneQureg(target: Qureg, copy: Qureg) -> None:
+    V.validate_matching_qureg_types(target, copy, "cloneQureg")
+    V.validate_matching_qureg_dims(target, copy, "cloneQureg")
+    target.set_amps_array(copy.amps.astype(target.dtype))
+
+
+def compareStates(a: Qureg, b: Qureg, precision: float) -> bool:
+    """Debug API (ref: QuEST_debug.h compareStates)."""
+    V.validate_matching_qureg_dims(a, b, "compareStates")
+    diff = np.asarray(a.amps, dtype=np.float64) - np.asarray(b.amps, dtype=np.float64)
+    return bool(np.all(np.abs(diff) < precision))
+
+
+# ---------------------------------------------------------------------------
+# amplitude access
+# ---------------------------------------------------------------------------
+
+def _amp_at(qureg: Qureg, index: int) -> complex:
+    pair = np.asarray(qureg.amps[:, int(index)], dtype=np.float64)
+    return complex(pair[0], pair[1])
+
+
+def getAmp(qureg: Qureg, index: int) -> complex:
+    V.validate_state_vec_qureg(qureg, "getAmp")
+    V.validate_amp_index(qureg, index, "getAmp")
+    return _amp_at(qureg, index)
+
+
+def getRealAmp(qureg: Qureg, index: int) -> float:
+    V.validate_state_vec_qureg(qureg, "getRealAmp")
+    V.validate_amp_index(qureg, index, "getRealAmp")
+    return float(qureg.amps[0, int(index)])
+
+
+def getImagAmp(qureg: Qureg, index: int) -> float:
+    V.validate_state_vec_qureg(qureg, "getImagAmp")
+    V.validate_amp_index(qureg, index, "getImagAmp")
+    return float(qureg.amps[1, int(index)])
+
+
+def getProbAmp(qureg: Qureg, index: int) -> float:
+    V.validate_state_vec_qureg(qureg, "getProbAmp")
+    V.validate_amp_index(qureg, index, "getProbAmp")
+    a = _amp_at(qureg, index)
+    return a.real * a.real + a.imag * a.imag
+
+
+def getDensityAmp(qureg: Qureg, row: int, col: int) -> complex:
+    """ρ(r,c) at flat index r + c·2^N (ref: getDensityAmp, QuEST.c:709-719)."""
+    V.validate_density_matr_qureg(qureg, "getDensityAmp")
+    dim = 1 << qureg.num_qubits_represented
+    if not (0 <= int(row) < dim and 0 <= int(col) < dim):
+        V._throw(V.ErrorCode.INVALID_AMP_INDEX, "getDensityAmp")
+    return _amp_at(qureg, int(row) + int(col) * dim)
+
+
+# ---------------------------------------------------------------------------
+# unitaries
+# ---------------------------------------------------------------------------
+
+def compactUnitary(qureg: Qureg, target: int, alpha, beta) -> None:
+    V.validate_target(qureg, target, "compactUnitary")
+    V.validate_unitary_complex_pair(complex(alpha), complex(beta), "compactUnitary",
+                                    eps=real_eps(qureg.dtype))
+    _apply_unitary(qureg, _compact_matrix(complex(alpha), complex(beta)), _ts(target))
+    qureg.qasm.record_compact_unitary(complex(alpha), complex(beta), (), int(target))
+
+
+def unitary(qureg: Qureg, target: int, u) -> None:
+    V.validate_target(qureg, target, "unitary")
+    u = as_matrix(u, 1)
+    V.validate_one_qubit_unitary(u, "unitary", eps=real_eps(qureg.dtype))
+    _apply_unitary(qureg, u, _ts(target))
+    qureg.qasm.record_unitary(u, (), int(target))
+
+
+def rotateX(qureg: Qureg, target: int, angle: float) -> None:
+    V.validate_target(qureg, target, "rotateX")
+    _apply_unitary(qureg, _rotation_matrix(angle, (1, 0, 0)), _ts(target))
+    qureg.qasm.record_gate("rotate_x", (), int(target), (angle,))
+
+
+def rotateY(qureg: Qureg, target: int, angle: float) -> None:
+    V.validate_target(qureg, target, "rotateY")
+    _apply_unitary(qureg, _rotation_matrix(angle, (0, 1, 0)), _ts(target))
+    qureg.qasm.record_gate("rotate_y", (), int(target), (angle,))
+
+
+def rotateZ(qureg: Qureg, target: int, angle: float) -> None:
+    V.validate_target(qureg, target, "rotateZ")
+    _apply_diag(qureg, _rz_diag(angle), _ts(target))
+    qureg.qasm.record_gate("rotate_z", (), int(target), (angle,))
+
+
+def _rz_diag(angle: float) -> np.ndarray:
+    return np.array([np.exp(-0.5j * angle), np.exp(0.5j * angle)],
+                    dtype=np.complex128)
+
+
+def rotateAroundAxis(qureg: Qureg, target: int, angle: float, axis) -> None:
+    V.validate_target(qureg, target, "rotateAroundAxis")
+    V.validate_vector(axis, "rotateAroundAxis")
+    _apply_unitary(qureg, _rotation_matrix(angle, axis), _ts(target))
+    qureg.qasm.record_comment(
+        f"Here, an undisclosed axis rotation of angle {angle:g} was applied to qubit {int(target)}")
+
+
+def controlledRotateX(qureg: Qureg, control: int, target: int, angle: float) -> None:
+    V.validate_control_target(qureg, control, target, "controlledRotateX")
+    _apply_unitary(qureg, _rotation_matrix(angle, (1, 0, 0)), _ts(target), _ts(control))
+    qureg.qasm.record_gate("rotate_x", _ts(control), int(target), (angle,))
+
+
+def controlledRotateY(qureg: Qureg, control: int, target: int, angle: float) -> None:
+    V.validate_control_target(qureg, control, target, "controlledRotateY")
+    _apply_unitary(qureg, _rotation_matrix(angle, (0, 1, 0)), _ts(target), _ts(control))
+    qureg.qasm.record_gate("rotate_y", _ts(control), int(target), (angle,))
+
+
+def controlledRotateZ(qureg: Qureg, control: int, target: int, angle: float) -> None:
+    V.validate_control_target(qureg, control, target, "controlledRotateZ")
+    _apply_diag(qureg, _rz_diag(angle), _ts(target), _ts(control))
+    qureg.qasm.record_gate("rotate_z", _ts(control), int(target), (angle,))
+
+
+def controlledRotateAroundAxis(qureg: Qureg, control: int, target: int,
+                               angle: float, axis) -> None:
+    V.validate_control_target(qureg, control, target, "controlledRotateAroundAxis")
+    V.validate_vector(axis, "controlledRotateAroundAxis")
+    _apply_unitary(qureg, _rotation_matrix(angle, axis), _ts(target), _ts(control))
+    qureg.qasm.record_comment(
+        f"Here, an undisclosed controlled axis rotation was applied to qubit {int(target)}")
+
+
+def controlledCompactUnitary(qureg: Qureg, control: int, target: int, alpha, beta) -> None:
+    V.validate_control_target(qureg, control, target, "controlledCompactUnitary")
+    V.validate_unitary_complex_pair(complex(alpha), complex(beta),
+                                    "controlledCompactUnitary", eps=real_eps(qureg.dtype))
+    _apply_unitary(qureg, _compact_matrix(complex(alpha), complex(beta)),
+                   _ts(target), _ts(control))
+    qureg.qasm.record_compact_unitary(complex(alpha), complex(beta),
+                                      _ts(control), int(target))
+
+
+def controlledUnitary(qureg: Qureg, control: int, target: int, u) -> None:
+    V.validate_control_target(qureg, control, target, "controlledUnitary")
+    u = as_matrix(u, 1)
+    V.validate_one_qubit_unitary(u, "controlledUnitary", eps=real_eps(qureg.dtype))
+    _apply_unitary(qureg, u, _ts(target), _ts(control))
+    qureg.qasm.record_unitary(u, _ts(control), int(target))
+
+
+def multiControlledUnitary(qureg: Qureg, controls, num_controls=None, target=None, u=None) -> None:
+    controls, target, u = _legacy_mc_args(controls, num_controls, target, u)
+    V.validate_multi_controls_target(qureg, controls, target, "multiControlledUnitary")
+    u = as_matrix(u, 1)
+    V.validate_one_qubit_unitary(u, "multiControlledUnitary", eps=real_eps(qureg.dtype))
+    _apply_unitary(qureg, u, _ts(target), _ts(controls))
+    qureg.qasm.record_unitary(u, _ts(controls), int(target))
+
+
+def _legacy_mc_args(controls, num_controls, target, u):
+    """Accept both (controls, numControls, target, u) — the C signature — and
+    the Pythonic (controls, target, u)."""
+    if u is None:
+        u = target
+        target = num_controls
+        return _ts(controls), int(target), u
+    return _ts(controls)[:int(num_controls)], int(target), u
+
+
+def multiStateControlledUnitary(qureg: Qureg, controls, control_state,
+                                num_controls=None, target=None, u=None) -> None:
+    """Controls conditioned on an arbitrary bit pattern (ref: QuEST.h
+    multiStateControlledUnitary)."""
+    if u is None:
+        u = target
+        target = num_controls
+    else:
+        controls = _ts(controls)[:int(num_controls)]
+    controls = _ts(controls)
+    V.validate_multi_controls_target(qureg, controls, target, "multiStateControlledUnitary")
+    V.validate_control_state(control_state, len(controls), "multiStateControlledUnitary")
+    u = as_matrix(u, 1)
+    V.validate_one_qubit_unitary(u, "multiStateControlledUnitary", eps=real_eps(qureg.dtype))
+    cs = tuple(int(b) for b in control_state)
+    _apply_unitary(qureg, u, _ts(target), controls, cs)
+    qureg.qasm.record_comment(
+        "Here, an undisclosed multi-state-controlled unitary was applied.")
+
+
+# --- fixed gates -----------------------------------------------------------
+
+_HADAMARD = np.array([[1, 1], [1, -1]], dtype=np.complex128) / math.sqrt(2)
+
+
+def pauliX(qureg: Qureg, target: int) -> None:
+    V.validate_target(qureg, target, "pauliX")
+    amps = _ap.apply_pauli_x(qureg.amps, int(target))
+    if qureg.is_density_matrix:
+        amps = _ap.apply_pauli_x(amps, int(target) + qureg.num_qubits_represented)
+    qureg.amps = amps
+    qureg.qasm.record_gate("sigma_x", (), int(target))
+
+
+def pauliY(qureg: Qureg, target: int) -> None:
+    V.validate_target(qureg, target, "pauliY")
+    amps = _ap.apply_pauli_y(qureg.amps, int(target))
+    if qureg.is_density_matrix:
+        # shadow is conj(Y) = -Y
+        amps = _ap.apply_pauli_y(amps, int(target) + qureg.num_qubits_represented,
+                                 conj_fac=-1)
+    qureg.amps = amps
+    qureg.qasm.record_gate("sigma_y", (), int(target))
+
+
+def pauliZ(qureg: Qureg, target: int) -> None:
+    V.validate_target(qureg, target, "pauliZ")
+    _apply_diag(qureg, np.array([1, -1], dtype=np.complex128), _ts(target))
+    qureg.qasm.record_gate("sigma_z", (), int(target))
+
+
+def hadamard(qureg: Qureg, target: int) -> None:
+    V.validate_target(qureg, target, "hadamard")
+    _apply_unitary(qureg, _HADAMARD, _ts(target))
+    qureg.qasm.record_gate("hadamard", (), int(target))
+
+
+def sGate(qureg: Qureg, target: int) -> None:
+    V.validate_target(qureg, target, "sGate")
+    _apply_diag(qureg, np.array([1, 1j], dtype=np.complex128), _ts(target))
+    qureg.qasm.record_gate("s", (), int(target))
+
+
+def tGate(qureg: Qureg, target: int) -> None:
+    V.validate_target(qureg, target, "tGate")
+    _apply_diag(qureg, np.array([1, np.exp(0.25j * np.pi)], dtype=np.complex128),
+                _ts(target))
+    qureg.qasm.record_gate("t", (), int(target))
+
+
+def phaseShift(qureg: Qureg, target: int, angle: float) -> None:
+    V.validate_target(qureg, target, "phaseShift")
+    _apply_diag(qureg, np.array([1, np.exp(1j * angle)], dtype=np.complex128),
+                _ts(target))
+    qureg.qasm.record_gate("phase_shift", (), int(target), (angle,))
+
+
+def controlledPhaseShift(qureg: Qureg, q1: int, q2: int, angle: float) -> None:
+    V.validate_control_target(qureg, q1, q2, "controlledPhaseShift")
+    _apply_diag(qureg, np.array([1, np.exp(1j * angle)], dtype=np.complex128),
+                _ts(q2), _ts(q1))
+    qureg.qasm.record_gate("phase_shift", _ts(q1), int(q2), (angle,))
+
+
+def multiControlledPhaseShift(qureg: Qureg, qubits, num_qubits=None, angle=None) -> None:
+    if angle is None:
+        angle = num_qubits
+    else:
+        qubits = _ts(qubits)[:int(num_qubits)]
+    qubits = _ts(qubits)
+    V.validate_multi_targets(qureg, qubits, "multiControlledPhaseShift")
+    _apply_diag(qureg, np.array([1, np.exp(1j * float(angle))], dtype=np.complex128),
+                (qubits[-1],), tuple(qubits[:-1]))
+    qureg.qasm.record_gate("phase_shift", tuple(qubits[:-1]), int(qubits[-1]),
+                           (float(angle),))
+
+
+def controlledPhaseFlip(qureg: Qureg, q1: int, q2: int) -> None:
+    V.validate_control_target(qureg, q1, q2, "controlledPhaseFlip")
+    _apply_diag(qureg, np.array([1, -1], dtype=np.complex128), _ts(q2), _ts(q1))
+    qureg.qasm.record_gate("sigma_z", _ts(q1), int(q2))
+
+
+def multiControlledPhaseFlip(qureg: Qureg, qubits, num_qubits=None) -> None:
+    if num_qubits is not None:
+        qubits = _ts(qubits)[:int(num_qubits)]
+    qubits = _ts(qubits)
+    V.validate_multi_targets(qureg, qubits, "multiControlledPhaseFlip")
+    _apply_diag(qureg, np.array([1, -1], dtype=np.complex128),
+                (qubits[-1],), tuple(qubits[:-1]))
+    qureg.qasm.record_gate("sigma_z", tuple(qubits[:-1]), int(qubits[-1]))
+
+
+def controlledNot(qureg: Qureg, control: int, target: int) -> None:
+    V.validate_control_target(qureg, control, target, "controlledNot")
+    amps = _ap.apply_pauli_x(qureg.amps, int(target), _ts(control))
+    if qureg.is_density_matrix:
+        n = qureg.num_qubits_represented
+        amps = _ap.apply_pauli_x(amps, int(target) + n, _ts(int(control) + n))
+    qureg.amps = amps
+    qureg.qasm.record_gate("sigma_x", _ts(control), int(target))
+
+
+def controlledPauliY(qureg: Qureg, control: int, target: int) -> None:
+    V.validate_control_target(qureg, control, target, "controlledPauliY")
+    amps = _ap.apply_pauli_y(qureg.amps, int(target), _ts(control))
+    if qureg.is_density_matrix:
+        n = qureg.num_qubits_represented
+        amps = _ap.apply_pauli_y(amps, int(target) + n, _ts(int(control) + n),
+                                 conj_fac=-1)
+    qureg.amps = amps
+    qureg.qasm.record_gate("sigma_y", _ts(control), int(target))
+
+
+def swapGate(qureg: Qureg, q1: int, q2: int) -> None:
+    V.validate_unique_targets(qureg, q1, q2, "swapGate")
+    amps = _ap.swap_qubit_amps(qureg.amps, int(q1), int(q2))
+    if qureg.is_density_matrix:
+        n = qureg.num_qubits_represented
+        amps = _ap.swap_qubit_amps(amps, int(q1) + n, int(q2) + n)
+    qureg.amps = amps
+    qureg.qasm.record_comment(
+        f"Here, a swap gate was applied to qubits {int(q1)} and {int(q2)}")
+
+
+_SQRT_SWAP = np.array([
+    [1, 0, 0, 0],
+    [0, 0.5 + 0.5j, 0.5 - 0.5j, 0],
+    [0, 0.5 - 0.5j, 0.5 + 0.5j, 0],
+    [0, 0, 0, 1]], dtype=np.complex128)
+
+
+def sqrtSwapGate(qureg: Qureg, q1: int, q2: int) -> None:
+    V.validate_unique_targets(qureg, q1, q2, "sqrtSwapGate")
+    _apply_unitary(qureg, _SQRT_SWAP, (int(q1), int(q2)))
+    qureg.qasm.record_comment(
+        f"Here, a sqrt-swap gate was applied to qubits {int(q1)} and {int(q2)}")
+
+
+def multiRotateZ(qureg: Qureg, qubits, num_qubits=None, angle=None) -> None:
+    if angle is None:
+        angle = num_qubits
+    else:
+        qubits = _ts(qubits)[:int(num_qubits)]
+    qubits = _ts(qubits)
+    V.validate_multi_targets(qureg, qubits, "multiRotateZ")
+    amps = _ap.apply_multi_rotate_z(qureg.amps, jnp.float64(angle), qubits)
+    if qureg.is_density_matrix:
+        n = qureg.num_qubits_represented
+        amps = _ap.apply_multi_rotate_z(amps, jnp.float64(-angle), _shift(qubits, n))
+    qureg.amps = amps
+    qureg.qasm.record_comment(
+        f"Here, a multiRotateZ of angle {float(angle):g} was applied.")
+
+
+def _multi_rotate_pauli_statevec(amps, targets, paulis, angle, apply_conj: bool):
+    """Basis-rotate X/Y targets onto Z, multiRotateZ, rotate back
+    (ref: statevec_multiRotatePauli, QuEST_common.c:411-448)."""
+    fac = 1 / math.sqrt(2)
+    # Ry(-pi/2): Z -> X;  Rx(pi/2)^(* if conj): Z -> Y
+    ry = _ap.mat_pair(_compact_matrix(fac, -fac))
+    rx = _ap.mat_pair(_compact_matrix(fac, (1j * fac) if apply_conj else (-1j * fac)))
+    mask_targets = []
+    for t, p in zip(targets, paulis):
+        p = int(p)
+        if p == PauliOpType.PAULI_I:
+            continue
+        mask_targets.append(t)
+        if p == PauliOpType.PAULI_X:
+            amps = _ap.apply_matrix(amps, ry, (t,))
+        elif p == PauliOpType.PAULI_Y:
+            amps = _ap.apply_matrix(amps, rx, (t,))
+    if mask_targets:
+        a = -angle if apply_conj else angle
+        amps = _ap.apply_multi_rotate_z(amps, jnp.float64(a), tuple(mask_targets))
+    ry_inv = _ap.mat_pair(_compact_matrix(fac, fac))
+    rx_inv = _ap.mat_pair(_compact_matrix(fac, (-1j * fac) if apply_conj else (1j * fac)))
+    for t, p in zip(targets, paulis):
+        p = int(p)
+        if p == PauliOpType.PAULI_X:
+            amps = _ap.apply_matrix(amps, ry_inv, (t,))
+        elif p == PauliOpType.PAULI_Y:
+            amps = _ap.apply_matrix(amps, rx_inv, (t,))
+    return amps
+
+
+def multiRotatePauli(qureg: Qureg, targets, paulis, num_targets=None, angle=None) -> None:
+    if angle is None:
+        angle = num_targets
+    else:
+        targets = _ts(targets)[:int(num_targets)]
+        paulis = list(paulis)[:int(num_targets)]
+    targets = _ts(targets)
+    V.validate_multi_targets(qureg, targets, "multiRotatePauli")
+    V.validate_pauli_codes(paulis, len(targets), "multiRotatePauli")
+    amps = _multi_rotate_pauli_statevec(qureg.amps, targets, paulis,
+                                        float(angle), False)
+    if qureg.is_density_matrix:
+        n = qureg.num_qubits_represented
+        amps = _multi_rotate_pauli_statevec(amps, _shift(targets, n), paulis,
+                                            float(angle), True)
+    qureg.amps = amps
+    qureg.qasm.record_comment("Here, a multiRotatePauli was applied.")
+
+
+# --- multi-qubit dense unitaries ------------------------------------------
+
+def twoQubitUnitary(qureg: Qureg, t1: int, t2: int, u) -> None:
+    V.validate_unique_targets(qureg, t1, t2, "twoQubitUnitary")
+    u = as_matrix(u, 2)
+    V.validate_two_qubit_unitary(u, "twoQubitUnitary", eps=real_eps(qureg.dtype))
+    V.validate_multi_qubit_matrix_fits_in_shard(qureg, 2, "twoQubitUnitary")
+    _apply_unitary(qureg, u, (int(t1), int(t2)))
+    qureg.qasm.record_comment("Here, an undisclosed 2-qubit unitary was applied.")
+
+
+def controlledTwoQubitUnitary(qureg: Qureg, control: int, t1: int, t2: int, u) -> None:
+    V.validate_multi_controls_multi_targets(qureg, _ts(control), (int(t1), int(t2)),
+                                            "controlledTwoQubitUnitary")
+    u = as_matrix(u, 2)
+    V.validate_two_qubit_unitary(u, "controlledTwoQubitUnitary", eps=real_eps(qureg.dtype))
+    V.validate_multi_qubit_matrix_fits_in_shard(qureg, 2, "controlledTwoQubitUnitary")
+    _apply_unitary(qureg, u, (int(t1), int(t2)), _ts(control))
+    qureg.qasm.record_comment("Here, an undisclosed controlled 2-qubit unitary was applied.")
+
+
+def multiControlledTwoQubitUnitary(qureg: Qureg, controls, num_controls=None,
+                                   t1=None, t2=None, u=None) -> None:
+    if u is None:
+        u = t2
+        t2 = t1
+        t1 = num_controls
+    else:
+        controls = _ts(controls)[:int(num_controls)]
+    controls = _ts(controls)
+    V.validate_multi_controls_multi_targets(qureg, controls, (int(t1), int(t2)),
+                                            "multiControlledTwoQubitUnitary")
+    u = as_matrix(u, 2)
+    V.validate_two_qubit_unitary(u, "multiControlledTwoQubitUnitary",
+                                 eps=real_eps(qureg.dtype))
+    V.validate_multi_qubit_matrix_fits_in_shard(qureg, 2, "multiControlledTwoQubitUnitary")
+    _apply_unitary(qureg, u, (int(t1), int(t2)), controls)
+    qureg.qasm.record_comment(
+        "Here, an undisclosed multi-controlled 2-qubit unitary was applied.")
+
+
+def multiQubitUnitary(qureg: Qureg, targets, num_targets=None, u=None) -> None:
+    if u is None:
+        u = num_targets
+    else:
+        targets = _ts(targets)[:int(num_targets)]
+    targets = _ts(targets)
+    V.validate_multi_targets(qureg, targets, "multiQubitUnitary")
+    u = as_matrix(u, len(targets))
+    V.validate_multi_qubit_unitary(u, len(targets), "multiQubitUnitary",
+                                   eps=real_eps(qureg.dtype))
+    V.validate_multi_qubit_matrix_fits_in_shard(qureg, len(targets), "multiQubitUnitary")
+    _apply_unitary(qureg, u, targets)
+    qureg.qasm.record_comment("Here, an undisclosed multi-qubit unitary was applied.")
+
+
+def controlledMultiQubitUnitary(qureg: Qureg, ctrl: int, targets, num_targets=None,
+                                u=None) -> None:
+    if u is None:
+        u = num_targets
+    else:
+        targets = _ts(targets)[:int(num_targets)]
+    targets = _ts(targets)
+    V.validate_multi_controls_multi_targets(qureg, _ts(ctrl), targets,
+                                            "controlledMultiQubitUnitary")
+    u = as_matrix(u, len(targets))
+    V.validate_multi_qubit_unitary(u, len(targets), "controlledMultiQubitUnitary",
+                                   eps=real_eps(qureg.dtype))
+    V.validate_multi_qubit_matrix_fits_in_shard(qureg, len(targets),
+                                                "controlledMultiQubitUnitary")
+    _apply_unitary(qureg, u, targets, _ts(ctrl))
+    qureg.qasm.record_comment(
+        "Here, an undisclosed controlled multi-qubit unitary was applied.")
+
+
+def multiControlledMultiQubitUnitary(qureg: Qureg, ctrls, num_ctrls=None,
+                                     targets=None, num_targets=None, u=None) -> None:
+    if u is None:
+        u = targets
+        targets = num_ctrls
+    else:
+        ctrls = _ts(ctrls)[:int(num_ctrls)]
+        targets = _ts(targets)[:int(num_targets)]
+    ctrls, targets = _ts(ctrls), _ts(targets)
+    V.validate_multi_controls_multi_targets(qureg, ctrls, targets,
+                                            "multiControlledMultiQubitUnitary")
+    u = as_matrix(u, len(targets))
+    V.validate_multi_qubit_unitary(u, len(targets), "multiControlledMultiQubitUnitary",
+                                   eps=real_eps(qureg.dtype))
+    V.validate_multi_qubit_matrix_fits_in_shard(qureg, len(targets),
+                                                "multiControlledMultiQubitUnitary")
+    _apply_unitary(qureg, u, targets, ctrls)
+    qureg.qasm.record_comment(
+        "Here, an undisclosed multi-controlled multi-qubit unitary was applied.")
+
+
+# ---------------------------------------------------------------------------
+# non-unitary matrix application (ref: applyMatrix2/4/N — left-multiply only,
+# no density shadow, no unitarity check)
+# ---------------------------------------------------------------------------
+
+def applyMatrix2(qureg: Qureg, target: int, u) -> None:
+    V.validate_target(qureg, target, "applyMatrix2")
+    qureg.amps = _ap.apply_matrix(qureg.amps, as_matrix(u, 1), _ts(target))
+    qureg.qasm.record_comment("Here, an undisclosed 2-by-2 matrix was applied.")
+
+
+def applyMatrix4(qureg: Qureg, t1: int, t2: int, u) -> None:
+    V.validate_unique_targets(qureg, t1, t2, "applyMatrix4")
+    V.validate_multi_qubit_matrix_fits_in_shard(qureg, 2, "applyMatrix4")
+    qureg.amps = _ap.apply_matrix(qureg.amps, as_matrix(u, 2), (int(t1), int(t2)))
+    qureg.qasm.record_comment("Here, an undisclosed 4-by-4 matrix was applied.")
+
+
+def applyMatrixN(qureg: Qureg, targets, num_targets=None, u=None) -> None:
+    if u is None:
+        u = num_targets
+    else:
+        targets = _ts(targets)[:int(num_targets)]
+    targets = _ts(targets)
+    V.validate_multi_targets(qureg, targets, "applyMatrixN")
+    u = as_matrix(u, len(targets))
+    V.validate_multi_qubit_matrix_size(u, len(targets), "applyMatrixN")
+    V.validate_multi_qubit_matrix_fits_in_shard(qureg, len(targets), "applyMatrixN")
+    qureg.amps = _ap.apply_matrix(qureg.amps, u, targets)
+    qureg.qasm.record_comment("Here, an undisclosed matrix was applied.")
+
+
+def applyMultiControlledMatrixN(qureg: Qureg, ctrls, num_ctrls=None, targets=None,
+                                num_targets=None, u=None) -> None:
+    if u is None:
+        u = targets
+        targets = num_ctrls
+    else:
+        ctrls = _ts(ctrls)[:int(num_ctrls)]
+        targets = _ts(targets)[:int(num_targets)]
+    ctrls, targets = _ts(ctrls), _ts(targets)
+    V.validate_multi_controls_multi_targets(qureg, ctrls, targets,
+                                            "applyMultiControlledMatrixN")
+    u = as_matrix(u, len(targets))
+    V.validate_multi_qubit_matrix_size(u, len(targets), "applyMultiControlledMatrixN")
+    V.validate_multi_qubit_matrix_fits_in_shard(qureg, len(targets),
+                                                "applyMultiControlledMatrixN")
+    qureg.amps = _ap.apply_matrix(qureg.amps, u, targets, ctrls)
+    qureg.qasm.record_comment("Here, an undisclosed controlled matrix was applied.")
+
+
+# ---------------------------------------------------------------------------
+# measurement
+# ---------------------------------------------------------------------------
+
+def _prob_of_zero(qureg: Qureg, target: int) -> float:
+    if qureg.is_density_matrix:
+        return float(_meas.densmatr_prob_of_zero(
+            qureg.amps, int(target), qureg.num_qubits_represented))
+    return float(_meas.prob_of_zero(qureg.amps, int(target)))
+
+
+def calcProbOfOutcome(qureg: Qureg, target: int, outcome: int) -> float:
+    V.validate_target(qureg, target, "calcProbOfOutcome")
+    V.validate_outcome(outcome, "calcProbOfOutcome")
+    p0 = _prob_of_zero(qureg, target)
+    return p0 if int(outcome) == 0 else 1.0 - p0
+
+
+def _collapse(qureg: Qureg, target: int, outcome: int, prob: float) -> None:
+    if qureg.is_density_matrix:
+        qureg.amps = _meas.densmatr_collapse_to_outcome(
+            qureg.amps, int(target), int(outcome), jnp.float64(prob),
+            qureg.num_qubits_represented)
+    else:
+        qureg.amps = _meas.collapse_to_outcome(
+            qureg.amps, int(target), int(outcome), jnp.float64(prob))
+
+
+def collapseToOutcome(qureg: Qureg, target: int, outcome: int) -> float:
+    V.validate_target(qureg, target, "collapseToOutcome")
+    V.validate_outcome(outcome, "collapseToOutcome")
+    p0 = _prob_of_zero(qureg, target)
+    prob = p0 if int(outcome) == 0 else 1.0 - p0
+    V.validate_measurement_prob(prob, "collapseToOutcome", eps=real_eps(qureg.dtype))
+    _collapse(qureg, target, outcome, prob)
+    qureg.qasm.record_comment(
+        f"Here, qubit {int(target)} was collapsed to outcome {int(outcome)}")
+    return prob
+
+
+def measureWithStats(qureg: Qureg, target: int):
+    """Returns (outcome, outcomeProb).  Outcome drawn from the global MT19937
+    exactly as the reference (ref: generateMeasurementOutcome,
+    QuEST_common.c:155-170)."""
+    V.validate_target(qureg, target, "measureWithStats")
+    eps = real_eps(qureg.dtype)
+    zero_prob = _prob_of_zero(qureg, target)
+    if zero_prob < eps:
+        outcome = 1
+    elif 1 - zero_prob < eps:
+        outcome = 0
+    else:
+        outcome = int(rng.rand_real1() > zero_prob)
+    prob = zero_prob if outcome == 0 else 1 - zero_prob
+    _collapse(qureg, target, outcome, prob)
+    qureg.qasm.record_measurement(int(target))
+    return outcome, prob
+
+
+def measure(qureg: Qureg, target: int) -> int:
+    outcome, _ = measureWithStats(qureg, target)
+    return outcome
+
+
+# ---------------------------------------------------------------------------
+# calculations
+# ---------------------------------------------------------------------------
+
+def calcTotalProb(qureg: Qureg) -> float:
+    if qureg.is_density_matrix:
+        return float(_calc.total_prob_densmatr(qureg.amps, qureg.num_qubits_represented))
+    return float(_calc.total_prob_statevec(qureg.amps))
+
+
+def calcInnerProduct(bra: Qureg, ket: Qureg) -> complex:
+    V.validate_state_vec_qureg(bra, "calcInnerProduct")
+    V.validate_state_vec_qureg(ket, "calcInnerProduct")
+    V.validate_matching_qureg_dims(bra, ket, "calcInnerProduct")
+    ip = np.asarray(_calc.inner_product(bra.amps, ket.amps))
+    return complex(ip[0], ip[1])
+
+
+def calcDensityInnerProduct(rho1: Qureg, rho2: Qureg) -> float:
+    V.validate_density_matr_qureg(rho1, "calcDensityInnerProduct")
+    V.validate_density_matr_qureg(rho2, "calcDensityInnerProduct")
+    V.validate_matching_qureg_dims(rho1, rho2, "calcDensityInnerProduct")
+    return float(_calc.densmatr_inner_product(rho1.amps, rho2.amps))
+
+
+def calcPurity(qureg: Qureg) -> float:
+    V.validate_density_matr_qureg(qureg, "calcPurity")
+    return float(_calc.purity(qureg.amps))
+
+
+def calcFidelity(qureg: Qureg, pure: Qureg) -> float:
+    V.validate_second_qureg_state_vec(pure, "calcFidelity")
+    V.validate_matching_qureg_dims(qureg, pure, "calcFidelity")
+    if qureg.is_density_matrix:
+        return float(_calc.densmatr_fidelity(qureg.amps, pure.amps,
+                                             qureg.num_qubits_represented))
+    ip = np.asarray(_calc.inner_product(qureg.amps, pure.amps))
+    return float(ip[0] ** 2 + ip[1] ** 2)
+
+
+def calcHilbertSchmidtDistance(a: Qureg, b: Qureg) -> float:
+    V.validate_density_matr_qureg(a, "calcHilbertSchmidtDistance")
+    V.validate_density_matr_qureg(b, "calcHilbertSchmidtDistance")
+    V.validate_matching_qureg_dims(a, b, "calcHilbertSchmidtDistance")
+    return float(jnp.sqrt(_calc.hilbert_schmidt_distance_squared(a.amps, b.amps)))
+
+
+_Z_DIAG = np.array([[1.0, -1.0], [0.0, 0.0]])  # (re, im) pair of diag(1, -1)
+
+
+def _apply_pauli_prod(amps, targets, codes):
+    """X/Y/Z factors on the row-side qubits (ref: statevec_applyPauliProd,
+    QuEST_common.c:451-463)."""
+    for t, c in zip(targets, codes):
+        c = int(c)
+        if c == PauliOpType.PAULI_X:
+            amps = _ap.apply_pauli_x(amps, int(t))
+        elif c == PauliOpType.PAULI_Y:
+            amps = _ap.apply_pauli_y(amps, int(t))
+        elif c == PauliOpType.PAULI_Z:
+            amps = _ap.apply_diagonal(amps, _Z_DIAG, (int(t),))
+    return amps
+
+
+def calcExpecPauliProd(qureg: Qureg, targets, codes, num_targets=None,
+                       workspace=None) -> float:
+    if workspace is None and not isinstance(num_targets, (int, np.integer, type(None))):
+        workspace = num_targets
+        num_targets = None
+    if num_targets is not None:
+        targets = _ts(targets)[:int(num_targets)]
+        codes = list(codes)[:int(num_targets)]
+    targets = _ts(targets)
+    V.validate_multi_targets(qureg, targets, "calcExpecPauliProd")
+    V.validate_pauli_codes(codes, len(targets), "calcExpecPauliProd")
+    prod_amps = _apply_pauli_prod(qureg.amps, targets, codes)
+    if workspace is not None:
+        workspace.amps = prod_amps
+    if qureg.is_density_matrix:
+        return float(_calc.total_prob_densmatr(prod_amps, qureg.num_qubits_represented))
+    return float(_calc.inner_product(prod_amps, qureg.amps)[0])
+
+
+def calcExpecPauliSum(qureg: Qureg, all_codes, term_coeffs, num_sum_terms=None,
+                      workspace=None) -> float:
+    if workspace is None and not isinstance(num_sum_terms, (int, np.integer, type(None))):
+        workspace = num_sum_terms
+        num_sum_terms = None
+    n = qureg.num_qubits_represented
+    codes = np.asarray(all_codes, dtype=np.int64).reshape(-1, n)
+    coeffs = np.asarray(term_coeffs, dtype=np.float64).ravel()
+    if num_sum_terms is not None:
+        codes = codes[:int(num_sum_terms)]
+        coeffs = coeffs[:int(num_sum_terms)]
+    V.validate_num_pauli_sum_terms(len(codes), "calcExpecPauliSum")
+    V.validate_pauli_codes(codes.ravel(), codes.size, "calcExpecPauliSum")
+    targets = tuple(range(n))
+    value = 0.0
+    for t in range(len(codes)):
+        prod_amps = _apply_pauli_prod(qureg.amps, targets, codes[t])
+        if workspace is not None:
+            workspace.amps = prod_amps
+        if qureg.is_density_matrix:
+            term = float(_calc.total_prob_densmatr(prod_amps, n))
+        else:
+            term = float(_calc.inner_product(prod_amps, qureg.amps)[0])
+        value += coeffs[t] * term
+    return value
+
+
+def calcExpecPauliHamil(qureg: Qureg, hamil: PauliHamil, workspace=None) -> float:
+    V.validate_pauli_hamil(hamil, "calcExpecPauliHamil")
+    V.validate_matching_hamil_qureg_dims(qureg, hamil, "calcExpecPauliHamil")
+    return calcExpecPauliSum(qureg, hamil.pauli_codes, hamil.term_coeffs,
+                             hamil.num_sum_terms, workspace)
+
+
+# ---------------------------------------------------------------------------
+# decoherence
+# ---------------------------------------------------------------------------
+
+def mixDephasing(qureg: Qureg, target: int, prob: float) -> None:
+    V.validate_density_matr_qureg(qureg, "mixDephasing")
+    V.validate_target(qureg, target, "mixDephasing")
+    V.validate_one_qubit_dephase_prob(prob, "mixDephasing")
+    qureg.amps = _deco.mix_dephasing(qureg.amps, jnp.float64(prob), int(target),
+                                     qureg.num_qubits_represented)
+    qureg.qasm.record_comment(
+        f"Here, a phase-damping channel of probability {prob:g} was applied to qubit {int(target)}")
+
+
+def mixTwoQubitDephasing(qureg: Qureg, q1: int, q2: int, prob: float) -> None:
+    V.validate_density_matr_qureg(qureg, "mixTwoQubitDephasing")
+    V.validate_unique_targets(qureg, q1, q2, "mixTwoQubitDephasing")
+    V.validate_two_qubit_dephase_prob(prob, "mixTwoQubitDephasing")
+    qureg.amps = _deco.mix_two_qubit_dephasing(
+        qureg.amps, jnp.float64(prob), int(q1), int(q2), qureg.num_qubits_represented)
+    qureg.qasm.record_comment(
+        f"Here, a two-qubit dephasing channel of probability {prob:g} was applied.")
+
+
+def mixDepolarising(qureg: Qureg, target: int, prob: float) -> None:
+    V.validate_density_matr_qureg(qureg, "mixDepolarising")
+    V.validate_target(qureg, target, "mixDepolarising")
+    V.validate_one_qubit_depol_prob(prob, "mixDepolarising")
+    qureg.amps = _deco.mix_depolarising(qureg.amps, jnp.float64(prob), int(target),
+                                        qureg.num_qubits_represented)
+    qureg.qasm.record_comment(
+        f"Here, a depolarising channel of probability {prob:g} was applied to qubit {int(target)}")
+
+
+def mixDamping(qureg: Qureg, target: int, prob: float) -> None:
+    V.validate_density_matr_qureg(qureg, "mixDamping")
+    V.validate_target(qureg, target, "mixDamping")
+    V.validate_one_qubit_damping_prob(prob, "mixDamping")
+    qureg.amps = _deco.mix_damping(qureg.amps, jnp.float64(prob), int(target),
+                                   qureg.num_qubits_represented)
+    qureg.qasm.record_comment(
+        f"Here, an amplitude damping channel of probability {prob:g} was applied to qubit {int(target)}")
+
+
+def mixTwoQubitDepolarising(qureg: Qureg, q1: int, q2: int, prob: float) -> None:
+    """ρ → (1-p)ρ + p/15 Σ_{P≠I⊗I} PρP, via a 16-operator Kraus superoperator
+    (the reference's three-phase masked kernels, QuEST_cpu.c:387-695, are a
+    memory-traffic optimisation of exactly this channel)."""
+    V.validate_density_matr_qureg(qureg, "mixTwoQubitDepolarising")
+    V.validate_unique_targets(qureg, q1, q2, "mixTwoQubitDepolarising")
+    V.validate_two_qubit_depol_prob(prob, "mixTwoQubitDepolarising")
+    p = float(prob)
+    ops = []
+    for i in range(4):
+        for j in range(4):
+            fac = math.sqrt(1 - p) if (i == 0 and j == 0) else math.sqrt(p / 15)
+            ops.append(fac * np.kron(PAULI_MATRICES[j], PAULI_MATRICES[i]))
+    qureg.amps = _deco.apply_kraus_map(qureg.amps, ops, (int(q1), int(q2)),
+                                       qureg.num_qubits_represented)
+    qureg.qasm.record_comment(
+        f"Here, a two-qubit depolarising channel of probability {p:g} was applied.")
+
+
+def mixPauli(qureg: Qureg, target: int, prob_x: float, prob_y: float,
+             prob_z: float) -> None:
+    """Kraus map {√(1-px-py-pz) I, √px X, √py Y, √pz Z}
+    (ref: densmatr_mixPauli, QuEST_common.c:676-696)."""
+    V.validate_density_matr_qureg(qureg, "mixPauli")
+    V.validate_target(qureg, target, "mixPauli")
+    V.validate_pauli_probs(prob_x, prob_y, prob_z, "mixPauli")
+    facs = [math.sqrt(max(0.0, 1 - prob_x - prob_y - prob_z)),
+            math.sqrt(prob_x), math.sqrt(prob_y), math.sqrt(prob_z)]
+    ops = [facs[i] * PAULI_MATRICES[i] for i in range(4)]
+    qureg.amps = _deco.apply_kraus_map(qureg.amps, ops, (int(target),),
+                                       qureg.num_qubits_represented)
+    qureg.qasm.record_comment(
+        f"Here, a Pauli noise channel was applied to qubit {int(target)}")
+
+
+def _mix_kraus(qureg: Qureg, targets, ops, num_ops, func: str) -> None:
+    if num_ops is not None:
+        ops = list(ops)[:int(num_ops)]
+    ops = list(ops)
+    targets = _ts(targets)
+    V.validate_density_matr_qureg(qureg, func)
+    V.validate_multi_targets(qureg, targets, func)
+    V.validate_num_kraus_ops(len(targets), len(ops), func)
+    V.validate_kraus_sizes(ops, len(targets), func)
+    V.validate_kraus_cptp(ops, func, eps=real_eps(qureg.dtype))
+    V.validate_multi_qubit_matrix_fits_in_shard(qureg, 2 * len(targets), func)
+    qureg.amps = _deco.apply_kraus_map(qureg.amps, ops, targets,
+                                       qureg.num_qubits_represented)
+    qureg.qasm.record_comment(
+        f"Here, an undisclosed Kraus map was applied to {len(targets)} qubit(s)")
+
+
+def mixKrausMap(qureg: Qureg, target: int, ops, num_ops=None) -> None:
+    _mix_kraus(qureg, (int(target),), ops, num_ops, "mixKrausMap")
+
+
+def mixTwoQubitKrausMap(qureg: Qureg, t1: int, t2: int, ops, num_ops=None) -> None:
+    _mix_kraus(qureg, (int(t1), int(t2)), ops, num_ops, "mixTwoQubitKrausMap")
+
+
+def mixMultiQubitKrausMap(qureg: Qureg, targets, num_targets=None, ops=None,
+                          num_ops=None) -> None:
+    if ops is None:
+        ops = num_targets
+        num_targets = None
+    if num_targets is not None:
+        targets = _ts(targets)[:int(num_targets)]
+    _mix_kraus(qureg, targets, ops, num_ops, "mixMultiQubitKrausMap")
+
+
+def mixDensityMatrix(qureg: Qureg, prob: float, other: Qureg) -> None:
+    V.validate_density_matr_qureg(qureg, "mixDensityMatrix")
+    V.validate_density_matr_qureg(other, "mixDensityMatrix")
+    V.validate_matching_qureg_dims(qureg, other, "mixDensityMatrix")
+    V.validate_prob(prob, "mixDensityMatrix")
+    qureg.amps = _deco.mix_density_matrix(qureg.amps, jnp.float64(prob), other.amps)
+    qureg.qasm.record_comment(
+        f"Here, the register was mixed with probability {float(prob):g}")
+
+
+# ---------------------------------------------------------------------------
+# operator application
+# ---------------------------------------------------------------------------
+
+def applyPauliSum(in_qureg: Qureg, all_codes, term_coeffs, num_sum_terms,
+                  out_qureg: Qureg) -> None:
+    """out = Σ_t c_t P_t |in> (ref: statevec_applyPauliSum, QuEST_common.c:493-515).
+
+    Functional accumulate — the reference's in-place apply/undo on inQureg is
+    unnecessary under immutable arrays."""
+    V.validate_matching_qureg_types(in_qureg, out_qureg, "applyPauliSum")
+    V.validate_matching_qureg_dims(in_qureg, out_qureg, "applyPauliSum")
+    n = in_qureg.num_qubits_represented
+    codes = np.asarray(all_codes, dtype=np.int64).reshape(-1, n)[:int(num_sum_terms)]
+    coeffs = np.asarray(term_coeffs, dtype=np.float64).ravel()[:int(num_sum_terms)]
+    V.validate_num_pauli_sum_terms(len(codes), "applyPauliSum")
+    V.validate_pauli_codes(codes.ravel(), codes.size, "applyPauliSum")
+    targets = tuple(range(n))
+    acc = _init.blank_state(in_qureg.num_amps_total, in_qureg.dtype)
+    for t in range(len(codes)):
+        acc = acc + coeffs[t] * _apply_pauli_prod(in_qureg.amps, targets, codes[t])
+    out_qureg.amps = acc.astype(out_qureg.dtype)
+
+
+def applyPauliHamil(in_qureg: Qureg, hamil: PauliHamil, out_qureg: Qureg) -> None:
+    V.validate_pauli_hamil(hamil, "applyPauliHamil")
+    V.validate_matching_hamil_qureg_dims(in_qureg, hamil, "applyPauliHamil")
+    applyPauliSum(in_qureg, hamil.pauli_codes, hamil.term_coeffs,
+                  hamil.num_sum_terms, out_qureg)
+
+
+def _apply_exponentiated_pauli_hamil(qureg: Qureg, hamil: PauliHamil, fac: float,
+                                     reverse: bool) -> None:
+    """First-order product formula exp(-i fac H) ≈ Π_j exp(-i fac c_j h_j)
+    (ref: applyExponentiatedPauliHamil, QuEST_common.c:698+)."""
+    n = hamil.num_qubits
+    vec_targets = tuple(range(n))
+    dens_targets = tuple(range(n, 2 * n))
+    order = range(hamil.num_sum_terms)
+    if reverse:
+        order = reversed(order)
+    for t in order:
+        angle = 2 * fac * float(hamil.term_coeffs[t])
+        codes = hamil.pauli_codes[t]
+        qureg.amps = _multi_rotate_pauli_statevec(
+            qureg.amps, vec_targets, codes, angle, False)
+        if qureg.is_density_matrix:
+            qureg.amps = _multi_rotate_pauli_statevec(
+                qureg.amps, dens_targets, codes, angle, True)
+
+
+def _apply_symmetrized_trotter(qureg: Qureg, hamil: PauliHamil, time: float,
+                               order: int) -> None:
+    """Symmetrized Suzuki recursion (ref: applySymmetrizedTrotterCircuit,
+    QuEST_common.c:755-775)."""
+    if order == 1:
+        _apply_exponentiated_pauli_hamil(qureg, hamil, time, False)
+    elif order == 2:
+        _apply_exponentiated_pauli_hamil(qureg, hamil, time / 2.0, False)
+        _apply_exponentiated_pauli_hamil(qureg, hamil, time / 2.0, True)
+    else:
+        p = 1.0 / (4 - 4 ** (1.0 / (order - 1)))
+        lower = order - 2
+        _apply_symmetrized_trotter(qureg, hamil, p * time, lower)
+        _apply_symmetrized_trotter(qureg, hamil, p * time, lower)
+        _apply_symmetrized_trotter(qureg, hamil, (1 - 4 * p) * time, lower)
+        _apply_symmetrized_trotter(qureg, hamil, p * time, lower)
+        _apply_symmetrized_trotter(qureg, hamil, p * time, lower)
+
+
+def applyTrotterCircuit(qureg: Qureg, hamil: PauliHamil, time: float,
+                        order: int, reps: int) -> None:
+    V.validate_pauli_hamil(hamil, "applyTrotterCircuit")
+    V.validate_matching_hamil_qureg_dims(qureg, hamil, "applyTrotterCircuit")
+    V.validate_trotter_params(order, reps, "applyTrotterCircuit")
+    qureg.qasm.record_comment(
+        f"Beginning of Trotter circuit (time {float(time):g}, order {order}, {reps} repetitions).")
+    if time != 0:
+        for _ in range(reps):
+            _apply_symmetrized_trotter(qureg, hamil, float(time) / reps, order)
+    qureg.qasm.record_comment("End of Trotter circuit")
+
+
+def applyDiagonalOp(qureg: Qureg, op: DiagonalOp) -> None:
+    V.validate_diag_op_init(op, "applyDiagonalOp")
+    V.validate_matching_qureg_diag_dims(qureg, op, "applyDiagonalOp")
+    if qureg.is_density_matrix:
+        qureg.amps = _ap.densmatr_apply_diagonal(qureg.amps, op.amps,
+                                                 qureg.num_qubits_represented)
+    else:
+        qureg.amps = _ap.apply_full_diagonal(qureg.amps, op.amps)
+    qureg.qasm.record_comment("Here, an undisclosed diagonal operator was applied.")
+
+
+def calcExpecDiagonalOp(qureg: Qureg, op: DiagonalOp) -> complex:
+    V.validate_diag_op_init(op, "calcExpecDiagonalOp")
+    V.validate_matching_qureg_diag_dims(qureg, op, "calcExpecDiagonalOp")
+    if qureg.is_density_matrix:
+        pair = _calc.expec_diagonal_op_densmatr(
+            qureg.amps, op.amps, qureg.num_qubits_represented)
+    else:
+        pair = _calc.expec_diagonal_op_statevec(qureg.amps, op.amps)
+    pair = np.asarray(pair)
+    return complex(pair[0], pair[1])
+
+
+def setWeightedQureg(fac1, qureg1: Qureg, fac2, qureg2: Qureg, fac_out,
+                     out: Qureg) -> None:
+    V.validate_matching_qureg_types(qureg1, qureg2, "setWeightedQureg")
+    V.validate_matching_qureg_types(qureg1, out, "setWeightedQureg")
+    V.validate_matching_qureg_dims(qureg1, qureg2, "setWeightedQureg")
+    V.validate_matching_qureg_dims(qureg1, out, "setWeightedQureg")
+    def _fac(f):
+        f = complex(f)
+        return jnp.asarray([f.real, f.imag], dtype=jnp.float64)
+    out.amps = _init.weighted_qureg(
+        _fac(fac1), qureg1.amps, _fac(fac2), qureg2.amps, _fac(fac_out), out.amps)
+    out.qasm.record_comment("Here, the register was set to a weighted sum of registers.")
+
+
+# ---------------------------------------------------------------------------
+# QASM
+# ---------------------------------------------------------------------------
+
+def startRecordingQASM(qureg: Qureg) -> None:
+    qureg.qasm.is_logging = True
+
+
+def stopRecordingQASM(qureg: Qureg) -> None:
+    qureg.qasm.is_logging = False
+
+
+def clearRecordedQASM(qureg: Qureg) -> None:
+    qureg.qasm.clear()
+
+
+def printRecordedQASM(qureg: Qureg) -> None:
+    qureg.qasm.print()
+
+
+def writeRecordedQASMToFile(qureg: Qureg, filename: str) -> None:
+    try:
+        qureg.qasm.write_to_file(filename)
+    except OSError:
+        V._throw(V.ErrorCode.CANNOT_OPEN_FILE, "writeRecordedQASMToFile", filename)
+
+
+# ---------------------------------------------------------------------------
+# reporting / debug
+# ---------------------------------------------------------------------------
+
+def reportState(qureg: Qureg) -> None:
+    """CSV dump (ref: reportState, QuEST_common.c:216-232)."""
+    with open("state_rank_0.csv", "w") as f:
+        f.write("real, imag\n")
+        arr = np.asarray(qureg.amps)
+        for re, im in zip(arr[0], arr[1]):
+            f.write(f"{re:.12f}, {im:.12f}\n")
+
+
+def reportStateToScreen(qureg: Qureg, env: QuESTEnv = None, report_rank: int = 0) -> None:
+    V.validate_report_size(qureg, "reportStateToScreen")
+    arr = np.asarray(qureg.amps)
+    print("Reporting state from rank 0:")
+    for re, im in zip(arr[0], arr[1]):
+        print(f"{re:.12f}, {im:.12f}")
+
+
+def copyStateToGPU(qureg: Qureg) -> None:
+    """No-op: jax arrays live on-device (ref parity: copyStateToGPU)."""
+
+
+def copyStateFromGPU(qureg: Qureg) -> None:
+    """No-op: host reads fetch on demand (ref parity: copyStateFromGPU)."""

@@ -1,0 +1,108 @@
+"""Execution environment: device mesh + sharding.
+
+The reference's ``QuESTEnv`` wraps MPI init/finalize and rank discovery
+(ref: QuEST/include/QuEST.h:242-246, QuEST_cpu_distributed.c:129-160).  On TPU
+the equivalent is a ``jax.sharding.Mesh`` over the chips: a single SPMD
+program replaces the rank-per-process model, and "numRanks" becomes the mesh
+size.  The amplitude axis of every distributed Qureg is sharded over the
+mesh's single ``"amps"`` axis, which reproduces the reference's contiguous
+chunk-per-rank layout (rank r owns global window [r*chunk, (r+1)*chunk)) while
+letting XLA's GSPMD partitioner insert the collectives the reference hand-wrote
+with MPI_Sendrecv/Allreduce/Bcast.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import rng
+from .validation import validate_num_ranks
+
+AMPS_AXIS = "amps"
+
+
+def _largest_pow2_leq(x: int) -> int:
+    p = 1
+    while p * 2 <= x:
+        p *= 2
+    return p
+
+
+@dataclasses.dataclass
+class QuESTEnv:
+    """Device mesh + seeding context (ref analogue: QuESTEnv, QuEST.h:242-246)."""
+
+    mesh: Mesh | None
+    num_ranks: int
+    rank: int = 0  # single-controller SPMD: the host drives all shards
+
+    @property
+    def sharding(self) -> NamedSharding | None:
+        """Sharding for a (2, 2^n) SoA amplitude pair: re/im replicated on
+        axis 0, the amplitude axis split over the mesh — reproducing the
+        reference's contiguous chunk-per-rank layout."""
+        if self.mesh is None or self.num_ranks == 1:
+            return None
+        return NamedSharding(self.mesh, P(None, AMPS_AXIS))
+
+    def replicated(self) -> NamedSharding | None:
+        if self.mesh is None or self.num_ranks == 1:
+            return None
+        return NamedSharding(self.mesh, P())
+
+
+def create_quest_env(num_devices: int | None = None, devices=None) -> QuESTEnv:
+    """Ref analogue: createQuESTEnv (QuEST_cpu_local.c:170-180 / _distributed.c:129-160).
+
+    Builds a 1-D mesh over the available accelerator devices.  With one device
+    the mesh is omitted and everything is shard-free (the "local backend").
+    ``num_devices`` may be passed to use a subset (must be a power of 2).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is None:
+        num_devices = _largest_pow2_leq(len(devices))
+    validate_num_ranks(num_devices, "createQuESTEnv")
+    if num_devices > len(devices):
+        raise ValueError(
+            f"requested {num_devices} devices but only {len(devices)} available")
+    devices = devices[:num_devices]
+    if num_devices == 1:
+        env = QuESTEnv(mesh=None, num_ranks=1)
+    else:
+        mesh = Mesh(np.asarray(devices), (AMPS_AXIS,))
+        env = QuESTEnv(mesh=mesh, num_ranks=num_devices)
+    rng.seed_quest_default()
+    return env
+
+
+def destroy_quest_env(env: QuESTEnv) -> None:
+    """Ref analogue: destroyQuESTEnv — nothing to tear down under JAX."""
+
+
+def sync_quest_env(env: QuESTEnv) -> None:
+    """Ref analogue: syncQuESTEnv (MPI_Barrier) — block until device work drains."""
+    for d in jax.live_arrays():
+        d.block_until_ready()
+
+
+def sync_quest_success(env: QuESTEnv, success_code: int) -> int:
+    """Ref analogue: syncQuESTSuccess (Allreduce LAND) — trivial single-controller."""
+    return int(success_code)
+
+
+def get_environment_string(env: QuESTEnv, qureg) -> str:
+    mode = "distributed" if env.num_ranks > 1 else "local"
+    plat = jax.devices()[0].platform
+    return (f"EXEC=TPU-SPMD/{plat} MODE={mode} NUMDEVICES={env.num_ranks} "
+            f"QUBITS={qureg.num_qubits_represented}")
+
+
+def report_quest_env(env: QuESTEnv) -> None:
+    print("EXECUTION ENVIRONMENT:")
+    print(f"Running distributed (SPMD) version on {env.num_ranks} device(s)")
+    print(f"Backend platform: {jax.devices()[0].platform}")

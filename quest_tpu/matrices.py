@@ -1,0 +1,241 @@
+"""User-facing value types: complex matrices, Pauli Hamiltonians, diagonal ops.
+
+Ref analogues: ``Complex``/``ComplexMatrix2/4/N`` (QuEST.h:103-141),
+``Vector`` (QuEST.h:148-151), ``PauliHamil`` (QuEST.h:158-169),
+``DiagonalOp`` (QuEST.h:178-194), ``enum pauliOpType`` (QuEST.h:96).
+
+The reference stores matrices as separate real/imag 2-D C arrays (a C99
+constraint); here a matrix is simply a complex ndarray, and the constructors
+below exist for source-level familiarity (`ComplexMatrix2(real=.., imag=..)`)
+and for the file-based PauliHamil loader.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .validation import ErrorCode, QuESTError, _throw, validate_diag_op_elems
+
+
+class PauliOpType(enum.IntEnum):
+    PAULI_I = 0
+    PAULI_X = 1
+    PAULI_Y = 2
+    PAULI_Z = 3
+
+
+PAULI_I = PauliOpType.PAULI_I
+PAULI_X = PauliOpType.PAULI_X
+PAULI_Y = PauliOpType.PAULI_Y
+PAULI_Z = PauliOpType.PAULI_Z
+
+# dense 2x2 Pauli matrices, indexed by code
+PAULI_MATRICES = np.stack([
+    np.eye(2, dtype=np.complex128),
+    np.array([[0, 1], [1, 0]], dtype=np.complex128),
+    np.array([[0, -1j], [1j, 0]], dtype=np.complex128),
+    np.array([[1, 0], [0, -1]], dtype=np.complex128),
+])
+
+
+def Complex(real: float = 0.0, imag: float = 0.0) -> complex:
+    """Ref analogue: Complex struct (QuEST.h:103)."""
+    return complex(real, imag)
+
+
+def Vector(x: float, y: float, z: float):
+    """Ref analogue: Vector (QuEST.h:148-151)."""
+    return (float(x), float(y), float(z))
+
+
+def _matrix_from_parts(real, imag, dim: int) -> np.ndarray:
+    if real is None and imag is None:
+        return np.zeros((dim, dim), dtype=np.complex128)
+    r = np.zeros((dim, dim)) if real is None else np.asarray(real, dtype=np.float64)
+    i = np.zeros((dim, dim)) if imag is None else np.asarray(imag, dtype=np.float64)
+    m = r + 1j * i
+    if m.shape != (dim, dim):
+        raise QuESTError(ErrorCode.INVALID_UNITARY_SIZE,
+                         f"expected a {dim}x{dim} matrix, got shape {m.shape}")
+    return m
+
+
+def ComplexMatrix2(real=None, imag=None) -> np.ndarray:
+    return _matrix_from_parts(real, imag, 2)
+
+
+def ComplexMatrix4(real=None, imag=None) -> np.ndarray:
+    return _matrix_from_parts(real, imag, 4)
+
+
+def create_complex_matrix_n(num_qubits: int) -> np.ndarray:
+    """Ref analogue: createComplexMatrixN (QuEST.c) — a zeroed 2^n x 2^n matrix."""
+    if num_qubits < 1:
+        _throw(ErrorCode.INVALID_NUM_QUBITS, "createComplexMatrixN")
+    return np.zeros((2 ** num_qubits, 2 ** num_qubits), dtype=np.complex128)
+
+
+def init_complex_matrix_n(m: np.ndarray, real, imag) -> None:
+    """Ref analogue: initComplexMatrixN — in-place fill from re/im parts."""
+    m[...] = np.asarray(real, dtype=np.float64) + 1j * np.asarray(imag, dtype=np.float64)
+
+
+def as_matrix(u, num_targets: int) -> np.ndarray:
+    """Coerce any user matrix (ndarray / nested lists / jnp) to complex ndarray."""
+    m = np.asarray(u, dtype=np.complex128)
+    dim = 2 ** num_targets
+    if m.shape != (dim, dim):
+        _throw(ErrorCode.INVALID_UNITARY_SIZE)
+    return m
+
+
+# ---------------------------------------------------------------------------
+# PauliHamil
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class PauliHamil:
+    """Weighted sum of Pauli strings (ref: PauliHamil, QuEST.h:158-169)."""
+
+    num_qubits: int
+    num_sum_terms: int
+    # shape (num_sum_terms, num_qubits), int codes 0..3
+    pauli_codes: np.ndarray = None
+    # shape (num_sum_terms,), real
+    term_coeffs: np.ndarray = None
+
+    def __post_init__(self):
+        if self.pauli_codes is None:
+            self.pauli_codes = np.zeros((self.num_sum_terms, self.num_qubits), dtype=np.int32)
+        if self.term_coeffs is None:
+            self.term_coeffs = np.zeros(self.num_sum_terms, dtype=np.float64)
+
+
+def create_pauli_hamil(num_qubits: int, num_sum_terms: int) -> PauliHamil:
+    if num_qubits < 1 or num_sum_terms < 1:
+        _throw(ErrorCode.INVALID_PAULI_HAMIL_PARAMS, "createPauliHamil")
+    return PauliHamil(num_qubits, num_sum_terms)
+
+
+def init_pauli_hamil(hamil: PauliHamil, coeffs, codes) -> None:
+    """Ref analogue: initPauliHamil — codes is the flat
+    [term0 qubit0..qubitN-1, term1 ...] layout of the reference."""
+    codes = np.asarray(codes, dtype=np.int32).reshape(hamil.num_sum_terms, hamil.num_qubits)
+    for c in codes.ravel():
+        if c not in (0, 1, 2, 3):
+            _throw(ErrorCode.INVALID_PAULI_CODE, "initPauliHamil")
+    hamil.term_coeffs = np.asarray(coeffs, dtype=np.float64).reshape(hamil.num_sum_terms)
+    hamil.pauli_codes = codes
+
+
+def create_pauli_hamil_from_file(fn: str) -> PauliHamil:
+    """Parse the reference's plain-text format: each line is a coefficient
+    followed by one Pauli code per qubit (ref: createPauliHamilFromFile,
+    QuEST.c:1169-1251).  Qubit count is inferred from the first line."""
+    try:
+        with open(fn) as f:
+            lines = [ln.split() for ln in f if ln.strip()]
+    except OSError:
+        _throw(ErrorCode.CANNOT_OPEN_FILE, "createPauliHamilFromFile", fn)
+    if not lines:
+        _throw(ErrorCode.INVALID_PAULI_HAMIL_FILE_PARAMS, "createPauliHamilFromFile", fn)
+    num_qubits = len(lines[0]) - 1
+    num_terms = len(lines)
+    if num_qubits < 1:
+        _throw(ErrorCode.INVALID_PAULI_HAMIL_FILE_PARAMS, "createPauliHamilFromFile", fn)
+    coeffs = np.zeros(num_terms)
+    codes = np.zeros((num_terms, num_qubits), dtype=np.int32)
+    for t, tok in enumerate(lines):
+        try:
+            coeffs[t] = float(tok[0])
+        except (ValueError, IndexError):
+            _throw(ErrorCode.CANNOT_PARSE_PAULI_HAMIL_FILE_COEFF, "createPauliHamilFromFile", fn)
+        if len(tok) != num_qubits + 1:
+            _throw(ErrorCode.CANNOT_PARSE_PAULI_HAMIL_FILE_PAULI, "createPauliHamilFromFile", fn)
+        for q in range(num_qubits):
+            try:
+                code = int(tok[1 + q])
+            except ValueError:
+                _throw(ErrorCode.CANNOT_PARSE_PAULI_HAMIL_FILE_PAULI, "createPauliHamilFromFile", fn)
+            if code not in (0, 1, 2, 3):
+                _throw(ErrorCode.INVALID_PAULI_HAMIL_FILE_PAULI_CODE, "createPauliHamilFromFile", fn)
+            codes[t, q] = code
+    hamil = PauliHamil(num_qubits, num_terms)
+    init_pauli_hamil(hamil, coeffs, codes)
+    return hamil
+
+
+def destroy_pauli_hamil(hamil: PauliHamil) -> None:
+    """Ref analogue: destroyPauliHamil — GC handles it; kept for API parity."""
+
+
+def report_pauli_hamil(hamil: PauliHamil) -> None:
+    for t in range(hamil.num_sum_terms):
+        codes = "\t".join(str(int(c)) for c in hamil.pauli_codes[t])
+        print(f"{hamil.term_coeffs[t]}\t{codes}")
+
+
+# ---------------------------------------------------------------------------
+# DiagonalOp
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class DiagonalOp:
+    """Distributed 2^N-element diagonal operator (ref: DiagonalOp, QuEST.h:178-194).
+
+    Stored as a (2, 2^N) SoA real pair sharded identically to a same-size
+    Qureg, so elementwise application needs no resharding."""
+
+    num_qubits: int
+    env: object
+    amps: jax.Array | None = None
+
+
+def create_diagonal_op(num_qubits: int, env) -> DiagonalOp:
+    if num_qubits < 1:
+        _throw(ErrorCode.INVALID_NUM_CREATE_QUBITS, "createDiagonalOp")
+    if 2 ** num_qubits < env.num_ranks:
+        _throw(ErrorCode.DISTRIB_DIAG_OP_TOO_SMALL, "createDiagonalOp")
+    from .precision import CONFIG
+    amps = jnp.zeros((2, 2 ** num_qubits), dtype=CONFIG.real_dtype)
+    if env.sharding is not None:
+        amps = jax.device_put(amps, env.sharding)
+    return DiagonalOp(num_qubits, env, amps)
+
+
+def destroy_diagonal_op(op: DiagonalOp, env=None) -> None:
+    op.amps = None
+
+
+def sync_diagonal_op(op: DiagonalOp) -> None:
+    """Ref analogue: syncDiagonalOp (host->GPU copy) — jax arrays are already
+    device-resident; block for completeness."""
+    if op.amps is not None:
+        op.amps.block_until_ready()
+
+
+def init_diagonal_op(op: DiagonalOp, real, imag) -> None:
+    re = np.asarray(real, dtype=np.float64).ravel()
+    im = np.asarray(imag, dtype=np.float64).ravel()
+    if re.shape != (2 ** op.num_qubits,) or im.shape != (2 ** op.num_qubits,):
+        _throw(ErrorCode.INVALID_NUM_ELEMS, "initDiagonalOp")
+    new = jnp.asarray(np.stack([re, im]), dtype=op.amps.dtype)
+    if op.env.sharding is not None:
+        new = jax.device_put(new, op.env.sharding)
+    op.amps = new
+
+
+def set_diagonal_op_elems(op: DiagonalOp, start_ind: int, real, imag, num_elems: int) -> None:
+    validate_diag_op_elems(op, start_ind, num_elems, "setDiagonalOpElems")
+    re = np.asarray(real, dtype=np.float64).ravel()[:num_elems]
+    im = np.asarray(imag, dtype=np.float64).ravel()[:num_elems]
+    new = op.amps.at[:, start_ind:start_ind + num_elems].set(
+        jnp.asarray(np.stack([re, im]), dtype=op.amps.dtype))
+    if op.env.sharding is not None:
+        new = jax.device_put(new, op.env.sharding)
+    op.amps = new

@@ -1,0 +1,5 @@
+"""Functional op layer: pure jitted programs over amplitude arrays.
+
+Modules: apply (gate engine), diagonal phases, init (state builders),
+measure (probabilities/collapse), calc (reductions), decoherence (channels).
+"""

@@ -1,0 +1,153 @@
+"""Decoherence channels on Choi-flattened density matrices.
+
+A density matrix of N qubits lives as a 2N-qubit amplitude pair with the
+row (ket) index in qubits 0..N-1 and the column (bra) index in N..2N-1
+(ref: getDensityAmp, QuEST.c:709-719).  A channel touching target q acts on
+the two axes (q, q+N).
+
+Dephasing-type channels are *diagonal* in this basis — pure broadcast
+multiplies by real factors, never any data movement, matching the reference's
+observation that its dephasing kernels are comm-free
+(ref: densmatr_oneQubitDegradeOffDiagonal, QuEST_cpu.c:48).  Population-mixing
+channels (depolarising, damping) combine the four (row-bit, col-bit)
+sub-blocks with static slices and real coefficients.  General Kraus maps
+become one dense superoperator matrix applied on the doubled axes via the
+universal gate engine (ref: populateKrausSuperOperator path,
+QuEST_common.c:541-605).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .apply import _axis, apply_matrix, mat_pair
+
+
+def _rc_axes(target: int, num_qubits: int):
+    n = 2 * num_qubits
+    return _axis(target, n), _axis(target + num_qubits, n)
+
+
+def _block_idx(n: int, axes_bits):
+    """Index tuple over a (2,)+(2,)*n tensor fixing given (axis, bit) pairs."""
+    idx = [slice(None)] * (n + 1)
+    for a, b in axes_bits:
+        idx[1 + a] = b
+    return tuple(idx)
+
+
+def _xor_pattern(n: int, ar: int, ac: int, dtype):
+    """Broadcastable {0,1} tensor (over a single-part (2,)*n view): 1 where
+    row bit != col bit of one qubit."""
+    m = jnp.array([[0.0, 1.0], [1.0, 0.0]], dtype=dtype)
+    return m.reshape([2 if i in (ar, ac) else 1 for i in range(n)])
+
+
+@partial(jax.jit, static_argnames=("target", "num_qubits"))
+def mix_dephasing(state: jax.Array, prob: jax.Array, target: int, num_qubits: int) -> jax.Array:
+    """ρ → (1-p)ρ + p ZρZ: off-diagonals (in q) scale by 1-2p
+    (ref: densmatr_mixDephasing, QuEST_cpu.c:79)."""
+    n = 2 * num_qubits
+    t = state.reshape((2,) + (2,) * n)
+    ar, ac = _rc_axes(target, num_qubits)
+    d = _xor_pattern(n, ar, ac, state.dtype)
+    factor = (1.0 - (2.0 * prob).astype(state.dtype) * d)[None]
+    return (t * factor).reshape(2, -1)
+
+
+@partial(jax.jit, static_argnames=("q1", "q2", "num_qubits"))
+def mix_two_qubit_dephasing(state: jax.Array, prob: jax.Array, q1: int, q2: int,
+                            num_qubits: int) -> jax.Array:
+    """ρ → (1-p)ρ + p/3 (Z1ρZ1 + Z2ρZ2 + Z1Z2ρZ1Z2): every element that is
+    off-diagonal in either qubit scales by 1-4p/3
+    (ref: densmatr_mixTwoQubitDephasing, QuEST_cpu.c:84)."""
+    n = 2 * num_qubits
+    t = state.reshape((2,) + (2,) * n)
+    r1, c1 = _rc_axes(q1, num_qubits)
+    r2, c2 = _rc_axes(q2, num_qubits)
+    d1 = _xor_pattern(n, r1, c1, state.dtype)
+    d2 = _xor_pattern(n, r2, c2, state.dtype)
+    off = 1.0 - (1.0 - d1) * (1.0 - d2)  # 1 where off-diagonal in q1 or q2
+    factor = (1.0 - (4.0 * prob / 3.0).astype(state.dtype) * off)[None]
+    return (t * factor).reshape(2, -1)
+
+
+@partial(jax.jit, static_argnames=("target", "num_qubits"))
+def mix_depolarising(state: jax.Array, prob: jax.Array, target: int,
+                     num_qubits: int) -> jax.Array:
+    """ρ → (1-p)ρ + p/3 (XρX + YρY + ZρZ)
+    (ref: densmatr_mixDepolarisingLocal, QuEST_cpu.c:125, with its
+    depolLevel = 4p/3 re-parametrisation resolved analytically):
+    off-diag *= 1-4p/3; populations mix as a00' = (1-2p/3)a00 + (2p/3)a11."""
+    n = 2 * num_qubits
+    t = state.reshape((2,) + (2,) * n)
+    ar, ac = _rc_axes(target, num_qubits)
+    i00 = _block_idx(n, [(ar, 0), (ac, 0)])
+    i11 = _block_idx(n, [(ar, 1), (ac, 1)])
+    i01 = _block_idx(n, [(ar, 0), (ac, 1)])
+    i10 = _block_idx(n, [(ar, 1), (ac, 0)])
+    a00, a11 = t[i00], t[i11]
+    mix = (2.0 * prob / 3.0).astype(state.dtype)
+    off = (1.0 - 4.0 * prob / 3.0).astype(state.dtype)
+    t = t.at[i00].set((1.0 - mix) * a00 + mix * a11)
+    t = t.at[i11].set((1.0 - mix) * a11 + mix * a00)
+    t = t.at[i01].set(off * t[i01])
+    t = t.at[i10].set(off * t[i10])
+    return t.reshape(2, -1)
+
+
+@partial(jax.jit, static_argnames=("target", "num_qubits"))
+def mix_damping(state: jax.Array, prob: jax.Array, target: int,
+                num_qubits: int) -> jax.Array:
+    """Amplitude damping |1><1| → |0><0| with probability p
+    (ref: densmatr_mixDampingLocal, QuEST_cpu.c:174):
+    a00' = a00 + p·a11, a11' = (1-p)a11, off-diag *= sqrt(1-p)."""
+    n = 2 * num_qubits
+    t = state.reshape((2,) + (2,) * n)
+    ar, ac = _rc_axes(target, num_qubits)
+    i00 = _block_idx(n, [(ar, 0), (ac, 0)])
+    i11 = _block_idx(n, [(ar, 1), (ac, 1)])
+    i01 = _block_idx(n, [(ar, 0), (ac, 1)])
+    i10 = _block_idx(n, [(ar, 1), (ac, 0)])
+    a00, a11 = t[i00], t[i11]
+    p = prob.astype(state.dtype)
+    keep = jnp.sqrt(1.0 - p)
+    t = t.at[i00].set(a00 + p * a11)
+    t = t.at[i11].set((1.0 - p) * a11)
+    t = t.at[i01].set(keep * t[i01])
+    t = t.at[i10].set(keep * t[i10])
+    return t.reshape(2, -1)
+
+
+def kraus_superoperator(ops) -> np.ndarray:
+    """S = Σ_i conj(K_i) ⊗ K_i in the (column ⊗ row) index convention of the
+    flattened density matrix: vec(K ρ K†) = (K̄ ⊗ K) vec(ρ), returned as a
+    (2, 4^k, 4^k) real pair
+    (ref analogue: populateKrausSuperOperator2/4/N, QuEST_common.c:541-574)."""
+    mats = [np.asarray(k, dtype=np.complex128) for k in ops]
+    dim = mats[0].shape[0]
+    s = np.zeros((dim * dim, dim * dim), dtype=np.complex128)
+    for k in mats:
+        s += np.kron(np.conj(k), k)
+    return mat_pair(s)
+
+
+def apply_kraus_map(state: jax.Array, ops, targets, num_qubits: int) -> jax.Array:
+    """Apply a Kraus channel by one dense superoperator matrix on the doubled
+    targets (ts..., ts+N...) — the same engine path as a 2k-qubit gate, which
+    is exactly how the reference routes Kraus maps
+    (ref: densmatr_applyKrausSuperoperator, QuEST_common.c:576-605)."""
+    s = kraus_superoperator(ops)
+    doubled = tuple(targets) + tuple(t + num_qubits for t in targets)
+    return apply_matrix(state, s, doubled)
+
+
+@jax.jit
+def mix_density_matrix(combine: jax.Array, prob: jax.Array, other: jax.Array) -> jax.Array:
+    """out = (1-p)·out + p·other (ref: densmatr_mixDensityMatrix, QuEST_cpu.c:890)."""
+    p = prob.astype(combine.dtype)
+    return (1.0 - p) * combine + p * other

@@ -1,0 +1,98 @@
+"""State initialisers (ref analogues: QuEST_cpu.c:1398-1673 init* family).
+
+All produce (2, 2^n) SoA real-pair amplitude arrays.  Pure jitted functions:
+under a sharded output sharding each device generates only its own window
+(no initialiser materialises the full state on one device)."""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("num_amps", "dtype"), inline=True)
+def blank_state(num_amps: int, dtype) -> jax.Array:
+    """Ref: initBlankState (QuEST_cpu.c:1398) — all zeros."""
+    return jnp.zeros((2, num_amps), dtype=dtype)
+
+
+@partial(jax.jit, static_argnames=("num_amps", "dtype"))
+def zero_state(num_amps: int, dtype) -> jax.Array:
+    """Ref: initZeroState (QuEST_cpu.c:1428) — |00..0>."""
+    return jnp.zeros((2, num_amps), dtype=dtype).at[0, 0].set(1.0)
+
+
+@partial(jax.jit, static_argnames=("num_amps", "dtype"))
+def plus_state(num_amps: int, dtype) -> jax.Array:
+    """Ref: initPlusState (QuEST_cpu.c:1438) — uniform 1/sqrt(2^n)."""
+    norm = 1.0 / jnp.sqrt(jnp.asarray(num_amps, dtype=dtype))
+    re = jnp.full((num_amps,), norm, dtype=dtype)
+    return jnp.stack([re, jnp.zeros_like(re)])
+
+
+@partial(jax.jit, static_argnames=("num_amps", "dtype"))
+def classical_state(num_amps: int, state_ind, dtype) -> jax.Array:
+    """Ref: initClassicalState (QuEST_cpu.c:1470) — basis state |s>."""
+    return jnp.zeros((2, num_amps), dtype=dtype).at[0, state_ind].set(1.0)
+
+
+@partial(jax.jit, static_argnames=("num_amps", "dtype"))
+def debug_state(num_amps: int, dtype) -> jax.Array:
+    """Ref: initDebugState (QuEST_cpu.c:1591) — amp k = (2k + i(2k+1))/10."""
+    k = jnp.arange(num_amps, dtype=dtype)
+    return jnp.stack([(2 * k) / 10.0, (2 * k + 1) / 10.0])
+
+
+@partial(jax.jit, static_argnames=("num_qubits", "qubit_id", "outcome", "dtype"))
+def state_of_single_qubit(num_qubits: int, qubit_id: int, outcome: int, dtype) -> jax.Array:
+    """Ref: initStateOfSingleQubit (QuEST_cpu.c:1545) — uniform over basis
+    states whose ``qubit_id`` bit equals ``outcome``."""
+    num_amps = 1 << num_qubits
+    k = jnp.arange(num_amps)
+    bit = (k >> qubit_id) & 1
+    norm = 1.0 / jnp.sqrt(jnp.asarray(num_amps // 2, dtype=dtype))
+    re = jnp.where(bit == outcome, norm, 0.0).astype(dtype)
+    return jnp.stack([re, jnp.zeros_like(re)])
+
+
+@partial(jax.jit, static_argnames=("num_qubits",))
+def densmatr_pure_state(pure: jax.Array, num_qubits: int) -> jax.Array:
+    """Ref: densmatr_initPureStateLocal (QuEST_cpu.c:1184) — ρ = |ψ><ψ|,
+    flattened column-major (row index in the low qubits).
+
+    The reference broadcasts ψ into every rank's pairStateVec then forms the
+    outer product per-chunk; here it is one outer product whose row axis
+    GSPMD keeps local and whose column axis follows the Qureg sharding."""
+    pr, pi = pure[0], pure[1]
+    # ρ(r,c) = ψ_r ψ_c*; storage [c, r] (flat index = r + c·2^N)
+    re = pi[:, None] * pi[None, :] + pr[:, None] * pr[None, :]
+    im = pi[:, None] * pr[None, :] * (-1.0) + pr[:, None] * pi[None, :]
+    return jnp.stack([re.reshape(-1), im.reshape(-1)])
+
+
+@partial(jax.jit, static_argnames=("num_qubits", "dtype"))
+def densmatr_classical_state(num_qubits: int, state_ind, dtype) -> jax.Array:
+    """Ref: densmatr_initClassicalState (QuEST_cpu.c:1115) — ρ = |s><s|."""
+    dim = 1 << num_qubits
+    ind = state_ind * dim + state_ind
+    return jnp.zeros((2, dim * dim), dtype=dtype).at[0, ind].set(1.0)
+
+
+@partial(jax.jit, static_argnames=("num_qubits", "dtype"))
+def densmatr_plus_state(num_qubits: int, dtype) -> jax.Array:
+    """Ref: densmatr_initPlusState (QuEST_cpu.c:1154) — every element 2^-N."""
+    dim = 1 << num_qubits
+    re = jnp.full((dim * dim,), 1.0 / dim, dtype=dtype)
+    return jnp.stack([re, jnp.zeros_like(re)])
+
+
+@jax.jit
+def weighted_qureg(fac1, state1, fac2, state2, fac_out, state_out) -> jax.Array:
+    """Ref: setWeightedQureg (QuEST_cpu.c:3619): out = f1·q1 + f2·q2 + fo·out.
+    Factors are (re, im) pairs of shape (2,)."""
+    def term(f, s):
+        fr, fi = f[0].astype(s.dtype), f[1].astype(s.dtype)
+        return jnp.stack([fr * s[0] - fi * s[1], fr * s[1] + fi * s[0]])
+    return term(fac1, state1) + term(fac2, state2) + term(fac_out, state_out)

@@ -1,0 +1,88 @@
+"""Runtime precision configuration.
+
+The reference (QuEST) fixes precision at *compile* time via the ``QuEST_PREC``
+CMake flag selecting ``qreal`` = float / double / long double
+(ref: QuEST/include/QuEST_precision.h:28-68).  On TPU the idiomatic equivalent
+is a *runtime* dtype choice: precision 1 -> float32/complex64 (native TPU
+width, fast path), precision 2 -> float64/complex128 (XLA-emulated f64 on TPU,
+bit-comparable with the CPU reference).  Long-double (precision 4) has no TPU
+equivalent and maps to precision 2.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+# complex128 support requires x64 mode; enable it once at import.  float32
+# quregs are still first-class (dtype is per-Qureg), x64 only widens what JAX
+# *allows*, not what we allocate.
+jax.config.update("jax_enable_x64", True)
+
+# REAL_EPS per precision (ref: QuEST_precision.h:35,49,64)
+_REAL_EPS = {1: 1e-5, 2: 1e-13, 4: 1e-14}
+
+_DEFAULT_PRECISION = int(os.environ.get("QUEST_TPU_PRECISION", "2"))
+
+
+class PrecisionConfig:
+    """Mutable global default precision; per-Qureg dtype can override."""
+
+    def __init__(self, precision: int = _DEFAULT_PRECISION):
+        self.set(precision)
+
+    def set(self, precision: int) -> None:
+        if precision not in (1, 2, 4):
+            raise ValueError(f"precision must be 1, 2 or 4, got {precision}")
+        self.precision = precision
+        self.real_eps = _REAL_EPS[precision]
+        if precision == 1:
+            self.real_dtype = jnp.float32
+            self.complex_dtype = jnp.complex64
+        else:
+            self.real_dtype = jnp.float64
+            self.complex_dtype = jnp.complex128
+
+
+CONFIG = PrecisionConfig()
+
+
+def set_precision(precision: int) -> None:
+    """Set the global default precision for newly created Quregs."""
+    CONFIG.set(precision)
+
+
+def get_precision() -> int:
+    return CONFIG.precision
+
+
+def real_eps(dtype=None) -> float:
+    """Numerical tolerance for the given real/complex dtype (default: global)."""
+    if dtype is None:
+        return CONFIG.real_eps
+    dtype = jnp.dtype(dtype)
+    if dtype in (jnp.dtype(jnp.float32), jnp.dtype(jnp.complex64)):
+        return _REAL_EPS[1]
+    return _REAL_EPS[2]
+
+
+def complex_dtype_for(precision: int):
+    return jnp.complex64 if precision == 1 else jnp.complex128
+
+
+def real_dtype_of(complex_dtype):
+    return jnp.float32 if jnp.dtype(complex_dtype) == jnp.dtype(jnp.complex64) else jnp.float64
+
+
+def storage_dtype(dtype):
+    """Map any requested dtype to the SoA real storage dtype.
+
+    TPU XLA rejects complex element types at program boundaries, so amplitude
+    arrays are stored as (re, im) real pairs; complex dtype requests map to
+    the matching real width."""
+    dtype = jnp.dtype(dtype)
+    if dtype in (jnp.dtype(jnp.complex64), jnp.dtype(jnp.float32)):
+        return jnp.dtype(jnp.float32)
+    return jnp.dtype(jnp.float64)

@@ -1,0 +1,155 @@
+"""OpenQASM 2.0 circuit logger.
+
+Ref analogue: QuEST/src/QuEST_qasm.{h,c} — a growable text buffer per Qureg
+recording every API call as QASM or a structured comment.  A Python list of
+lines replaces the realloc'd char buffer; gate labels and the header format
+match the reference's output (qasm.c:38-53, :61-84) so downstream tooling
+reads either."""
+
+from __future__ import annotations
+
+import cmath
+import math
+
+QUREG_LABEL = "q"
+MESREG_LABEL = "c"
+COMMENT_PREF = "//"
+
+GATE_LABELS = {
+    "sigma_x": "x",
+    "sigma_y": "y",
+    "sigma_z": "z",
+    "t": "t",
+    "s": "s",
+    "hadamard": "h",
+    "rotate_x": "Rx",
+    "rotate_y": "Ry",
+    "rotate_z": "Rz",
+    "unitary": "U",
+    "phase_shift": "Rz",
+    "swap": "swap",
+    "sqrt_swap": "sqrtswap",
+}
+
+
+class QASMLogger:
+    """Ref analogue: QASMLogger struct (QuEST.h:62-69)."""
+
+    def __init__(self, num_qubits: int):
+        self.num_qubits = num_qubits
+        self.is_logging = False
+        self.lines: list[str] = []
+        self._header = (f"OPENQASM 2.0;\nqreg {QUREG_LABEL}[{num_qubits}];\n"
+                        f"creg {MESREG_LABEL}[{num_qubits}];\n")
+
+    def clone(self) -> "QASMLogger":
+        c = QASMLogger(self.num_qubits)
+        c.is_logging = self.is_logging
+        c.lines = list(self.lines)
+        return c
+
+    # --- recording ---------------------------------------------------------
+    def _add(self, line: str) -> None:
+        if self.is_logging:
+            self.lines.append(line)
+
+    def record_gate(self, gate: str, controls, target: int, params=()) -> None:
+        if not self.is_logging:
+            return
+        label = GATE_LABELS.get(gate, gate)
+        ctrl_pref = "c" * len(controls)
+        if params:
+            pstr = "(" + ",".join(_fmt_real(p) for p in params) + ")"
+        else:
+            pstr = ""
+        qubits = [f"{QUREG_LABEL}[{c}]" for c in controls] + [f"{QUREG_LABEL}[{target}]"]
+        self._add(f"{ctrl_pref}{label}{pstr} {','.join(qubits)};\n")
+
+    def record_param_gate(self, gate: str, controls, target: int, *params) -> None:
+        self.record_gate(gate, controls, target, params)
+
+    def record_compact_unitary(self, alpha: complex, beta: complex,
+                               controls, target: int) -> None:
+        if not self.is_logging:
+            return
+        rz2, ry, rz1, _ = _zyz_from_compact(alpha, beta)
+        self.record_gate("rotate_z", controls, target, (rz2,))
+        self.record_gate("rotate_y", controls, target, (ry,))
+        self.record_gate("rotate_z", controls, target, (rz1,))
+
+    def record_unitary(self, u, controls, target: int) -> None:
+        if not self.is_logging:
+            return
+        rz2, ry, rz1, phase = _zyz_from_unitary(u)
+        self.record_gate("rotate_z", controls, target, (rz2,))
+        self.record_gate("rotate_y", controls, target, (ry,))
+        self.record_gate("rotate_z", controls, target, (rz1,))
+        if abs(phase) > 1e-12 and not controls:
+            self.record_comment(f"Here, the matrix had a global phase of {_fmt_real(phase)}")
+
+    def record_measurement(self, qubit: int) -> None:
+        self._add(f"measure {QUREG_LABEL}[{qubit}] -> {MESREG_LABEL}[{qubit}];\n")
+
+    def record_init_zero(self) -> None:
+        if not self.is_logging:
+            return
+        for q in range(self.num_qubits):
+            self._add(f"reset {QUREG_LABEL}[{q}];\n")
+
+    def record_init_plus(self) -> None:
+        if not self.is_logging:
+            return
+        self.record_init_zero()
+        for q in range(self.num_qubits):
+            self._add(f"h {QUREG_LABEL}[{q}];\n")
+
+    def record_init_classical(self, state_ind: int) -> None:
+        if not self.is_logging:
+            return
+        self.record_init_zero()
+        for q in range(self.num_qubits):
+            if (state_ind >> q) & 1:
+                self._add(f"x {QUREG_LABEL}[{q}];\n")
+
+    def record_comment(self, comment: str) -> None:
+        self._add(f"{COMMENT_PREF} {comment}\n")
+
+    # --- retrieval ---------------------------------------------------------
+    def recorded(self) -> str:
+        return self._header + "".join(self.lines)
+
+    def clear(self) -> None:
+        self.lines = []
+
+    def print(self) -> None:
+        print(self.recorded(), end="")
+
+    def write_to_file(self, filename: str) -> None:
+        with open(filename, "w") as f:
+            f.write(self.recorded())
+
+
+def _fmt_real(x: float) -> str:
+    return f"{float(x):g}"
+
+
+def _zyz_from_compact(alpha: complex, beta: complex):
+    """ZYZ Euler angles of the compact unitary [[a, -b*], [b, a*]]
+    (ref analogue: getZYZRotAnglesFromComplexPair, QuEST_common.c)."""
+    a, b = complex(alpha), complex(beta)
+    ry = 2 * math.acos(min(1.0, abs(a)))
+    rz1 = cmath.phase(a) + cmath.phase(b) if abs(b) > 1e-15 else 2 * cmath.phase(a)
+    rz2 = cmath.phase(a) - cmath.phase(b) if abs(b) > 1e-15 else 0.0
+    return rz2, ry, rz1, 0.0
+
+
+def _zyz_from_unitary(u):
+    """Factor a general 2x2 unitary as e^{iφ} Rz(rz1)·Ry(ry)·Rz(rz2)."""
+    import numpy as np
+    m = np.asarray(u, dtype=complex).reshape(2, 2)
+    det = m[0, 0] * m[1, 1] - m[0, 1] * m[1, 0]
+    phase = cmath.phase(det) / 2
+    su = m * cmath.exp(-1j * phase)
+    # su = [[a, -b*],[b, a*]]
+    rz2, ry, rz1, _ = _zyz_from_compact(su[0, 0], su[1, 0])
+    return rz2, ry, rz1, phase

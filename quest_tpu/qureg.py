@@ -1,0 +1,103 @@
+"""The Qureg: a quantum register backed by a (possibly sharded) jax.Array.
+
+Ref analogue: struct Qureg (QuEST.h:203-234).  Differences by design:
+- amplitudes are one (2, 2^n) real jax.Array — the reference's SoA re/im
+  layout, but as a single stacked array (TPU XLA rejects complex element
+  types at program boundaries; see ops/apply.py);
+- there is no pairStateVec: the reference needs a same-size receive buffer for
+  every MPI exchange (2x memory, ref QuEST_cpu.c:1292-1295); GSPMD's
+  collective-permute streams shards without a user-visible mirror;
+- chunkId/numChunks disappear: a sharded jax.Array carries its own layout.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .environment import QuESTEnv
+from .precision import CONFIG, storage_dtype
+from .qasm import QASMLogger
+from .validation import validate_create_num_qubits
+
+
+class Qureg:
+    """Mutable shell over an immutable amplitude array (functional core,
+    imperative surface — the QuEST API mutates, jnp does not).
+
+    ``amps`` has shape (2, 2^n): stacked (re, im) real parts — see
+    ops/apply.py for why complex dtypes are avoided on TPU."""
+
+    def __init__(self, num_qubits: int, env: QuESTEnv,
+                 is_density_matrix: bool = False, dtype=None):
+        self.num_qubits_represented = num_qubits
+        self.is_density_matrix = is_density_matrix
+        self.num_qubits_in_state_vec = num_qubits * (2 if is_density_matrix else 1)
+        self.env = env
+        self.dtype = storage_dtype(dtype if dtype is not None else CONFIG.real_dtype)
+        self.amps: jax.Array | None = None
+        self.qasm = QASMLogger(num_qubits)
+
+    # --- ref-compatible aliases -------------------------------------------
+    @property
+    def num_amps_total(self) -> int:
+        return 1 << self.num_qubits_in_state_vec
+
+    @property
+    def numQubitsRepresented(self) -> int:
+        return self.num_qubits_represented
+
+    @property
+    def isDensityMatrix(self) -> bool:
+        return self.is_density_matrix
+
+    # --- amplitude management ---------------------------------------------
+    def set_amps_array(self, amps: jax.Array) -> None:
+        """Install a new amplitude array, preserving the Qureg's sharding."""
+        if self.env is not None and self.env.sharding is not None:
+            if amps.sharding != self.env.sharding:
+                amps = jax.device_put(amps, self.env.sharding)
+        self.amps = amps
+
+    def sharded(self, amps: jax.Array) -> jax.Array:
+        if self.env is not None and self.env.sharding is not None:
+            return jax.device_put(amps, self.env.sharding)
+        return amps
+
+    def __repr__(self) -> str:
+        kind = "density-matrix" if self.is_density_matrix else "state-vector"
+        return (f"Qureg({kind}, qubits={self.num_qubits_represented}, "
+                f"amps=2^{self.num_qubits_in_state_vec}, dtype={self.dtype}, "
+                f"devices={self.env.num_ranks if self.env else 1})")
+
+
+def create_qureg(num_qubits: int, env: QuESTEnv, dtype=None) -> Qureg:
+    """Ref analogue: createQureg (QuEST.c:36-48) — statevector in |0..0>."""
+    validate_create_num_qubits(num_qubits, env, "createQureg")
+    from .ops import init as init_ops
+    q = Qureg(num_qubits, env, is_density_matrix=False, dtype=dtype)
+    q.set_amps_array(init_ops.zero_state(q.num_amps_total, q.dtype))
+    return q
+
+
+def create_density_qureg(num_qubits: int, env: QuESTEnv, dtype=None) -> Qureg:
+    """Ref analogue: createDensityQureg (QuEST.c:50-62) — ρ = |0..0><0..0|."""
+    validate_create_num_qubits(num_qubits, env, "createDensityQureg")
+    from .ops import init as init_ops
+    q = Qureg(num_qubits, env, is_density_matrix=True, dtype=dtype)
+    q.set_amps_array(init_ops.zero_state(q.num_amps_total, q.dtype))
+    return q
+
+
+def create_clone_qureg(qureg: Qureg, env: QuESTEnv) -> Qureg:
+    """Ref analogue: createCloneQureg (QuEST.c)."""
+    q = Qureg(qureg.num_qubits_represented, env,
+              is_density_matrix=qureg.is_density_matrix, dtype=qureg.dtype)
+    q.set_amps_array(qureg.amps)
+    q.qasm = qureg.qasm.clone()
+    return q
+
+
+def destroy_qureg(qureg: Qureg, env: QuESTEnv | None = None) -> None:
+    """Ref analogue: destroyQureg — drop the device buffer eagerly."""
+    qureg.amps = None

@@ -1,0 +1,109 @@
+"""Host-side RNG with exact MT19937 stream parity to the reference.
+
+The reference seeds a Mersenne-Twister (mt19937ar) with ``init_by_array`` and
+draws measurement outcomes with ``genrand_real1`` (ref: QuEST/src/mt19937ar.c,
+QuEST_common.c:155-170).  Reproducing the identical outcome stream requires the
+same generator, same seeding, and same draw points, so we implement the
+standard MT19937 algorithm here (it is a public, well-specified algorithm).
+
+Measurement is inherently a host round-trip (data-dependent collapse), so a
+host-side Python generator costs nothing extra on TPU.  Batched stochastic
+workloads should use ``jax.random`` instead; this generator exists for
+reference-parity of ``measure()``/``seedQuEST()`` semantics.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+_N = 624
+_M = 397
+_MATRIX_A = 0x9908B0DF
+_UPPER_MASK = 0x80000000
+_LOWER_MASK = 0x7FFFFFFF
+_U32 = 0xFFFFFFFF
+
+
+class MT19937:
+    """The standard 32-bit Mersenne Twister (mt19937ar variant)."""
+
+    def __init__(self) -> None:
+        self.mt = [0] * _N
+        self.mti = _N + 1
+
+    def init_genrand(self, s: int) -> None:
+        self.mt[0] = s & _U32
+        for i in range(1, _N):
+            self.mt[i] = (1812433253 * (self.mt[i - 1] ^ (self.mt[i - 1] >> 30)) + i) & _U32
+        self.mti = _N
+
+    def init_by_array(self, init_key) -> None:
+        self.init_genrand(19650218)
+        i, j = 1, 0
+        k = max(_N, len(init_key))
+        for _ in range(k):
+            self.mt[i] = ((self.mt[i] ^ ((self.mt[i - 1] ^ (self.mt[i - 1] >> 30)) * 1664525))
+                          + init_key[j] + j) & _U32
+            i += 1
+            j += 1
+            if i >= _N:
+                self.mt[0] = self.mt[_N - 1]
+                i = 1
+            if j >= len(init_key):
+                j = 0
+        for _ in range(_N - 1):
+            self.mt[i] = ((self.mt[i] ^ ((self.mt[i - 1] ^ (self.mt[i - 1] >> 30)) * 1566083941))
+                          - i) & _U32
+            i += 1
+            if i >= _N:
+                self.mt[0] = self.mt[_N - 1]
+                i = 1
+        self.mt[0] = 0x80000000
+
+    def genrand_int32(self) -> int:
+        if self.mti >= _N:
+            if self.mti == _N + 1:  # never seeded
+                self.init_genrand(5489)
+            mt = self.mt
+            for kk in range(_N - _M):
+                y = (mt[kk] & _UPPER_MASK) | (mt[kk + 1] & _LOWER_MASK)
+                mt[kk] = mt[kk + _M] ^ (y >> 1) ^ (_MATRIX_A if y & 1 else 0)
+            for kk in range(_N - _M, _N - 1):
+                y = (mt[kk] & _UPPER_MASK) | (mt[kk + 1] & _LOWER_MASK)
+                mt[kk] = mt[kk + (_M - _N)] ^ (y >> 1) ^ (_MATRIX_A if y & 1 else 0)
+            y = (mt[_N - 1] & _UPPER_MASK) | (mt[0] & _LOWER_MASK)
+            mt[_N - 1] = mt[_M - 1] ^ (y >> 1) ^ (_MATRIX_A if y & 1 else 0)
+            self.mti = 0
+        y = self.mt[self.mti]
+        self.mti += 1
+        y ^= y >> 11
+        y ^= (y << 7) & 0x9D2C5680
+        y ^= (y << 15) & 0xEFC60000
+        y ^= y >> 18
+        return y & _U32
+
+    def genrand_real1(self) -> float:
+        """Uniform on [0,1] with 32-bit resolution (matches reference draws)."""
+        return self.genrand_int32() * (1.0 / 4294967295.0)
+
+
+# The process-global generator, mirroring the reference's single static MT
+# state shared by all Quregs.
+_GLOBAL = MT19937()
+
+
+def seed_quest(seed_array) -> None:
+    """User seeding, ref: seedQuEST (QuEST_common.c:209-214)."""
+    _GLOBAL.init_by_array([int(s) & _U32 for s in seed_array])
+
+
+def seed_quest_default() -> None:
+    """Default seeding by [msec-time, pid], ref: QuEST_common.c:182-204."""
+    msecs = int(time.time() * 1000)
+    pid = os.getpid()
+    seed_quest([msecs, pid])
+
+
+def rand_real1() -> float:
+    return _GLOBAL.genrand_real1()
