@@ -417,8 +417,7 @@ def rotateAroundAxis(qureg: Qureg, target: int, angle: float, axis) -> None:
     V.validate_target(qureg, target, "rotateAroundAxis")
     V.validate_vector(axis, "rotateAroundAxis")
     _apply_unitary(qureg, _rotation_matrix(angle, axis), _ts(target))
-    qureg.qasm.record_comment(
-        f"Here, an undisclosed axis rotation of angle {angle:g} was applied to qubit {int(target)}")
+    qureg.qasm.record_axis_rotation(angle, axis, (), int(target))
 
 
 def controlledRotateX(qureg: Qureg, control: int, target: int, angle: float) -> None:
@@ -444,8 +443,7 @@ def controlledRotateAroundAxis(qureg: Qureg, control: int, target: int,
     V.validate_control_target(qureg, control, target, "controlledRotateAroundAxis")
     V.validate_vector(axis, "controlledRotateAroundAxis")
     _apply_unitary(qureg, _rotation_matrix(angle, axis), _ts(target), _ts(control))
-    qureg.qasm.record_comment(
-        f"Here, an undisclosed controlled axis rotation was applied to qubit {int(target)}")
+    qureg.qasm.record_axis_rotation(angle, axis, _ts(control), int(target))
 
 
 def controlledCompactUnitary(qureg: Qureg, control: int, target: int, alpha, beta) -> None:
@@ -813,14 +811,15 @@ def multiControlledMultiQubitUnitary(qureg: Qureg, ctrls, num_ctrls=None,
 
 def applyMatrix2(qureg: Qureg, target: int, u) -> None:
     V.validate_target(qureg, target, "applyMatrix2")
-    qureg.amps = _ap.apply_matrix(qureg.amps, as_matrix(u, 1), _ts(target))
+    qureg.amps = _ap.apply_matrix(qureg.amps, _ap.mat_pair(as_matrix(u, 1)), _ts(target))
     qureg.qasm.record_comment("Here, an undisclosed 2-by-2 matrix was applied.")
 
 
 def applyMatrix4(qureg: Qureg, t1: int, t2: int, u) -> None:
     V.validate_unique_targets(qureg, t1, t2, "applyMatrix4")
     V.validate_multi_qubit_matrix_fits_in_shard(qureg, 2, "applyMatrix4")
-    qureg.amps = _ap.apply_matrix(qureg.amps, as_matrix(u, 2), (int(t1), int(t2)))
+    qureg.amps = _ap.apply_matrix(qureg.amps, _ap.mat_pair(as_matrix(u, 2)),
+                                  (int(t1), int(t2)))
     qureg.qasm.record_comment("Here, an undisclosed 4-by-4 matrix was applied.")
 
 
@@ -834,7 +833,7 @@ def applyMatrixN(qureg: Qureg, targets, num_targets=None, u=None) -> None:
     u = as_matrix(u, len(targets))
     V.validate_multi_qubit_matrix_size(u, len(targets), "applyMatrixN")
     V.validate_multi_qubit_matrix_fits_in_shard(qureg, len(targets), "applyMatrixN")
-    qureg.amps = _ap.apply_matrix(qureg.amps, u, targets)
+    qureg.amps = _ap.apply_matrix(qureg.amps, _ap.mat_pair(u), targets)
     qureg.qasm.record_comment("Here, an undisclosed matrix was applied.")
 
 
@@ -853,7 +852,7 @@ def applyMultiControlledMatrixN(qureg: Qureg, ctrls, num_ctrls=None, targets=Non
     V.validate_multi_qubit_matrix_size(u, len(targets), "applyMultiControlledMatrixN")
     V.validate_multi_qubit_matrix_fits_in_shard(qureg, len(targets),
                                                 "applyMultiControlledMatrixN")
-    qureg.amps = _ap.apply_matrix(qureg.amps, u, targets, ctrls)
+    qureg.amps = _ap.apply_matrix(qureg.amps, _ap.mat_pair(u), targets, ctrls)
     qureg.qasm.record_comment("Here, an undisclosed controlled matrix was applied.")
 
 

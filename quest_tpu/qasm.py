@@ -2,9 +2,10 @@
 
 Ref analogue: QuEST/src/QuEST_qasm.{h,c} — a growable text buffer per Qureg
 recording every API call as QASM or a structured comment.  A Python list of
-lines replaces the realloc'd char buffer; gate labels and the header format
-match the reference's output (qasm.c:38-53, :61-84) so downstream tooling
-reads either."""
+lines replaces the realloc'd char buffer; gate labels, the U-gate ZYZ output,
+and the controlled-gate global-phase fix-ups match the reference's output
+(qasm.c:38-53, :195-300) so downstream tooling reads either.
+"""
 
 from __future__ import annotations
 
@@ -53,39 +54,64 @@ class QASMLogger:
         if self.is_logging:
             self.lines.append(line)
 
+    def _gate_line(self, gate: str, controls, target: int, params=()) -> None:
+        """One '{c*}label(params) q[c],..,q[t];' line — the reference's
+        addGateToQASM format (qasm.c:128-176)."""
+        label = GATE_LABELS.get(gate, gate)
+        ctrl_pref = "c" * len(controls)
+        pstr = ("(" + ",".join(_fmt_real(p) for p in params) + ")") if params else ""
+        qubits = [f"{QUREG_LABEL}[{c}]" for c in controls] + [f"{QUREG_LABEL}[{target}]"]
+        self._add(f"{ctrl_pref}{label}{pstr} {','.join(qubits)};\n")
+
     def record_gate(self, gate: str, controls, target: int, params=()) -> None:
         if not self.is_logging:
             return
-        label = GATE_LABELS.get(gate, gate)
-        ctrl_pref = "c" * len(controls)
-        if params:
-            pstr = "(" + ",".join(_fmt_real(p) for p in params) + ")"
-        else:
-            pstr = ""
-        qubits = [f"{QUREG_LABEL}[{c}]" for c in controls] + [f"{QUREG_LABEL}[{target}]"]
-        self._add(f"{ctrl_pref}{label}{pstr} {','.join(qubits)};\n")
+        self._gate_line(gate, controls, target, params)
+        # controlled phase shifts discard a global phase in QASM's Rz form;
+        # the reference restores it with an uncontrolled Rz(angle/2) on the
+        # target (qasm.c: qasm_recordControlledParamGate / MultiControlled...)
+        if gate == "phase_shift" and controls and params:
+            kind = "controlled" if len(controls) == 1 else "multicontrolled"
+            self.record_comment("Restoring the discarded global phase of the "
+                                f"previous {kind} phase gate")
+            self._gate_line("rotate_z", (), target, (params[0] / 2.0,))
 
     def record_param_gate(self, gate: str, controls, target: int, *params) -> None:
         self.record_gate(gate, controls, target, params)
 
     def record_compact_unitary(self, alpha: complex, beta: complex,
                                controls, target: int) -> None:
+        """One U(rz2, ry, rz1) gate (ref: qasm_recordCompactUnitary)."""
         if not self.is_logging:
             return
-        rz2, ry, rz1, _ = _zyz_from_compact(alpha, beta)
-        self.record_gate("rotate_z", controls, target, (rz2,))
-        self.record_gate("rotate_y", controls, target, (ry,))
-        self.record_gate("rotate_z", controls, target, (rz1,))
+        rz2, ry, rz1 = _zyz_from_compact(alpha, beta)
+        self._gate_line("unitary", controls, target, (rz2, ry, rz1))
 
     def record_unitary(self, u, controls, target: int) -> None:
+        """U(rz2, ry, rz1); when controlled, the matrix's global phase is
+        physical, so append the reference's uncontrolled-Rz fix-up
+        (ref: qasm_recordControlledUnitary, qasm.c:279-300)."""
         if not self.is_logging:
             return
-        rz2, ry, rz1, phase = _zyz_from_unitary(u)
-        self.record_gate("rotate_z", controls, target, (rz2,))
-        self.record_gate("rotate_y", controls, target, (ry,))
-        self.record_gate("rotate_z", controls, target, (rz1,))
-        if abs(phase) > 1e-12 and not controls:
-            self.record_comment(f"Here, the matrix had a global phase of {_fmt_real(phase)}")
+        alpha, beta, phase = _pair_and_phase_from_unitary(u)
+        rz2, ry, rz1 = _zyz_from_compact(alpha, beta)
+        self._gate_line("unitary", controls, target, (rz2, ry, rz1))
+        if controls:
+            self.record_comment("Restoring the discarded global phase of the "
+                                "previous controlled unitary")
+            self._gate_line("rotate_z", (), target, (phase,))
+
+    def record_axis_rotation(self, angle: float, axis, controls, target: int) -> None:
+        """Rotation about an arbitrary axis as a U gate
+        (ref: qasm_recordAxisRotation / qasm_recordControlledAxisRotation)."""
+        if not self.is_logging:
+            return
+        ux, uy, uz = _unit_axis(axis)
+        s = math.sin(angle / 2.0)
+        alpha = complex(math.cos(angle / 2.0), -s * uz)
+        beta = complex(s * uy, -s * ux)
+        rz2, ry, rz1 = _zyz_from_compact(alpha, beta)
+        self._gate_line("unitary", controls, target, (rz2, ry, rz1))
 
     def record_measurement(self, qubit: int) -> None:
         self._add(f"measure {QUREG_LABEL}[{qubit}] -> {MESREG_LABEL}[{qubit}];\n")
@@ -130,26 +156,35 @@ class QASMLogger:
 
 
 def _fmt_real(x: float) -> str:
-    return f"{float(x):g}"
+    return f"{float(x):.14g}"
+
+
+def _unit_axis(axis):
+    ux, uy, uz = (float(a) for a in axis)
+    mag = math.sqrt(ux * ux + uy * uy + uz * uz)
+    return ux / mag, uy / mag, uz / mag
 
 
 def _zyz_from_compact(alpha: complex, beta: complex):
-    """ZYZ Euler angles of the compact unitary [[a, -b*], [b, a*]]
-    (ref analogue: getZYZRotAnglesFromComplexPair, QuEST_common.c)."""
+    """ZYZ Euler angles (rz2, ry, rz1) with
+    U(α, β) = Rz(rz2)·Ry(ry)·Rz(rz1) under Rz(t) = diag(e^{-it/2}, e^{it/2}):
+    ry = 2 acos|α|, rz2 = -arg(α)+arg(β), rz1 = -arg(α)-arg(β)
+    (ref analogue: getZYZRotAnglesFromComplexPair, QuEST_common.c:124-133)."""
     a, b = complex(alpha), complex(beta)
-    ry = 2 * math.acos(min(1.0, abs(a)))
-    rz1 = cmath.phase(a) + cmath.phase(b) if abs(b) > 1e-15 else 2 * cmath.phase(a)
-    rz2 = cmath.phase(a) - cmath.phase(b) if abs(b) > 1e-15 else 0.0
-    return rz2, ry, rz1, 0.0
+    ry = 2.0 * math.acos(min(1.0, abs(a)))
+    alpha_phase = math.atan2(a.imag, a.real)
+    beta_phase = math.atan2(b.imag, b.real)
+    rz2 = -alpha_phase + beta_phase
+    rz1 = -alpha_phase - beta_phase
+    return rz2, ry, rz1
 
 
-def _zyz_from_unitary(u):
-    """Factor a general 2x2 unitary as e^{iφ} Rz(rz1)·Ry(ry)·Rz(rz2)."""
+def _pair_and_phase_from_unitary(u):
+    """Split a 2x2 unitary into exp(iφ)·U(α, β) with φ the mean phase of the
+    diagonal (ref analogue: getComplexPairAndPhaseFromUnitary,
+    QuEST_common.c:136-150)."""
     import numpy as np
     m = np.asarray(u, dtype=complex).reshape(2, 2)
-    det = m[0, 0] * m[1, 1] - m[0, 1] * m[1, 0]
-    phase = cmath.phase(det) / 2
-    su = m * cmath.exp(-1j * phase)
-    # su = [[a, -b*],[b, a*]]
-    rz2, ry, rz1, _ = _zyz_from_compact(su[0, 0], su[1, 0])
-    return rz2, ry, rz1, phase
+    phase = (cmath.phase(m[0, 0]) + cmath.phase(m[1, 1])) / 2.0
+    rot = cmath.exp(-1j * phase)
+    return m[0, 0] * rot, m[1, 0] * rot, phase

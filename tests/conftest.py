@@ -1,0 +1,66 @@
+"""Test configuration: force a deterministic CPU backend with 8 virtual devices.
+
+The reference runs ONE test suite against whichever backend was compiled in
+(serial / OpenMP / MPI / GPU — ref: tests/CMakeLists.txt:6-17).  Here the same
+idea is expressed as a pytest parametrisation: every correctness test runs
+twice, once on a single (unsharded) device and once sharded over an 8-device
+mesh, exercising the GSPMD collective paths the reference exercised with real
+MPI under SLURM (ref: examples/submissionScripts/mpi_SLURM_unit_tests.sh).
+
+The container may boot JAX with a TPU platform plugin pre-registered from
+sitecustomize; tests must nevertheless run on CPU with 8 virtual devices, so
+before any backend is initialised we inject the XLA host-device-count flag and
+switch the platform config to cpu (this works even after plugin registration,
+as long as no backend has been *used* yet).
+"""
+
+from __future__ import annotations
+
+import os
+
+# Must happen before the first jax backend initialisation.
+_FLAGS = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _FLAGS:
+    os.environ["XLA_FLAGS"] = (_FLAGS + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+import quest_tpu as qt  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _precision():
+    qt.set_precision(2)  # float64: matches the reference's default PRECISION=2
+
+
+@pytest.fixture(scope="session")
+def env_local():
+    return qt.createQuESTEnv(1)
+
+
+@pytest.fixture(scope="session")
+def env_dist():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return qt.createQuESTEnv(8)
+
+
+@pytest.fixture(scope="session", params=["local", "dist8"])
+def env(request, env_local):
+    """Backend-parametrized environment: unsharded, and sharded over 8 devices."""
+    if request.param == "local":
+        return env_local
+    return request.getfixturevalue("env_dist")
+
+
+@pytest.fixture(autouse=True)
+def _seed_rng():
+    """Deterministic MT19937 stream per test (ref: seedQuEST semantics)."""
+    qt.seedQuEST([12345, 678], 2)
+    np.random.seed(7)
+    yield

@@ -1,0 +1,206 @@
+"""Analytic linear-algebra oracle for the test suite.
+
+An independent, deliberately unoptimised dense-numpy model of every operation,
+mirroring the role of the reference's QVector/QMatrix utilities
+(ref: tests/utilities.hpp:49-60, getFullOperatorMatrix :273-287,
+applyReferenceOp overloads :403-703): tests apply an operation through
+quest_tpu AND through this oracle and compare all amplitudes.
+
+Conventions (identical to the framework and the reference):
+- qubit q is bit q of the basis index (qubit 0 = least significant);
+- a k-qubit gate matrix has targets[0] as the least significant row bit;
+- a density matrix rho of N qubits is held as rho[r, c], and the flattened
+  Choi vector has element (r, c) at index r + c*2^N.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+NUM_QUBITS = 5  # matches the reference suite (tests/utilities.hpp:36)
+
+# tolerance: tests accept <=10x REAL_EPS like the reference (test_unitaries.cpp:70)
+SV_TOL = 1e-12
+DM_TOL = 1e-11
+
+
+# ---------------------------------------------------------------------------
+# state extraction
+# ---------------------------------------------------------------------------
+
+def sv(qureg) -> np.ndarray:
+    """Complex statevector of a quest_tpu Qureg (gathers shards)."""
+    a = np.asarray(qureg.amps)
+    return a[0] + 1j * a[1]
+
+
+def dm(qureg) -> np.ndarray:
+    """Density matrix rho[r, c] of a density Qureg."""
+    v = sv(qureg)
+    dim = 1 << qureg.num_qubits_represented
+    return v.reshape(dim, dim).T  # flat index r + c*dim -> [r, c]
+
+
+def dm_to_flat(rho: np.ndarray) -> np.ndarray:
+    """Inverse of ``dm``: rho[r, c] -> flattened Choi vector."""
+    return rho.T.reshape(-1)
+
+
+# ---------------------------------------------------------------------------
+# full-operator construction
+# ---------------------------------------------------------------------------
+
+def full_operator(n: int, targets, u, controls=(), control_states=None) -> np.ndarray:
+    """Build the full 2^n x 2^n matrix of a (multi-)controlled k-qubit gate
+    (oracle analogue of getFullOperatorMatrix, ref tests/utilities.hpp:273-287)."""
+    targets = list(targets)
+    controls = list(controls)
+    if control_states is None:
+        control_states = [1] * len(controls)
+    u = np.asarray(u, dtype=complex)
+    dim = 1 << n
+    k = len(targets)
+    op = np.zeros((dim, dim), dtype=complex)
+    for col in range(dim):
+        if all(((col >> c) & 1) == s for c, s in zip(controls, control_states)):
+            in_sub = 0
+            for j, t in enumerate(targets):
+                in_sub |= ((col >> t) & 1) << j
+            rest = col
+            for t in targets:
+                rest &= ~(1 << t)
+            for out_sub in range(1 << k):
+                row = rest
+                for j, t in enumerate(targets):
+                    row |= ((out_sub >> j) & 1) << t
+                op[row, col] = u[out_sub, in_sub]
+        else:
+            op[col, col] = 1.0
+    return op
+
+
+def apply_to_sv(vec: np.ndarray, n, targets, u, controls=(), control_states=None):
+    return full_operator(n, targets, u, controls, control_states) @ vec
+
+
+def apply_to_dm(rho: np.ndarray, n, targets, u, controls=(), control_states=None):
+    """rho -> U rho U^dagger (the reference's density applyReferenceOp)."""
+    op = full_operator(n, targets, u, controls, control_states)
+    return op @ rho @ op.conj().T
+
+
+def left_apply_to_dm(rho: np.ndarray, n, targets, u, controls=()):
+    """rho -> U rho (the reference's applyReferenceMatrix for density inputs)."""
+    return full_operator(n, targets, u, controls) @ rho
+
+
+def apply_channel(rho: np.ndarray, n, targets, kraus_ops) -> np.ndarray:
+    """rho -> sum_i K_i rho K_i^dagger with k-qubit Kraus operators."""
+    out = np.zeros_like(rho)
+    for k in kraus_ops:
+        op = full_operator(n, targets, k)
+        out += op @ rho @ op.conj().T
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fixed matrices
+# ---------------------------------------------------------------------------
+
+I2 = np.eye(2, dtype=complex)
+X = np.array([[0, 1], [1, 0]], dtype=complex)
+Y = np.array([[0, -1j], [1j, 0]], dtype=complex)
+Z = np.array([[1, 0], [0, -1]], dtype=complex)
+H = np.array([[1, 1], [1, -1]], dtype=complex) / np.sqrt(2)
+PAULIS = [I2, X, Y, Z]
+
+
+def rot(axis: np.ndarray, angle: float) -> np.ndarray:
+    """exp(-i angle/2 (axis . sigma)), axis normalised."""
+    axis = np.asarray(axis, dtype=float)
+    axis = axis / np.linalg.norm(axis)
+    g = axis[0] * X + axis[1] * Y + axis[2] * Z
+    return np.cos(angle / 2) * I2 - 1j * np.sin(angle / 2) * g
+
+
+def phase_shift(angle: float) -> np.ndarray:
+    return np.diag([1.0, np.exp(1j * angle)])
+
+
+def pauli_string_matrix(n: int, targets, codes) -> np.ndarray:
+    """Full-space product of single-qubit Paulis at the given targets."""
+    op = np.eye(1 << n, dtype=complex)
+    for t, c in zip(targets, codes):
+        op = full_operator(n, [t], PAULIS[int(c)]) @ op
+    return op
+
+
+def pauli_sum_matrix(n: int, codes: np.ndarray, coeffs) -> np.ndarray:
+    """sum_t coeffs[t] * prod_q pauli(codes[t, q]) on qubit q."""
+    codes = np.asarray(codes).reshape(len(coeffs), n)
+    dim = 1 << n
+    out = np.zeros((dim, dim), dtype=complex)
+    for t, c in enumerate(np.asarray(coeffs, dtype=float)):
+        out += c * pauli_string_matrix(n, range(n), codes[t])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# random fixtures (oracle analogues of tests/utilities.hpp:342-384)
+# ---------------------------------------------------------------------------
+
+def random_unitary(k_qubits: int) -> np.ndarray:
+    """Haar-ish random unitary via QR of a complex Gaussian."""
+    dim = 1 << k_qubits
+    g = np.random.randn(dim, dim) + 1j * np.random.randn(dim, dim)
+    q, r = np.linalg.qr(g)
+    return q * (np.diag(r) / np.abs(np.diag(r)))
+
+
+def random_statevector(n: int) -> np.ndarray:
+    v = np.random.randn(1 << n) + 1j * np.random.randn(1 << n)
+    return v / np.linalg.norm(v)
+
+
+def random_density_matrix(n: int) -> np.ndarray:
+    dim = 1 << n
+    a = np.random.randn(dim, dim) + 1j * np.random.randn(dim, dim)
+    rho = a @ a.conj().T
+    return rho / np.trace(rho)
+
+
+def random_kraus_map(k_qubits: int, num_ops: int) -> list:
+    """A random CPTP map: random matrices normalised so sum K^dag K = I."""
+    dim = 1 << k_qubits
+    mats = [np.random.randn(dim, dim) + 1j * np.random.randn(dim, dim)
+            for _ in range(num_ops)]
+    s = sum(k.conj().T @ k for k in mats)
+    # s is positive-definite; its inverse square root normalises the map
+    w, v = np.linalg.eigh(s)
+    s_inv_sqrt = v @ np.diag(w ** -0.5) @ v.conj().T
+    return [k @ s_inv_sqrt for k in mats]
+
+
+# ---------------------------------------------------------------------------
+# state loading & comparison
+# ---------------------------------------------------------------------------
+
+def set_sv(qureg, vec: np.ndarray) -> None:
+    import quest_tpu as qt
+    qt.initStateFromAmps(qureg, np.real(vec).copy(), np.imag(vec).copy())
+
+
+def set_dm(qureg, rho: np.ndarray) -> None:
+    import quest_tpu as qt
+    flat = dm_to_flat(rho)
+    qt.setDensityAmps(qureg, np.real(flat).copy(), np.imag(flat).copy())
+
+
+def assert_sv(qureg, expected: np.ndarray, tol: float = SV_TOL) -> None:
+    got = sv(qureg)
+    np.testing.assert_allclose(got, expected, atol=tol, rtol=0)
+
+
+def assert_dm(qureg, expected: np.ndarray, tol: float = DM_TOL) -> None:
+    got = dm(qureg)
+    np.testing.assert_allclose(got, expected, atol=tol, rtol=0)

@@ -1,0 +1,396 @@
+"""Unitary-gate correctness vs the analytic oracle, mirroring the reference's
+test_unitaries.cpp (37 TEST_CASEs).  Every test runs on a 5-qubit statevector
+AND a 5-qubit density matrix (debug-state initialised), on an unsharded and an
+8-device-sharded backend (see conftest), comparing all amplitudes within
+10x REAL_EPS — the reference's exact pattern (tests/test_unitaries.cpp:46-89).
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from oracle import (DM_TOL, NUM_QUBITS, H, I2, X, Y, Z, apply_to_dm,
+                    apply_to_sv, assert_dm, assert_sv, dm, full_operator,
+                    phase_shift, random_unitary, rot, sv)
+
+N = NUM_QUBITS
+
+
+def _prepared(env):
+    psi = qt.createQureg(N, env)
+    rho = qt.createDensityQureg(N, env)
+    qt.initDebugState(psi)
+    qt.initDebugState(rho)
+    return psi, rho, sv(psi), dm(rho)
+
+
+def _check(env, apply_quest, targets, u, controls=(), control_states=None):
+    """Apply through quest_tpu and the oracle on both register kinds."""
+    psi, rho, ref_psi, ref_rho = _prepared(env)
+    apply_quest(psi)
+    apply_quest(rho)
+    assert_sv(psi, apply_to_sv(ref_psi, N, targets, u, controls, control_states))
+    assert_dm(rho, apply_to_dm(ref_rho, N, targets, u, controls, control_states))
+
+
+def _all_pairs():
+    return [(a, b) for a in range(N) for b in range(N) if a != b]
+
+
+_SOME_PAIRS = [(0, 1), (1, 0), (0, N - 1), (N - 1, 2), (3, 4)]
+_SOME_TRIPLES = [(0, 1, 2), (4, 1, 3), (2, 4, 0)]
+
+
+# ---------------------------------------------------------------------------
+# single-qubit dense gates
+# ---------------------------------------------------------------------------
+
+def test_compactUnitary(env):
+    alpha, beta = 0.3 - 0.4j, 0.74 + 0.46j
+    norm = np.sqrt(abs(alpha) ** 2 + abs(beta) ** 2)
+    alpha, beta = alpha / norm, beta / norm
+    u = np.array([[alpha, -np.conj(beta)], [beta, np.conj(alpha)]])
+    for t in range(N):
+        _check(env, lambda q, t=t: qt.compactUnitary(q, t, alpha, beta), [t], u)
+    psi = qt.createQureg(N, env)
+    with pytest.raises(qt.QuESTError, match="Invalid target"):
+        qt.compactUnitary(psi, N, alpha, beta)
+    with pytest.raises(qt.QuESTError, match="not unitary"):
+        qt.compactUnitary(psi, 0, 1.0, 1.0)
+
+
+def test_unitary(env):
+    u = random_unitary(1)
+    for t in range(N):
+        _check(env, lambda q, t=t: qt.unitary(q, t, u), [t], u)
+    psi = qt.createQureg(N, env)
+    with pytest.raises(qt.QuESTError, match="Invalid target"):
+        qt.unitary(psi, -1, u)
+    with pytest.raises(qt.QuESTError, match="not unitary"):
+        qt.unitary(psi, 0, u + 1.0)
+
+
+def test_rotateX(env):
+    theta = 0.6
+    for t in range(N):
+        _check(env, lambda q, t=t: qt.rotateX(q, t, theta), [t], rot([1, 0, 0], theta))
+
+
+def test_rotateY(env):
+    theta = -1.2
+    for t in range(N):
+        _check(env, lambda q, t=t: qt.rotateY(q, t, theta), [t], rot([0, 1, 0], theta))
+
+
+def test_rotateZ(env):
+    theta = 2.1
+    for t in range(N):
+        _check(env, lambda q, t=t: qt.rotateZ(q, t, theta), [t], rot([0, 0, 1], theta))
+
+
+def test_rotateAroundAxis(env):
+    theta, axis = 0.9, (1.0, -2.0, 0.5)
+    for t in range(N):
+        _check(env, lambda q, t=t: qt.rotateAroundAxis(q, t, theta, axis),
+               [t], rot(axis, theta))
+    psi = qt.createQureg(N, env)
+    with pytest.raises(qt.QuESTError, match="non-zero"):
+        qt.rotateAroundAxis(psi, 0, theta, (0.0, 0.0, 0.0))
+
+
+def test_pauliX(env):
+    for t in range(N):
+        _check(env, lambda q, t=t: qt.pauliX(q, t), [t], X)
+
+
+def test_pauliY(env):
+    for t in range(N):
+        _check(env, lambda q, t=t: qt.pauliY(q, t), [t], Y)
+
+
+def test_pauliZ(env):
+    for t in range(N):
+        _check(env, lambda q, t=t: qt.pauliZ(q, t), [t], Z)
+
+
+def test_hadamard(env):
+    for t in range(N):
+        _check(env, lambda q, t=t: qt.hadamard(q, t), [t], H)
+
+
+def test_sGate(env):
+    for t in range(N):
+        _check(env, lambda q, t=t: qt.sGate(q, t), [t], np.diag([1, 1j]))
+
+
+def test_tGate(env):
+    for t in range(N):
+        _check(env, lambda q, t=t: qt.tGate(q, t), [t],
+               np.diag([1, np.exp(1j * np.pi / 4)]))
+
+
+def test_phaseShift(env):
+    theta = 0.8
+    for t in range(N):
+        _check(env, lambda q, t=t: qt.phaseShift(q, t, theta), [t], phase_shift(theta))
+
+
+# ---------------------------------------------------------------------------
+# controlled single-qubit gates
+# ---------------------------------------------------------------------------
+
+def test_controlledNot(env):
+    for c, t in _SOME_PAIRS:
+        _check(env, lambda q, c=c, t=t: qt.controlledNot(q, c, t), [t], X, [c])
+    psi = qt.createQureg(N, env)
+    with pytest.raises(qt.QuESTError, match="equal target"):
+        qt.controlledNot(psi, 1, 1)
+    with pytest.raises(qt.QuESTError, match="Invalid control"):
+        qt.controlledNot(psi, N, 0)
+
+
+def test_controlledPauliY(env):
+    for c, t in _SOME_PAIRS:
+        _check(env, lambda q, c=c, t=t: qt.controlledPauliY(q, c, t), [t], Y, [c])
+
+
+def test_controlledPhaseFlip(env):
+    for c, t in _SOME_PAIRS:
+        _check(env, lambda q, c=c, t=t: qt.controlledPhaseFlip(q, c, t), [t], Z, [c])
+
+
+def test_controlledPhaseShift(env):
+    theta = 1.7
+    for c, t in _SOME_PAIRS:
+        _check(env, lambda q, c=c, t=t: qt.controlledPhaseShift(q, c, t, theta),
+               [t], phase_shift(theta), [c])
+
+
+def test_controlledCompactUnitary(env):
+    alpha, beta = (0.6 + 0.1j), (-0.2 + 0.77j)
+    norm = np.sqrt(abs(alpha) ** 2 + abs(beta) ** 2)
+    alpha, beta = alpha / norm, beta / norm
+    u = np.array([[alpha, -np.conj(beta)], [beta, np.conj(alpha)]])
+    for c, t in _SOME_PAIRS:
+        _check(env, lambda q, c=c, t=t: qt.controlledCompactUnitary(q, c, t, alpha, beta),
+               [t], u, [c])
+
+
+def test_controlledUnitary(env):
+    u = random_unitary(1)
+    for c, t in _SOME_PAIRS:
+        _check(env, lambda q, c=c, t=t: qt.controlledUnitary(q, c, t, u), [t], u, [c])
+
+
+def test_controlledRotateX(env):
+    theta = 0.4
+    for c, t in _SOME_PAIRS:
+        _check(env, lambda q, c=c, t=t: qt.controlledRotateX(q, c, t, theta),
+               [t], rot([1, 0, 0], theta), [c])
+
+
+def test_controlledRotateY(env):
+    theta = 1.1
+    for c, t in _SOME_PAIRS:
+        _check(env, lambda q, c=c, t=t: qt.controlledRotateY(q, c, t, theta),
+               [t], rot([0, 1, 0], theta), [c])
+
+
+def test_controlledRotateZ(env):
+    theta = -0.9
+    for c, t in _SOME_PAIRS:
+        _check(env, lambda q, c=c, t=t: qt.controlledRotateZ(q, c, t, theta),
+               [t], rot([0, 0, 1], theta), [c])
+
+
+def test_controlledRotateAroundAxis(env):
+    theta, axis = -2.0, (0.5, 1.0, -1.5)
+    for c, t in _SOME_PAIRS:
+        _check(env,
+               lambda q, c=c, t=t: qt.controlledRotateAroundAxis(q, c, t, theta, axis),
+               [t], rot(axis, theta), [c])
+
+
+def test_multiControlledUnitary(env):
+    u = random_unitary(1)
+    for ctrls, t in [((1,), 0), ((0, 1), 2), ((0, 1, 2, 3), 4), ((4, 2), 0)]:
+        _check(env,
+               lambda q, cs=ctrls, t=t: qt.multiControlledUnitary(q, list(cs), len(cs), t, u),
+               [t], u, list(ctrls))
+    psi = qt.createQureg(N, env)
+    with pytest.raises(qt.QuESTError, match="unique"):
+        qt.multiControlledUnitary(psi, [0, 0], 2, 1, u)
+    with pytest.raises(qt.QuESTError, match="include target"):
+        qt.multiControlledUnitary(psi, [0, 1], 2, 0, u)
+
+
+def test_multiStateControlledUnitary(env):
+    u = random_unitary(1)
+    for ctrls, states, t in [((1,), (0,), 0), ((0, 2), (1, 0), 3),
+                             ((0, 1, 4), (0, 0, 1), 2)]:
+        _check(env,
+               lambda q, cs=ctrls, ss=states, t=t:
+                   qt.multiStateControlledUnitary(q, list(cs), list(ss), len(cs), t, u),
+               [t], u, list(ctrls), list(states))
+
+
+# ---------------------------------------------------------------------------
+# swaps
+# ---------------------------------------------------------------------------
+
+_SWAP = np.array([[1, 0, 0, 0], [0, 0, 1, 0], [0, 1, 0, 0], [0, 0, 0, 1]],
+                 dtype=complex)
+_SQRT_SWAP = np.array([[1, 0, 0, 0],
+                       [0, (1 + 1j) / 2, (1 - 1j) / 2, 0],
+                       [0, (1 - 1j) / 2, (1 + 1j) / 2, 0],
+                       [0, 0, 0, 1]], dtype=complex)
+
+
+def test_swapGate(env):
+    for a, b in _SOME_PAIRS:
+        _check(env, lambda q, a=a, b=b: qt.swapGate(q, a, b), [a, b], _SWAP)
+    psi = qt.createQureg(N, env)
+    with pytest.raises(qt.QuESTError, match="unique"):
+        qt.swapGate(psi, 0, 0)
+
+
+def test_sqrtSwapGate(env):
+    for a, b in _SOME_PAIRS:
+        _check(env, lambda q, a=a, b=b: qt.sqrtSwapGate(q, a, b), [a, b], _SQRT_SWAP)
+
+
+# ---------------------------------------------------------------------------
+# multi-qubit phase gates
+# ---------------------------------------------------------------------------
+
+def test_multiControlledPhaseFlip(env):
+    for qs in [(0, 1), (2, 4, 0), (0, 1, 2, 3, 4)]:
+        # a phase flip on all-1s of the group: diag with -1 at the last entry
+        u = np.eye(1 << len(qs), dtype=complex)
+        u[-1, -1] = -1
+        _check(env, lambda q, qs=qs: qt.multiControlledPhaseFlip(q, list(qs), len(qs)),
+               list(qs), u)
+
+
+def test_multiControlledPhaseShift(env):
+    theta = 0.77
+    for qs in [(0, 1), (1, 3, 4), (0, 1, 2, 3, 4)]:
+        u = np.eye(1 << len(qs), dtype=complex)
+        u[-1, -1] = np.exp(1j * theta)
+        _check(env,
+               lambda q, qs=qs: qt.multiControlledPhaseShift(q, list(qs), len(qs), theta),
+               list(qs), u)
+
+
+def test_multiRotateZ(env):
+    theta = 1.3
+    for qs in [(0,), (0, 1), (1, 3, 4), (0, 1, 2, 3, 4)]:
+        # exp(-i theta/2 Z x..x Z): diagonal phase by parity of the group bits
+        dim = 1 << len(qs)
+        diag = np.array([np.exp(-1j * theta / 2 * (1 - 2 * (bin(i).count("1") % 2)))
+                         for i in range(dim)])
+        _check(env, lambda q, qs=qs: qt.multiRotateZ(q, list(qs), len(qs), theta),
+               list(qs), np.diag(diag))
+
+
+def test_multiRotatePauli(env):
+    theta = 0.67
+    paulis = [I2, X, Y, Z]
+    for qs, codes in [((0,), (1,)), ((0, 2), (2, 3)), ((1, 3, 4), (1, 2, 3)),
+                      ((0, 1, 2), (3, 3, 1))]:
+        # exp(-i theta/2 sigma_1 x .. x sigma_k), with codes[j] acting on qs[j]
+        op = np.array([[1.0]], dtype=complex)
+        for c in reversed(codes):  # qs[0] = least significant row bit
+            op = np.kron(op, paulis[c])
+        u = (np.cos(theta / 2) * np.eye(1 << len(qs))
+             - 1j * np.sin(theta / 2) * op)
+        _check(env,
+               lambda q, qs=qs, cs=codes: qt.multiRotatePauli(q, list(qs), list(cs),
+                                                              len(qs), theta),
+               list(qs), u)
+
+
+# ---------------------------------------------------------------------------
+# multi-qubit dense gates
+# ---------------------------------------------------------------------------
+
+def test_twoQubitUnitary(env):
+    u = random_unitary(2)
+    for t1, t2 in _SOME_PAIRS:
+        _check(env, lambda q, a=t1, b=t2: qt.twoQubitUnitary(q, a, b, u), [t1, t2], u)
+    psi = qt.createQureg(N, env)
+    with pytest.raises(qt.QuESTError, match="not unitary"):
+        qt.twoQubitUnitary(psi, 0, 1, np.ones((4, 4)))
+
+
+def test_controlledTwoQubitUnitary(env):
+    u = random_unitary(2)
+    for c, (t1, t2) in [(4, (0, 1)), (0, (1, 2)), (2, (3, 0))]:
+        _check(env, lambda q, c=c, a=t1, b=t2: qt.controlledTwoQubitUnitary(q, c, a, b, u),
+               [t1, t2], u, [c])
+
+
+def test_multiControlledTwoQubitUnitary(env):
+    u = random_unitary(2)
+    for cs, (t1, t2) in [((4,), (0, 1)), ((0, 1), (2, 3)), ((2, 3, 4), (0, 1))]:
+        _check(env,
+               lambda q, cs=cs, a=t1, b=t2:
+                   qt.multiControlledTwoQubitUnitary(q, list(cs), len(cs), a, b, u),
+               [t1, t2], u, list(cs))
+
+
+def _max_dense_targets(env):
+    """Like the reference, dense-matrix batches must fit in one device's shard
+    (ref: validateMultiQubitMatrixFitsInNode, QuEST_validation.c:437)."""
+    shard_amps = (1 << N) // env.num_ranks
+    return shard_amps.bit_length() - 1
+
+
+def test_multiQubitUnitary(env):
+    kmax = _max_dense_targets(env)
+    for targs in [(0,), (0, 1), (2, 0, 4), (1, 3, 4, 0)]:
+        if len(targs) > kmax:
+            continue
+        u = random_unitary(len(targs))
+        _check(env, lambda q, ts=targs, u=u: qt.multiQubitUnitary(q, list(ts), len(ts), u),
+               list(targs), u)
+    psi = qt.createQureg(N, env)
+    with pytest.raises(qt.QuESTError, match="unique"):
+        qt.multiQubitUnitary(psi, [0, 0], 2, random_unitary(2))
+    if kmax < N:
+        with pytest.raises(qt.QuESTError, match="cannot fit"):
+            qt.multiQubitUnitary(psi, list(range(kmax + 1)), kmax + 1,
+                                 random_unitary(kmax + 1))
+
+
+def test_controlledMultiQubitUnitary(env):
+    kmax = _max_dense_targets(env)
+    for c, targs in [(4, (0, 1)), (0, (2, 3, 4)), (1, (0,))]:
+        if len(targs) > kmax:
+            continue
+        u = random_unitary(len(targs))
+        _check(env,
+               lambda q, c=c, ts=targs, u=u:
+                   qt.controlledMultiQubitUnitary(q, c, list(ts), len(ts), u),
+               list(targs), u, [c])
+
+
+def test_multiControlledMultiQubitUnitary(env):
+    kmax = _max_dense_targets(env)
+    for cs, targs in [((4,), (0, 1)), ((0, 1), (2, 3)), ((1, 2, 4), (0, 3)),
+                      ((0,), (1, 2, 3))]:
+        if len(targs) > kmax:
+            continue
+        u = random_unitary(len(targs))
+        _check(env,
+               lambda q, cs=cs, ts=targs, u=u:
+                   qt.multiControlledMultiQubitUnitary(q, list(cs), len(cs),
+                                                       list(ts), len(ts), u),
+               list(targs), u, list(cs))
+    psi = qt.createQureg(N, env)
+    with pytest.raises(qt.QuESTError, match="disjoint"):
+        qt.multiControlledMultiQubitUnitary(psi, [0, 1], 2, [1, 2], 2, random_unitary(2))
