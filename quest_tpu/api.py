@@ -98,6 +98,7 @@ __all__ = [
     # types
     "Qureg", "QuESTEnv", "Complex", "ComplexMatrix2", "ComplexMatrix4",
     "Vector", "PauliHamil", "DiagonalOp", "PauliOpType", "QuESTError",
+    "fromComplex", "toComplex", "getStaticComplexMatrixN",
 ]
 
 
@@ -194,6 +195,24 @@ setDiagonalOpElems = set_diagonal_op_elems
 
 def destroyComplexMatrixN(m) -> None:
     """Ref parity only — ndarray lifetime is GC-managed."""
+
+
+def fromComplex(c) -> complex:
+    """Ref analogue: fromComplex macro (QuEST_complex.h) — Complex -> qcomp."""
+    return complex(c)
+
+
+def toComplex(c) -> complex:
+    """Ref analogue: toComplex macro (QuEST_complex.h) — qcomp -> Complex."""
+    return complex(c)
+
+
+def getStaticComplexMatrixN(real, imag) -> np.ndarray:
+    """Ref analogue: getStaticComplexMatrixN macro (QuEST.h) — build a
+    ComplexMatrixN from nested real/imag lists without explicit create/destroy."""
+    r = np.asarray(real, dtype=np.float64)
+    i = np.asarray(imag, dtype=np.float64)
+    return r + 1j * i
 
 
 def seedQuEST(seed_array, num_seeds: int | None = None):

@@ -1,0 +1,208 @@
+"""Scalar calculations & amplitude access, mirroring the reference's
+test_calculations.cpp (18 TEST_CASEs)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from oracle import (NUM_QUBITS, pauli_string_matrix, pauli_sum_matrix,
+                    random_density_matrix, random_statevector, set_dm, set_sv)
+
+N = NUM_QUBITS
+DIM = 1 << N
+
+
+@pytest.fixture
+def loaded(env):
+    vec = random_statevector(N)
+    rho = random_density_matrix(N)
+    psi = qt.createQureg(N, env)
+    dq = qt.createDensityQureg(N, env)
+    set_sv(psi, vec)
+    set_dm(dq, rho)
+    return psi, dq, vec, rho
+
+
+def test_calcTotalProb(env, loaded):
+    psi, dq, vec, rho = loaded
+    assert qt.calcTotalProb(psi) == pytest.approx(1.0, abs=1e-12)
+    assert qt.calcTotalProb(dq) == pytest.approx(1.0, abs=1e-12)
+    qt.initBlankState(psi)
+    assert qt.calcTotalProb(psi) == pytest.approx(0.0, abs=1e-12)
+
+
+def test_calcProbOfOutcome(env, loaded):
+    psi, dq, vec, rho = loaded
+    for t in range(N):
+        mask = np.array([((i >> t) & 1) for i in range(DIM)])
+        p1 = float(np.sum(np.abs(vec) ** 2 * mask))
+        assert qt.calcProbOfOutcome(psi, t, 1) == pytest.approx(p1, abs=1e-12)
+        assert qt.calcProbOfOutcome(psi, t, 0) == pytest.approx(1 - p1, abs=1e-12)
+        p1d = float(np.real(np.sum(np.diag(rho) * mask)))
+        assert qt.calcProbOfOutcome(dq, t, 1) == pytest.approx(p1d, abs=1e-12)
+        assert qt.calcProbOfOutcome(dq, t, 0) == pytest.approx(1 - p1d, abs=1e-12)
+    with pytest.raises(qt.QuESTError, match="Invalid measurement outcome"):
+        qt.calcProbOfOutcome(psi, 0, 3)
+
+
+def test_calcInnerProduct(env):
+    v1, v2 = random_statevector(N), random_statevector(N)
+    q1, q2 = qt.createQureg(N, env), qt.createQureg(N, env)
+    set_sv(q1, v1)
+    set_sv(q2, v2)
+    expected = np.vdot(v1, v2)  # <q1|q2>
+    got = qt.calcInnerProduct(q1, q2)
+    assert got == pytest.approx(expected, abs=1e-12)
+    rho = qt.createDensityQureg(N, env)
+    with pytest.raises(qt.QuESTError, match="state-vector"):
+        qt.calcInnerProduct(q1, rho)
+
+
+def test_calcDensityInnerProduct(env):
+    r1, r2 = random_density_matrix(N), random_density_matrix(N)
+    d1, d2 = qt.createDensityQureg(N, env), qt.createDensityQureg(N, env)
+    set_dm(d1, r1)
+    set_dm(d2, r2)
+    expected = float(np.real(np.trace(r1.conj().T @ r2)))
+    assert qt.calcDensityInnerProduct(d1, d2) == pytest.approx(expected, abs=1e-12)
+
+
+def test_calcPurity(env, loaded):
+    psi, dq, vec, rho = loaded
+    expected = float(np.real(np.trace(rho @ rho)))
+    assert qt.calcPurity(dq) == pytest.approx(expected, abs=1e-12)
+    with pytest.raises(qt.QuESTError, match="density matrices"):
+        qt.calcPurity(psi)
+
+
+def test_calcFidelity(env, loaded):
+    psi, dq, vec, rho = loaded
+    pure_vec = random_statevector(N)
+    pure = qt.createQureg(N, env)
+    set_sv(pure, pure_vec)
+    # statevector fidelity |<pure|psi>|^2
+    expected_sv = float(np.abs(np.vdot(pure_vec, vec)) ** 2)
+    assert qt.calcFidelity(psi, pure) == pytest.approx(expected_sv, abs=1e-12)
+    # density fidelity <pure|rho|pure>
+    expected_dm = float(np.real(np.vdot(pure_vec, rho @ pure_vec)))
+    assert qt.calcFidelity(dq, pure) == pytest.approx(expected_dm, abs=1e-12)
+    with pytest.raises(qt.QuESTError, match="state-vector"):
+        qt.calcFidelity(psi, dq)
+
+
+def test_calcHilbertSchmidtDistance(env):
+    r1, r2 = random_density_matrix(N), random_density_matrix(N)
+    d1, d2 = qt.createDensityQureg(N, env), qt.createDensityQureg(N, env)
+    set_dm(d1, r1)
+    set_dm(d2, r2)
+    expected = float(np.sqrt(np.sum(np.abs(r1 - r2) ** 2)))
+    assert qt.calcHilbertSchmidtDistance(d1, d2) == pytest.approx(expected, abs=1e-10)
+
+
+def test_calcExpecPauliProd(env, loaded):
+    psi, dq, vec, rho = loaded
+    work = qt.createQureg(N, env)
+    workd = qt.createDensityQureg(N, env)
+    for targets, codes in [((0,), (1,)), ((1, 3), (2, 3)), ((0, 2, 4), (3, 1, 2))]:
+        op = pauli_string_matrix(N, targets, codes)
+        expected = float(np.real(np.vdot(vec, op @ vec)))
+        got = qt.calcExpecPauliProd(psi, list(targets), list(codes), len(targets), work)
+        assert got == pytest.approx(expected, abs=1e-10)
+        expected_d = float(np.real(np.trace(op @ rho)))
+        got_d = qt.calcExpecPauliProd(dq, list(targets), list(codes), len(targets), workd)
+        assert got_d == pytest.approx(expected_d, abs=1e-10)
+    with pytest.raises(qt.QuESTError, match="Invalid Pauli code"):
+        qt.calcExpecPauliProd(psi, [0], [4], 1, work)
+
+
+def test_calcExpecPauliSum(env, loaded):
+    psi, dq, vec, rho = loaded
+    work = qt.createQureg(N, env)
+    np.random.seed(11)
+    num_terms = 4
+    codes = np.random.randint(0, 4, size=(num_terms, N))
+    coeffs = np.random.randn(num_terms)
+    op = pauli_sum_matrix(N, codes, coeffs)
+    expected = float(np.real(np.vdot(vec, op @ vec)))
+    got = qt.calcExpecPauliSum(psi, codes.ravel(), coeffs, num_terms, work)
+    assert got == pytest.approx(expected, abs=1e-10)
+    workd = qt.createDensityQureg(N, env)
+    expected_d = float(np.real(np.trace(op @ rho)))
+    got_d = qt.calcExpecPauliSum(dq, codes.ravel(), coeffs, num_terms, workd)
+    assert got_d == pytest.approx(expected_d, abs=1e-10)
+
+
+def test_calcExpecPauliHamil(env, loaded):
+    psi, dq, vec, rho = loaded
+    num_terms = 3
+    np.random.seed(21)
+    codes = np.random.randint(0, 4, size=(num_terms, N))
+    coeffs = np.random.randn(num_terms)
+    hamil = qt.createPauliHamil(N, num_terms)
+    qt.initPauliHamil(hamil, coeffs, codes.ravel())
+    op = pauli_sum_matrix(N, codes, coeffs)
+    work = qt.createQureg(N, env)
+    expected = float(np.real(np.vdot(vec, op @ vec)))
+    assert qt.calcExpecPauliHamil(psi, hamil, work) == pytest.approx(expected, abs=1e-10)
+
+
+def test_calcExpecDiagonalOp(env, loaded):
+    psi, dq, vec, rho = loaded
+    op = qt.createDiagonalOp(N, env)
+    elems = np.random.randn(DIM) + 1j * np.random.randn(DIM)
+    qt.initDiagonalOp(op, np.real(elems).copy(), np.imag(elems).copy())
+    expected = complex(np.sum(np.abs(vec) ** 2 * elems))
+    got = qt.calcExpecDiagonalOp(psi, op)
+    assert got == pytest.approx(expected, abs=1e-10)
+    expected_d = complex(np.sum(np.diag(rho) * elems))
+    got_d = qt.calcExpecDiagonalOp(dq, op)
+    assert got_d == pytest.approx(expected_d, abs=1e-10)
+
+
+def test_getNumQubits(env):
+    psi = qt.createQureg(N, env)
+    assert qt.getNumQubits(psi) == N
+
+
+def test_getNumAmps(env):
+    psi = qt.createQureg(N, env)
+    assert qt.getNumAmps(psi) == DIM
+
+
+def test_getAmp(env, loaded):
+    psi, dq, vec, rho = loaded
+    for i in (0, 1, DIM - 1):
+        assert qt.getAmp(psi, i) == pytest.approx(vec[i], abs=1e-13)
+    with pytest.raises(qt.QuESTError, match="Invalid amplitude index"):
+        qt.getAmp(psi, DIM)
+    with pytest.raises(qt.QuESTError, match="state-vector"):
+        qt.getAmp(dq, 0)
+
+
+def test_getRealAmp(env, loaded):
+    psi, _, vec, _ = loaded
+    for i in (0, 7):
+        assert qt.getRealAmp(psi, i) == pytest.approx(np.real(vec[i]), abs=1e-13)
+
+
+def test_getImagAmp(env, loaded):
+    psi, _, vec, _ = loaded
+    for i in (0, 7):
+        assert qt.getImagAmp(psi, i) == pytest.approx(np.imag(vec[i]), abs=1e-13)
+
+
+def test_getProbAmp(env, loaded):
+    psi, _, vec, _ = loaded
+    for i in (0, 7):
+        assert qt.getProbAmp(psi, i) == pytest.approx(abs(vec[i]) ** 2, abs=1e-13)
+
+
+def test_getDensityAmp(env, loaded):
+    _, dq, _, rho = loaded
+    for r, c in [(0, 0), (1, 3), (DIM - 1, DIM - 1), (4, 0)]:
+        assert qt.getDensityAmp(dq, r, c) == pytest.approx(rho[r, c], abs=1e-13)
+    psi = qt.createQureg(N, env)
+    with pytest.raises(qt.QuESTError, match="density matrices"):
+        qt.getDensityAmp(psi, 0, 0)

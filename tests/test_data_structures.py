@@ -1,0 +1,206 @@
+"""Data structures: registers, matrices, Hamiltonians, diagonal operators,
+environments — mirroring the reference's test_data_structures.cpp
+(21 TEST_CASEs)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from oracle import NUM_QUBITS, assert_sv, dm, sv
+
+N = NUM_QUBITS
+DIM = 1 << N
+
+
+# ---------------------------------------------------------------------------
+# complex scalar / matrix types
+# ---------------------------------------------------------------------------
+
+def test_fromComplex():
+    c = qt.Complex(0.3, -0.5)
+    assert qt.fromComplex(c) == 0.3 - 0.5j
+
+
+def test_toComplex():
+    assert qt.toComplex(1.5 + 2.5j) == 1.5 + 2.5j
+
+
+def test_createComplexMatrixN():
+    for k in (1, 3):
+        m = qt.createComplexMatrixN(k)
+        assert m.shape == (1 << k, 1 << k)
+        assert np.all(m == 0)
+    with pytest.raises(qt.QuESTError, match="Invalid number of qubits"):
+        qt.createComplexMatrixN(0)
+
+
+def test_initComplexMatrixN():
+    m = qt.createComplexMatrixN(1)
+    qt.initComplexMatrixN(m, [[1, 2], [3, 4]], [[5, 6], [7, 8]])
+    assert m[0, 0] == 1 + 5j and m[1, 1] == 4 + 8j
+
+
+def test_destroyComplexMatrixN():
+    m = qt.createComplexMatrixN(2)
+    qt.destroyComplexMatrixN(m)  # no-op for parity
+
+
+def test_getStaticComplexMatrixN():
+    m = qt.getStaticComplexMatrixN([[0, 1], [1, 0]], [[0, 0], [0, 0]])
+    assert np.allclose(m, np.array([[0, 1], [1, 0]]))
+
+
+# ---------------------------------------------------------------------------
+# environment
+# ---------------------------------------------------------------------------
+
+def test_createQuESTEnv():
+    env = qt.createQuESTEnv(1)
+    assert env.num_ranks == 1
+    with pytest.raises(qt.QuESTError, match="power-of-2"):
+        qt.createQuESTEnv(3)
+
+
+def test_destroyQuESTEnv():
+    env = qt.createQuESTEnv(1)
+    qt.destroyQuESTEnv(env)
+
+
+def test_syncQuESTEnv():
+    env = qt.createQuESTEnv(1)
+    qt.syncQuESTEnv(env)
+
+
+# ---------------------------------------------------------------------------
+# quregs
+# ---------------------------------------------------------------------------
+
+def test_createQureg(env):
+    q = qt.createQureg(N, env)
+    assert not q.isDensityMatrix
+    assert q.numQubitsRepresented == N
+    assert q.num_amps_total == DIM
+    expected = np.zeros(DIM)
+    expected[0] = 1.0
+    assert_sv(q, expected)
+    with pytest.raises(qt.QuESTError, match="Invalid number of qubits"):
+        qt.createQureg(0, env)
+    if env.num_ranks > 1:
+        with pytest.raises(qt.QuESTError, match="one amplitude per device"):
+            qt.createQureg(1, env)
+
+
+def test_createDensityQureg(env):
+    q = qt.createDensityQureg(N, env)
+    assert q.isDensityMatrix
+    assert q.numQubitsRepresented == N
+    assert q.num_amps_total == DIM * DIM
+    rho = dm(q)
+    assert rho[0, 0] == pytest.approx(1.0)
+    assert np.abs(rho).sum() == pytest.approx(1.0)
+
+
+def test_createCloneQureg(env):
+    src = qt.createQureg(N, env)
+    qt.hadamard(src, 0)
+    qt.rotateY(src, 2, 0.4)
+    clone = qt.createCloneQureg(src, env)
+    assert np.allclose(sv(clone), sv(src))
+    assert clone.numQubitsRepresented == src.numQubitsRepresented
+
+
+def test_destroyQureg(env):
+    q = qt.createQureg(N, env)
+    qt.destroyQureg(q, env)
+    assert q.amps is None
+
+
+# ---------------------------------------------------------------------------
+# PauliHamil
+# ---------------------------------------------------------------------------
+
+def test_createPauliHamil():
+    h = qt.createPauliHamil(3, 4)
+    assert h.num_qubits == 3 and h.num_sum_terms == 4
+    assert h.pauli_codes.shape == (4, 3)
+    assert np.all(h.term_coeffs == 0)
+    with pytest.raises(qt.QuESTError, match="strictly positive"):
+        qt.createPauliHamil(0, 1)
+    with pytest.raises(qt.QuESTError, match="strictly positive"):
+        qt.createPauliHamil(1, 0)
+
+
+def test_destroyPauliHamil():
+    h = qt.createPauliHamil(2, 2)
+    qt.destroyPauliHamil(h)
+
+
+def test_initPauliHamil():
+    h = qt.createPauliHamil(2, 2)
+    qt.initPauliHamil(h, [0.5, -1.5], [0, 1, 2, 3])
+    assert np.allclose(h.term_coeffs, [0.5, -1.5])
+    assert np.all(h.pauli_codes == [[0, 1], [2, 3]])
+    with pytest.raises(qt.QuESTError, match="Invalid Pauli code"):
+        qt.initPauliHamil(h, [1.0, 1.0], [0, 1, 2, 4])
+
+
+def test_createPauliHamilFromFile(tmp_path):
+    fn = tmp_path / "hamil.txt"
+    fn.write_text("0.5 0 1 2\n-1.0 3 0 1\n")
+    h = qt.createPauliHamilFromFile(str(fn))
+    assert h.num_qubits == 3 and h.num_sum_terms == 2
+    assert np.allclose(h.term_coeffs, [0.5, -1.0])
+    assert np.all(h.pauli_codes == [[0, 1, 2], [3, 0, 1]])
+    bad = tmp_path / "bad.txt"
+    bad.write_text("0.5 0 1 9\n")
+    with pytest.raises(qt.QuESTError, match="invalid pauli code"):
+        qt.createPauliHamilFromFile(str(bad))
+    with pytest.raises(qt.QuESTError, match="Could not open file"):
+        qt.createPauliHamilFromFile(str(tmp_path / "missing.txt"))
+
+
+# ---------------------------------------------------------------------------
+# DiagonalOp
+# ---------------------------------------------------------------------------
+
+def test_createDiagonalOp(env):
+    op = qt.createDiagonalOp(N, env)
+    assert op.num_qubits == N
+    assert np.asarray(op.amps).shape == (2, DIM)
+    with pytest.raises(qt.QuESTError, match="Invalid number of qubits"):
+        qt.createDiagonalOp(0, env)
+
+
+def test_destroyDiagonalOp(env):
+    op = qt.createDiagonalOp(N, env)
+    qt.destroyDiagonalOp(op, env)
+    assert op.amps is None
+
+
+def test_initDiagonalOp(env):
+    op = qt.createDiagonalOp(N, env)
+    re = np.arange(DIM, dtype=float)
+    im = -np.arange(DIM, dtype=float)
+    qt.initDiagonalOp(op, re, im)
+    a = np.asarray(op.amps)
+    assert np.allclose(a[0], re) and np.allclose(a[1], im)
+    with pytest.raises(qt.QuESTError, match="Invalid number of elements"):
+        qt.initDiagonalOp(op, re[:3], im[:3])
+
+
+def test_setDiagonalOpElems(env):
+    op = qt.createDiagonalOp(N, env)
+    qt.setDiagonalOpElems(op, 4, [1.0, 2.0], [3.0, 4.0], 2)
+    a = np.asarray(op.amps)
+    assert a[0][4] == 1.0 and a[1][5] == 4.0
+    with pytest.raises(qt.QuESTError, match="More elements"):
+        qt.setDiagonalOpElems(op, DIM - 1, [1.0, 2.0], [3.0, 4.0], 2)
+
+
+def test_syncDiagonalOp(env):
+    op = qt.createDiagonalOp(N, env)
+    qt.syncDiagonalOp(op)  # device-resident already; must not fail

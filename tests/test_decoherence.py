@@ -1,0 +1,161 @@
+"""Decoherence channels on density matrices, mirroring the reference's
+test_decoherence.cpp (10 TEST_CASEs).  Each channel is checked against the
+Kraus-sum oracle on a random density matrix."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from oracle import (DM_TOL, NUM_QUBITS, I2, X, Y, Z, apply_channel, assert_dm,
+                    dm, random_density_matrix, random_kraus_map, set_dm)
+
+N = NUM_QUBITS
+
+
+@pytest.fixture
+def rho_q(env):
+    rho = random_density_matrix(N)
+    dq = qt.createDensityQureg(N, env)
+    set_dm(dq, rho)
+    return dq, rho
+
+
+def test_mixDephasing(env, rho_q):
+    dq, rho = rho_q
+    p = 0.2
+    for t in range(N):
+        set_dm(dq, rho)
+        qt.mixDephasing(dq, t, p)
+        kraus = [np.sqrt(1 - p) * I2, np.sqrt(p) * Z]
+        assert_dm(dq, apply_channel(rho, N, [t], kraus))
+    psi = qt.createQureg(N, env)
+    with pytest.raises(qt.QuESTError, match="density matrices"):
+        qt.mixDephasing(psi, 0, p)
+    with pytest.raises(qt.QuESTError, match="dephase error"):
+        qt.mixDephasing(dq, 0, 0.6)
+
+
+def test_mixTwoQubitDephasing(env, rho_q):
+    dq, rho = rho_q
+    p = 0.3
+    for q1, q2 in [(0, 1), (1, 4), (3, 2)]:
+        set_dm(dq, rho)
+        qt.mixTwoQubitDephasing(dq, q1, q2, p)
+        # (1-p) rho + p/3 (Z1 + Z2 + Z1Z2 conjugations)
+        i4 = np.eye(4, dtype=complex)
+        z1 = np.kron(I2, Z)  # acts on q1 (q1 = least significant target bit)
+        z2 = np.kron(Z, I2)
+        kraus = [np.sqrt(1 - p) * i4, np.sqrt(p / 3) * z1, np.sqrt(p / 3) * z2,
+                 np.sqrt(p / 3) * (z1 @ z2)]
+        assert_dm(dq, apply_channel(rho, N, [q1, q2], kraus))
+    with pytest.raises(qt.QuESTError, match="dephase error"):
+        qt.mixTwoQubitDephasing(dq, 0, 1, 0.8)
+
+
+def test_mixDepolarising(env, rho_q):
+    dq, rho = rho_q
+    p = 0.4
+    for t in range(N):
+        set_dm(dq, rho)
+        qt.mixDepolarising(dq, t, p)
+        kraus = [np.sqrt(1 - p) * I2, np.sqrt(p / 3) * X, np.sqrt(p / 3) * Y,
+                 np.sqrt(p / 3) * Z]
+        assert_dm(dq, apply_channel(rho, N, [t], kraus))
+    with pytest.raises(qt.QuESTError, match="depolarising error"):
+        qt.mixDepolarising(dq, 0, 0.8)
+
+
+def test_mixTwoQubitDepolarising(env, rho_q):
+    dq, rho = rho_q
+    p = 0.5
+    for q1, q2 in [(0, 1), (2, 4)]:
+        set_dm(dq, rho)
+        qt.mixTwoQubitDepolarising(dq, q1, q2, p)
+        # (1-p) rho + p/15 sum over the 15 non-identity two-qubit Paulis
+        paulis = [I2, X, Y, Z]
+        expected = (1 - p) * rho
+        for i in range(4):
+            for j in range(4):
+                if i == 0 and j == 0:
+                    continue
+                sigma = np.kron(paulis[j], paulis[i])  # i on q1, j on q2
+                expected += (p / 15) * apply_channel(rho, N, [q1, q2], [sigma])
+        assert_dm(dq, expected)
+    with pytest.raises(qt.QuESTError, match="two-qubit depolarising"):
+        qt.mixTwoQubitDepolarising(dq, 0, 1, 0.95)
+
+
+def test_mixDamping(env, rho_q):
+    dq, rho = rho_q
+    p = 0.35
+    for t in range(N):
+        set_dm(dq, rho)
+        qt.mixDamping(dq, t, p)
+        k0 = np.array([[1, 0], [0, np.sqrt(1 - p)]], dtype=complex)
+        k1 = np.array([[0, np.sqrt(p)], [0, 0]], dtype=complex)
+        assert_dm(dq, apply_channel(rho, N, [t], [k0, k1]))
+    with pytest.raises(qt.QuESTError, match="[Pp]robabilities"):
+        qt.mixDamping(dq, 0, 1.2)
+
+
+def test_mixPauli(env, rho_q):
+    dq, rho = rho_q
+    px, py, pz = 0.1, 0.15, 0.05
+    for t in range(N):
+        set_dm(dq, rho)
+        qt.mixPauli(dq, t, px, py, pz)
+        kraus = [np.sqrt(1 - px - py - pz) * I2, np.sqrt(px) * X,
+                 np.sqrt(py) * Y, np.sqrt(pz) * Z]
+        assert_dm(dq, apply_channel(rho, N, [t], kraus))
+    # probability of any single error cannot exceed the no-error probability
+    with pytest.raises(qt.QuESTError, match="cannot exceed the probability"):
+        qt.mixPauli(dq, 0, 0.6, 0.3, 0.05)
+
+
+def test_mixKrausMap(env, rho_q):
+    dq, rho = rho_q
+    np.random.seed(3)
+    ops = random_kraus_map(1, 3)
+    for t in (0, 2, N - 1):
+        set_dm(dq, rho)
+        qt.mixKrausMap(dq, t, ops, len(ops))
+        assert_dm(dq, apply_channel(rho, N, [t], ops))
+    with pytest.raises(qt.QuESTError, match="trace preserving"):
+        qt.mixKrausMap(dq, 0, [2 * np.eye(2)], 1)
+    with pytest.raises(qt.QuESTError, match="single qubit Kraus"):
+        qt.mixKrausMap(dq, 0, [np.eye(2)] * 5, 5)
+
+
+def test_mixTwoQubitKrausMap(env, rho_q):
+    dq, rho = rho_q
+    np.random.seed(5)
+    ops = random_kraus_map(2, 4)
+    for q1, q2 in [(0, 1), (3, 1)]:
+        set_dm(dq, rho)
+        qt.mixTwoQubitKrausMap(dq, q1, q2, ops, len(ops))
+        assert_dm(dq, apply_channel(rho, N, [q1, q2], ops))
+
+
+def test_mixMultiQubitKrausMap(env, rho_q):
+    dq, rho = rho_q
+    np.random.seed(9)
+    for targets in [(0,), (1, 3), (0, 2, 4)]:
+        ops = random_kraus_map(len(targets), 2)
+        set_dm(dq, rho)
+        qt.mixMultiQubitKrausMap(dq, list(targets), len(targets), ops, len(ops))
+        assert_dm(dq, apply_channel(rho, N, list(targets), ops))
+
+
+def test_mixDensityMatrix(env, rho_q):
+    dq, rho = rho_q
+    other_rho = random_density_matrix(N)
+    other = qt.createDensityQureg(N, env)
+    set_dm(other, other_rho)
+    p = 0.42
+    qt.mixDensityMatrix(dq, p, other)
+    assert_dm(dq, (1 - p) * rho + p * other_rho)
+    psi = qt.createQureg(N, env)
+    with pytest.raises(qt.QuESTError, match="density matrices"):
+        qt.mixDensityMatrix(psi, p, other)

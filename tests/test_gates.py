@@ -1,0 +1,93 @@
+"""Non-unitary gates: measurement and collapse, mirroring the reference's
+test_gates.cpp (3 TEST_CASEs)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from oracle import (DM_TOL, NUM_QUBITS, assert_dm, assert_sv, dm,
+                    random_density_matrix, random_statevector, set_dm, set_sv, sv)
+
+N = NUM_QUBITS
+
+
+def test_collapseToOutcome(env):
+    vec = random_statevector(N)
+    rho = random_density_matrix(N)
+    for t in range(N):
+        for outcome in (0, 1):
+            # statevector
+            psi = qt.createQureg(N, env)
+            set_sv(psi, vec)
+            mask = np.array([(i >> t) & 1 == outcome for i in range(1 << N)])
+            prob = float(np.sum(np.abs(vec[mask]) ** 2))
+            got = qt.collapseToOutcome(psi, t, outcome)
+            assert got == pytest.approx(prob, abs=1e-12)
+            expected = np.where(mask, vec, 0.0) / np.sqrt(prob)
+            assert_sv(psi, expected)
+            # density matrix
+            dq = qt.createDensityQureg(N, env)
+            set_dm(dq, rho)
+            probd = float(np.real(sum(rho[i, i] for i in range(1 << N)
+                                      if ((i >> t) & 1) == outcome)))
+            gotd = qt.collapseToOutcome(dq, t, outcome)
+            assert gotd == pytest.approx(probd, abs=1e-12)
+            keep = np.array([((i >> t) & 1) == outcome for i in range(1 << N)])
+            expected_rho = np.where(np.outer(keep, keep), rho, 0.0) / probd
+            assert_dm(dq, expected_rho)
+    # input validation (ref: test_gates.cpp collapseToOutcome section)
+    psi = qt.createQureg(N, env)
+    with pytest.raises(qt.QuESTError, match="Invalid measurement outcome"):
+        qt.collapseToOutcome(psi, 0, 2)
+    with pytest.raises(qt.QuESTError, match="Invalid target"):
+        qt.collapseToOutcome(psi, N, 0)
+    qt.initClassicalState(psi, 0)  # P(qubit 0 = 1) is 0
+    with pytest.raises(qt.QuESTError, match="zero probability"):
+        qt.collapseToOutcome(psi, 0, 1)
+
+
+def test_measure(env):
+    # outcome distribution on |+>^N: both outcomes occur; state collapses
+    for t in (0, N - 1):
+        counts = [0, 0]
+        for _ in range(10):
+            psi = qt.createQureg(N, env)
+            qt.initPlusState(psi)
+            out = qt.measure(psi, t)
+            counts[out] += 1
+            # post-measurement state is normalised and consistent
+            assert qt.calcProbOfOutcome(psi, t, out) == pytest.approx(1.0, abs=1e-10)
+        assert counts[0] + counts[1] == 10
+    # deterministic on a classical state
+    psi = qt.createQureg(N, env)
+    qt.initClassicalState(psi, 0b10110)
+    for t, expect in [(0, 0), (1, 1), (2, 1), (3, 0), (4, 1)]:
+        assert qt.measure(psi, t) == expect
+    # density matrix
+    rho = qt.createDensityQureg(N, env)
+    qt.initClassicalState(rho, 0b00101)
+    assert qt.measure(rho, 0) == 1
+    assert qt.measure(rho, 1) == 0
+    with pytest.raises(qt.QuESTError, match="Invalid target"):
+        qt.measure(psi, -1)
+
+
+def test_measureWithStats(env):
+    psi = qt.createQureg(N, env)
+    qt.initPlusState(psi)
+    out, prob = qt.measureWithStats(psi, 2)
+    assert out in (0, 1)
+    assert prob == pytest.approx(0.5, abs=1e-10)
+    # repeated measurement of the same qubit is deterministic with prob 1
+    out2, prob2 = qt.measureWithStats(psi, 2)
+    assert out2 == out
+    assert prob2 == pytest.approx(1.0, abs=1e-10)
+    # density matrix
+    rho = qt.createDensityQureg(N, env)
+    qt.initPlusState(rho)
+    out, prob = qt.measureWithStats(rho, 0)
+    assert out in (0, 1)
+    assert prob == pytest.approx(0.5, abs=1e-10)
+    assert qt.calcTotalProb(rho) == pytest.approx(1.0, abs=1e-10)

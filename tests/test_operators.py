@@ -1,0 +1,167 @@
+"""Non-unitary operators, mirroring the reference's test_operators.cpp
+(8 TEST_CASEs)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from oracle import (NUM_QUBITS, apply_to_sv, assert_dm, assert_sv, dm,
+                    full_operator, left_apply_to_dm, pauli_sum_matrix,
+                    random_density_matrix, random_statevector, random_unitary,
+                    set_dm, set_sv, sv)
+
+N = NUM_QUBITS
+DIM = 1 << N
+
+
+@pytest.fixture
+def loaded(env):
+    vec = random_statevector(N)
+    rho = random_density_matrix(N)
+    psi = qt.createQureg(N, env)
+    dq = qt.createDensityQureg(N, env)
+    set_sv(psi, vec)
+    set_dm(dq, rho)
+    return psi, dq, vec, rho
+
+
+def _random_matrix(k):
+    d = 1 << k
+    return np.random.randn(d, d) + 1j * np.random.randn(d, d)
+
+
+def test_applyMatrix2(env, loaded):
+    psi, dq, vec, rho = loaded
+    m = _random_matrix(1)
+    for t in (0, 2, N - 1):
+        set_sv(psi, vec)
+        qt.applyMatrix2(psi, t, m)
+        assert_sv(psi, apply_to_sv(vec, N, [t], m))
+        # on density matrices the matrix left-multiplies only (no dagger)
+        set_dm(dq, rho)
+        qt.applyMatrix2(dq, t, m)
+        assert_dm(dq, left_apply_to_dm(rho, N, [t], m))
+
+
+def test_applyMatrix4(env, loaded):
+    psi, dq, vec, rho = loaded
+    m = _random_matrix(2)
+    for t1, t2 in [(0, 1), (3, 1), (2, 4)]:
+        set_sv(psi, vec)
+        qt.applyMatrix4(psi, t1, t2, m)
+        assert_sv(psi, apply_to_sv(vec, N, [t1, t2], m))
+        set_dm(dq, rho)
+        qt.applyMatrix4(dq, t1, t2, m)
+        assert_dm(dq, left_apply_to_dm(rho, N, [t1, t2], m))
+
+
+def test_applyMatrixN(env, loaded):
+    psi, dq, vec, rho = loaded
+    shard_amps = DIM // env.num_ranks
+    kmax = shard_amps.bit_length() - 1
+    for targets in [(0,), (1, 3), (0, 2, 4)]:
+        if len(targets) > kmax:
+            continue
+        m = _random_matrix(len(targets))
+        set_sv(psi, vec)
+        qt.applyMatrixN(psi, list(targets), len(targets), m)
+        assert_sv(psi, apply_to_sv(vec, N, list(targets), m))
+        set_dm(dq, rho)
+        qt.applyMatrixN(dq, list(targets), len(targets), m)
+        assert_dm(dq, left_apply_to_dm(rho, N, list(targets), m))
+    with pytest.raises(qt.QuESTError, match="size does not match"):
+        qt.applyMatrixN(psi, [0, 1], 2, _random_matrix(1))
+
+
+def test_applyMultiControlledMatrixN(env, loaded):
+    psi, dq, vec, rho = loaded
+    for ctrls, targets in [((4,), (0, 1)), ((0, 3), (1,)), ((1,), (2, 0))]:
+        m = _random_matrix(len(targets))
+        set_sv(psi, vec)
+        qt.applyMultiControlledMatrixN(psi, list(ctrls), len(ctrls),
+                                       list(targets), len(targets), m)
+        assert_sv(psi, apply_to_sv(vec, N, list(targets), m, list(ctrls)))
+        set_dm(dq, rho)
+        qt.applyMultiControlledMatrixN(dq, list(ctrls), len(ctrls),
+                                       list(targets), len(targets), m)
+        assert_dm(dq, left_apply_to_dm(rho, N, list(targets), m, list(ctrls)))
+    with pytest.raises(qt.QuESTError, match="disjoint"):
+        qt.applyMultiControlledMatrixN(psi, [0], 1, [0, 1], 2, _random_matrix(2))
+
+
+def test_applyPauliSum(env, loaded):
+    psi, dq, vec, rho = loaded
+    np.random.seed(13)
+    num_terms = 3
+    codes = np.random.randint(0, 4, size=(num_terms, N))
+    coeffs = np.random.randn(num_terms)
+    op = pauli_sum_matrix(N, codes, coeffs)
+    out = qt.createQureg(N, env)
+    qt.applyPauliSum(psi, codes.ravel(), coeffs, num_terms, out)
+    assert_sv(out, op @ vec)
+    # input state is preserved
+    assert_sv(psi, vec)
+    # density version: rho -> H rho (left multiplication)
+    out_d = qt.createDensityQureg(N, env)
+    qt.applyPauliSum(dq, codes.ravel(), coeffs, num_terms, out_d)
+    assert_dm(out_d, op @ rho)
+
+
+def test_applyPauliHamil(env, loaded):
+    psi, dq, vec, rho = loaded
+    np.random.seed(17)
+    num_terms = 4
+    codes = np.random.randint(0, 4, size=(num_terms, N))
+    coeffs = np.random.randn(num_terms)
+    hamil = qt.createPauliHamil(N, num_terms)
+    qt.initPauliHamil(hamil, coeffs, codes.ravel())
+    op = pauli_sum_matrix(N, codes, coeffs)
+    out = qt.createQureg(N, env)
+    qt.applyPauliHamil(psi, hamil, out)
+    assert_sv(out, op @ vec)
+
+
+def test_applyTrotterCircuit(env, loaded):
+    psi, dq, vec, rho = loaded
+    np.random.seed(19)
+    num_terms = 3
+    codes = np.random.randint(0, 4, size=(num_terms, N))
+    coeffs = np.random.randn(num_terms)
+    hamil = qt.createPauliHamil(N, num_terms)
+    qt.initPauliHamil(hamil, coeffs, codes.ravel())
+    h = pauli_sum_matrix(N, codes, coeffs)
+    w, v = np.linalg.eigh(h)
+    time = 0.1
+
+    def exact(t):
+        return (v * np.exp(-1j * w * t)) @ v.conj().T
+
+    # high-rep first-order Trotter converges to the exact evolution
+    set_sv(psi, vec)
+    qt.applyTrotterCircuit(psi, hamil, time, 1, 30)
+    got = sv(psi)
+    assert np.abs(got - exact(time) @ vec).max() < 2e-3
+    # second order converges faster
+    set_sv(psi, vec)
+    qt.applyTrotterCircuit(psi, hamil, time, 2, 10)
+    got2 = sv(psi)
+    assert np.abs(got2 - exact(time) @ vec).max() < 2e-4
+    # order must be 1 or even
+    with pytest.raises(qt.QuESTError, match="Trotterisation order"):
+        qt.applyTrotterCircuit(psi, hamil, time, 3, 1)
+    with pytest.raises(qt.QuESTError, match="repetitions"):
+        qt.applyTrotterCircuit(psi, hamil, time, 1, 0)
+
+
+def test_applyDiagonalOp(env, loaded):
+    psi, dq, vec, rho = loaded
+    op = qt.createDiagonalOp(N, env)
+    elems = np.random.randn(DIM) + 1j * np.random.randn(DIM)
+    qt.initDiagonalOp(op, np.real(elems).copy(), np.imag(elems).copy())
+    qt.applyDiagonalOp(psi, op)
+    assert_sv(psi, elems * vec)
+    # density: rho -> D rho (left multiplication by the diagonal)
+    qt.applyDiagonalOp(dq, op)
+    assert_dm(dq, np.diag(elems) @ rho)

@@ -1,0 +1,158 @@
+"""State initialisation & amplitude injection, mirroring the reference's
+test_state_initialisations.cpp (9 TEST_CASEs)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from oracle import (NUM_QUBITS, assert_dm, assert_sv, dm, random_density_matrix,
+                    random_statevector, set_dm, set_sv, sv)
+
+N = NUM_QUBITS
+DIM = 1 << N
+
+
+def test_initBlankState(env):
+    psi = qt.createQureg(N, env)
+    qt.initBlankState(psi)
+    assert_sv(psi, np.zeros(DIM))
+    rho = qt.createDensityQureg(N, env)
+    qt.initBlankState(rho)
+    assert_dm(rho, np.zeros((DIM, DIM)))
+
+
+def test_initZeroState(env):
+    psi = qt.createQureg(N, env)
+    qt.hadamard(psi, 0)
+    qt.initZeroState(psi)
+    expected = np.zeros(DIM)
+    expected[0] = 1.0
+    assert_sv(psi, expected)
+    rho = qt.createDensityQureg(N, env)
+    qt.initZeroState(rho)
+    exp_rho = np.zeros((DIM, DIM))
+    exp_rho[0, 0] = 1.0
+    assert_dm(rho, exp_rho)
+
+
+def test_initPlusState(env):
+    psi = qt.createQureg(N, env)
+    qt.initPlusState(psi)
+    assert_sv(psi, np.full(DIM, 1.0 / np.sqrt(DIM)))
+    rho = qt.createDensityQureg(N, env)
+    qt.initPlusState(rho)
+    assert_dm(rho, np.full((DIM, DIM), 1.0 / DIM))
+
+
+def test_initClassicalState(env):
+    for ind in (0, 5, DIM - 1):
+        psi = qt.createQureg(N, env)
+        qt.initClassicalState(psi, ind)
+        expected = np.zeros(DIM)
+        expected[ind] = 1.0
+        assert_sv(psi, expected)
+        rho = qt.createDensityQureg(N, env)
+        qt.initClassicalState(rho, ind)
+        exp_rho = np.zeros((DIM, DIM))
+        exp_rho[ind, ind] = 1.0
+        assert_dm(rho, exp_rho)
+    psi = qt.createQureg(N, env)
+    with pytest.raises(qt.QuESTError, match="Invalid state index"):
+        qt.initClassicalState(psi, DIM)
+
+
+def test_initPureState(env):
+    vec = random_statevector(N)
+    source = qt.createQureg(N, env)
+    set_sv(source, vec)
+    # statevector <- statevector copy
+    psi = qt.createQureg(N, env)
+    qt.initPureState(psi, source)
+    assert_sv(psi, vec)
+    # density matrix <- |psi><psi|
+    rho = qt.createDensityQureg(N, env)
+    qt.initPureState(rho, source)
+    assert_dm(rho, np.outer(vec, np.conj(vec)))
+    # validation: second arg must be a statevector; dims must match
+    with pytest.raises(qt.QuESTError, match="state-vector"):
+        qt.initPureState(psi, rho)
+    small = qt.createQureg(N - 1, env)
+    with pytest.raises(qt.QuESTError, match="Dimensions"):
+        qt.initPureState(psi, small)
+
+
+def test_initStateFromAmps(env):
+    vec = random_statevector(N)
+    psi = qt.createQureg(N, env)
+    qt.initStateFromAmps(psi, np.real(vec).copy(), np.imag(vec).copy())
+    assert_sv(psi, vec)
+
+
+def test_setAmps(env):
+    vec = random_statevector(N)
+    psi = qt.createQureg(N, env)
+    set_sv(psi, vec)
+    # overwrite a window [start, start+num)
+    start, num = 3, 7
+    re = np.arange(num, dtype=float)
+    im = -np.arange(num, dtype=float)
+    qt.setAmps(psi, start, re, im, num)
+    expected = vec.copy()
+    expected[start:start + num] = re + 1j * im
+    assert_sv(psi, expected)
+    with pytest.raises(qt.QuESTError, match="More amplitudes"):
+        qt.setAmps(psi, DIM - 1, re, im, num)
+    with pytest.raises(qt.QuESTError, match="Invalid amplitude index"):
+        qt.setAmps(psi, -1, re, im, num)
+    rho = qt.createDensityQureg(N, env)
+    with pytest.raises(qt.QuESTError, match="state-vector"):
+        qt.setAmps(rho, 0, re, im, num)
+
+
+def test_cloneQureg(env):
+    vec = random_statevector(N)
+    source = qt.createQureg(N, env)
+    set_sv(source, vec)
+    target = qt.createQureg(N, env)
+    qt.cloneQureg(target, source)
+    assert_sv(target, vec)
+    # density
+    rho_in = random_density_matrix(N)
+    src_d = qt.createDensityQureg(N, env)
+    set_dm(src_d, rho_in)
+    tgt_d = qt.createDensityQureg(N, env)
+    qt.cloneQureg(tgt_d, src_d)
+    assert_dm(tgt_d, rho_in)
+    with pytest.raises(qt.QuESTError, match="both be state-vectors or both"):
+        qt.cloneQureg(target, src_d)
+    small = qt.createQureg(N - 1, env)
+    with pytest.raises(qt.QuESTError, match="Dimensions"):
+        qt.cloneQureg(small, source)
+
+
+def test_setWeightedQureg(env):
+    v1, v2, v3 = (random_statevector(N) for _ in range(3))
+    f1, f2, fo = 0.3 - 0.1j, -0.5 + 0.2j, 1.1 + 0.4j
+    q1 = qt.createQureg(N, env)
+    q2 = qt.createQureg(N, env)
+    out = qt.createQureg(N, env)
+    set_sv(q1, v1)
+    set_sv(q2, v2)
+    set_sv(out, v3)
+    qt.setWeightedQureg(f1, q1, f2, q2, fo, out)
+    assert_sv(out, f1 * v1 + f2 * v2 + fo * v3)
+    # density-matrix version
+    r1, r2, r3 = (random_density_matrix(N) for _ in range(3))
+    d1 = qt.createDensityQureg(N, env)
+    d2 = qt.createDensityQureg(N, env)
+    do = qt.createDensityQureg(N, env)
+    set_dm(d1, r1)
+    set_dm(d2, r2)
+    set_dm(do, r3)
+    qt.setWeightedQureg(f1, d1, f2, d2, fo, do)
+    assert_dm(do, f1 * r1 + f2 * r2 + fo * r3)
+    # validation: mixed types
+    with pytest.raises(qt.QuESTError, match="both be state-vectors or both"):
+        qt.setWeightedQureg(f1, q1, f2, d2, fo, out)
