@@ -1,0 +1,70 @@
+"""Benchmark harness: single-qubit-gate amplitude-update throughput per chip.
+
+Workload: a depth-D random circuit (Haar 1-qubit layers + CZ ladders) on an
+n-qubit statevector, compiled as ONE fused XLA program per layer and iterated
+with buffer donation.  The metric is the reference's headline unit
+(BASELINE.md: >=1e8 single-qubit-gate amplitude updates / sec / chip):
+
+    value = 2^n * (#single-qubit gates) / wall_seconds / n_chips
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+
+Env overrides: QUEST_BENCH_QUBITS (default 26 on TPU, 20 on CPU),
+QUEST_BENCH_DEPTH (default 8), QUEST_BENCH_PRECISION (1|2, default 1).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BASELINE_AMPS_PER_SEC = 1e8  # driver target (BASELINE.md north star)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+    n = int(os.environ.get("QUEST_BENCH_QUBITS", "26" if on_accel else "20"))
+    depth = int(os.environ.get("QUEST_BENCH_DEPTH", "8"))
+    precision = int(os.environ.get("QUEST_BENCH_PRECISION", "1"))
+    dtype = jnp.float32 if precision == 1 else jnp.float64
+
+    from quest_tpu.circuit import compile_circuit, random_circuit
+
+    circuit = random_circuit(n, depth=1, seed=11)
+    num_sq_gates_per_layer = n  # the CZ ladder is excluded from the metric
+    run_layer = compile_circuit(circuit, donate=True)
+
+    state = jnp.zeros((2, 1 << n), dtype=dtype).at[0, 0].set(1.0)
+
+    # warmup / compile
+    state = run_layer(state)
+    state.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(depth):
+        state = run_layer(state)
+    state.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    total_sq_gates = depth * num_sq_gates_per_layer
+    amps_per_sec = (1 << n) * total_sq_gates / dt
+    result = {
+        "metric": "statevec_1q_gate_amp_updates_per_sec_per_chip",
+        "value": amps_per_sec,
+        "unit": "amps/s",
+        "vs_baseline": amps_per_sec / BASELINE_AMPS_PER_SEC,
+        "config": {"qubits": n, "depth": depth, "precision": precision,
+                   "platform": platform, "seconds": dt},
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,12 @@ extensions (precision control, mesh control, circuit compilation).
 from .precision import set_precision, get_precision, real_eps  # noqa: F401  (configures x64)
 from .api import *  # noqa: F401,F403
 from .api import __all__ as _api_all
+from .circuit import (Circuit, compile_circuit, apply_circuit,  # noqa: F401
+                      random_circuit, qft_circuit)
 
 __version__ = "0.1.0"
-__all__ = list(_api_all) + ["set_precision", "get_precision", "real_eps"]
+__all__ = list(_api_all) + [
+    "set_precision", "get_precision", "real_eps",
+    "Circuit", "compile_circuit", "apply_circuit", "random_circuit",
+    "qft_circuit",
+]

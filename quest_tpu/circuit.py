@@ -1,0 +1,248 @@
+"""Whole-circuit compilation: record a gate list, emit ONE fused XLA program.
+
+This layer has no analogue in the reference, which dispatches one kernel per
+API call (ref: QuEST.c:177-660 — every gate is a separate library call with
+its own OpenMP/MPI/CUDA launch).  Under XLA that per-gate model would leave
+fusion on the table: a circuit compiled as a single jitted program lets the
+compiler fuse adjacent diagonal/elementwise gates into single HBM passes,
+batch rotations into one matmul, and schedule cross-shard collectives — the
+performance model TPUs want.  The eager per-gate API (api.py) remains the
+compatibility surface; this is the TPU-native fast path.
+
+A :class:`Circuit` is a host-side IR of (kind, targets, controls, matrix)
+records.  ``compile_circuit`` closes over the static structure and returns a
+jitted ``state -> state`` function; parametric use goes through
+``apply_circuit`` on a Qureg.  Matrices are embedded as compile-time
+constants (gate structure is trace-time structure, the resolution of the
+reference's runtime qubit-index dispatch — SURVEY §7 hard part (b)).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .ops import apply as _ap
+
+__all__ = ["Circuit", "compile_circuit", "apply_circuit", "random_circuit",
+           "qft_circuit"]
+
+
+@dataclasses.dataclass(frozen=True)
+class GateOp:
+    kind: str                      # 'matrix' | 'diagonal' | 'x' | 'y' | 'swap'
+    targets: tuple
+    controls: tuple = ()
+    control_states: tuple = ()
+    matrix: tuple | None = None    # flattened real-pair payload (hashable)
+    shape: tuple | None = None
+
+    def payload(self) -> np.ndarray:
+        return np.asarray(self.matrix, dtype=np.float64).reshape(self.shape)
+
+
+class Circuit:
+    """Recorded gate sequence on ``num_qubits`` qubits.
+
+    Builder methods mirror the API's gate set; each appends an IR record.
+    ``compile()`` returns a jitted pure function over the (2, 2^n) SoA state.
+    """
+
+    def __init__(self, num_qubits: int):
+        self.num_qubits = num_qubits
+        self.ops: list[GateOp] = []
+
+    # --- recording ---------------------------------------------------------
+    def _mat(self, u, targets, controls=(), control_states=()):
+        up = _ap.mat_pair(u)
+        self.ops.append(GateOp("matrix", tuple(targets), tuple(controls),
+                               tuple(control_states),
+                               tuple(up.ravel()), up.shape))
+        return self
+
+    def _diag(self, d, targets, controls=(), control_states=()):
+        d = np.asarray(d, dtype=np.complex128)
+        dp = np.stack([d.real, d.imag])
+        self.ops.append(GateOp("diagonal", tuple(targets), tuple(controls),
+                               tuple(control_states),
+                               tuple(dp.ravel()), dp.shape))
+        return self
+
+    def unitary(self, target, u):
+        return self._mat(u, (target,))
+
+    def multi_qubit_unitary(self, targets, u, controls=(), control_states=()):
+        return self._mat(u, tuple(targets), tuple(controls), tuple(control_states))
+
+    def compact_unitary(self, target, alpha, beta):
+        return self._mat([[alpha, -np.conj(beta)], [beta, np.conj(alpha)]], (target,))
+
+    def h(self, target):
+        s = 1.0 / math.sqrt(2.0)
+        return self._mat([[s, s], [s, -s]], (target,))
+
+    def x(self, target, controls=()):
+        self.ops.append(GateOp("x", (target,), tuple(controls)))
+        return self
+
+    def y(self, target, controls=()):
+        self.ops.append(GateOp("y", (target,), tuple(controls)))
+        return self
+
+    def z(self, target, controls=()):
+        return self._diag([1.0, -1.0], (target,), tuple(controls))
+
+    def cnot(self, control, target):
+        return self.x(target, (control,))
+
+    def cz(self, q1, q2):
+        return self.z(q2, (q1,))
+
+    def s(self, target):
+        return self._diag([1.0, 1j], (target,))
+
+    def t(self, target):
+        return self._diag([1.0, np.exp(1j * math.pi / 4)], (target,))
+
+    def phase_shift(self, target, angle, controls=()):
+        return self._diag([1.0, np.exp(1j * angle)], (target,), tuple(controls))
+
+    def rx(self, target, angle):
+        c, s = math.cos(angle / 2), math.sin(angle / 2)
+        return self._mat([[c, -1j * s], [-1j * s, c]], (target,))
+
+    def ry(self, target, angle):
+        c, s = math.cos(angle / 2), math.sin(angle / 2)
+        return self._mat([[c, -s], [s, c]], (target,))
+
+    def rz(self, target, angle):
+        return self._diag([np.exp(-1j * angle / 2), np.exp(1j * angle / 2)], (target,))
+
+    def swap(self, q1, q2):
+        self.ops.append(GateOp("swap", (q1, q2)))
+        return self
+
+    # --- compilation -------------------------------------------------------
+    def __len__(self) -> int:
+        return len(self.ops)
+
+    def key(self) -> tuple:
+        return tuple(self.ops)
+
+
+def _apply_one(state: jax.Array, op: GateOp) -> jax.Array:
+    if op.kind == "matrix":
+        u = jnp.asarray(op.payload(), dtype=state.dtype)
+        return _ap.apply_matrix(state, u, op.targets, op.controls, op.control_states)
+    if op.kind == "diagonal":
+        d = jnp.asarray(op.payload(), dtype=state.dtype)
+        return _ap.apply_diagonal(state, d, op.targets, op.controls, op.control_states)
+    if op.kind == "x":
+        return _ap.apply_pauli_x(state, op.targets[0], op.controls, op.control_states)
+    if op.kind == "y":
+        return _ap.apply_pauli_y(state, op.targets[0], op.controls, op.control_states)
+    if op.kind == "y*":  # conjugated Y for density-matrix shadow ops
+        return _ap.apply_pauli_y(state, op.targets[0], op.controls, op.control_states,
+                                 conj_fac=-1)
+    if op.kind == "swap":
+        return _ap.swap_qubit_amps(state, op.targets[0], op.targets[1])
+    raise ValueError(f"unknown gate kind {op.kind}")
+
+
+def _shadow_op(op: GateOp, n: int) -> GateOp:
+    """The conjugated column-side twin of a gate for the Choi-flattened
+    density matrix (same rule as the eager API's shadow, ref: QuEST.c:8-10)."""
+    kind = "y*" if op.kind == "y" else op.kind
+    conj_matrix = op.matrix
+    if op.matrix is not None:
+        p = op.payload()
+        conj_matrix = tuple(np.stack([p[0], -p[1]]).ravel())
+    return GateOp(kind, tuple(t + n for t in op.targets),
+                  tuple(c + n for c in op.controls), op.control_states,
+                  conj_matrix, op.shape)
+
+
+@partial(jax.jit, static_argnames=("ops",))
+def _run_ops(state: jax.Array, ops: tuple) -> jax.Array:
+    for op in ops:
+        state = _apply_one(state, op)
+    return state
+
+
+def compile_circuit(circuit: Circuit, donate: bool = False):
+    """Return a jitted ``state -> state`` applying the whole circuit as one
+    XLA program.  ``donate=True`` reuses the input buffer (allocation-free
+    iteration) — callers must not hold other references to the state."""
+    ops = circuit.key()
+    if donate:
+        @partial(jax.jit, donate_argnums=(0,))
+        def run(state: jax.Array) -> jax.Array:
+            for op in ops:
+                state = _apply_one(state, op)
+            return state
+        return run
+
+    def run(state: jax.Array) -> jax.Array:
+        return _run_ops(state, ops)
+
+    return run
+
+
+def apply_circuit(qureg, circuit: Circuit) -> None:
+    """Apply a compiled circuit to a Qureg (statevector path; density quregs
+    get the conjugated shadow ops, cached per (circuit, n))."""
+    if qureg.is_density_matrix:
+        n = qureg.num_qubits_represented
+        cache = getattr(circuit, "_shadow_cache", None)
+        if cache is None or cache[0] != n:
+            ops = []
+            for op in circuit.ops:
+                ops.append(op)
+                ops.append(_shadow_op(op, n))
+            cache = (n, tuple(ops))
+            circuit._shadow_cache = cache
+        qureg.amps = _run_ops(qureg.amps, cache[1])
+    else:
+        qureg.amps = _run_ops(qureg.amps, circuit.key())
+
+
+# ---------------------------------------------------------------------------
+# circuit generators (benchmark workloads; ref analogue: the random-circuit
+# and QFT configs in BASELINE.md)
+# ---------------------------------------------------------------------------
+
+def random_circuit(num_qubits: int, depth: int, seed: int = 0,
+                   entangle: bool = True) -> Circuit:
+    """Depth layers of Haar-random single-qubit gates + a CZ ladder — the
+    standard random-circuit benchmark (BASELINE.md: 20q Clifford+T / 34q
+    random circuit)."""
+    rng = np.random.default_rng(seed)
+    c = Circuit(num_qubits)
+    for layer in range(depth):
+        for q in range(num_qubits):
+            g = rng.normal(size=(2, 2)) + 1j * rng.normal(size=(2, 2))
+            u, r = np.linalg.qr(g)
+            u = u * (np.diag(r) / np.abs(np.diag(r)))
+            c.unitary(q, u)
+        if entangle:
+            for q in range(layer % 2, num_qubits - 1, 2):
+                c.cz(q, q + 1)
+    return c
+
+
+def qft_circuit(num_qubits: int) -> Circuit:
+    """Quantum Fourier transform: H + controlled-phase ladder + reversal swaps
+    (BASELINE.md config 5: 28q QFT — the distributed diagonal-gate path)."""
+    c = Circuit(num_qubits)
+    for q in range(num_qubits - 1, -1, -1):
+        c.h(q)
+        for j in range(q):
+            c.phase_shift(q, math.pi / (1 << (q - j)), controls=(j,))
+    for q in range(num_qubits // 2):
+        c.swap(q, num_qubits - 1 - q)
+    return c

@@ -1,0 +1,84 @@
+"""The whole-circuit compilation layer (no reference analogue — the TPU-native
+fast path).  Checks the fused program agrees with the eager per-gate API and
+with analytic results."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from oracle import NUM_QUBITS, assert_dm, assert_sv, dm, random_statevector, set_sv, sv
+
+N = NUM_QUBITS
+
+
+def test_compiled_random_circuit_matches_eager(env):
+    c = qt.random_circuit(N, depth=3, seed=42)
+    psi = qt.createQureg(N, env)
+    qt.initPlusState(psi)
+    ref = qt.createCloneQureg(psi, env)
+    qt.apply_circuit(psi, c)
+    # replay through the eager API
+    from quest_tpu.circuit import GateOp  # noqa: F401
+    for op in c.ops:
+        if op.kind == "matrix":
+            p = op.payload()
+            u = p[0] + 1j * p[1]
+            qt.multiQubitUnitary(ref, list(op.targets), len(op.targets), u)
+        elif op.kind == "diagonal":
+            p = op.payload()
+            d = p[0] + 1j * p[1]
+            if op.controls:
+                qt.controlledPhaseShift(ref, op.controls[0], op.targets[0],
+                                        float(np.angle(d[1])))
+            else:
+                diag_u = np.diag(d)
+                qt.multiQubitUnitary(ref, list(op.targets), len(op.targets), diag_u)
+        elif op.kind == "x":
+            if op.controls:
+                qt.controlledNot(ref, op.controls[0], op.targets[0])
+            else:
+                qt.pauliX(ref, op.targets[0])
+        elif op.kind == "swap":
+            qt.swapGate(ref, op.targets[0], op.targets[1])
+    np.testing.assert_allclose(sv(psi), sv(ref), atol=1e-12)
+
+
+def test_compiled_circuit_on_density_matrix(env):
+    c = qt.Circuit(N).h(0).cnot(0, 1).rz(1, 0.3).ry(2, -0.7).y(3).x(4, (3,))
+    rho = qt.createDensityQureg(N, env)
+    ref = qt.createDensityQureg(N, env)
+    qt.apply_circuit(rho, c)
+    qt.hadamard(ref, 0)
+    qt.controlledNot(ref, 0, 1)
+    qt.rotateZ(ref, 1, 0.3)
+    qt.rotateY(ref, 2, -0.7)
+    qt.pauliY(ref, 3)
+    qt.controlledNot(ref, 3, 4)
+    np.testing.assert_allclose(sv(rho), sv(ref), atol=1e-12)
+    assert qt.calcTotalProb(rho) == pytest.approx(1.0, abs=1e-12)
+
+
+def test_qft_matches_dft_matrix(env):
+    n = 4
+    dim = 1 << n
+    vec = random_statevector(n)
+    psi = qt.createQureg(n, env)
+    set_sv(psi, vec)
+    qt.apply_circuit(psi, qt.qft_circuit(n))
+    # DFT with positive phase convention: F[y, x] = w^(xy)/sqrt(dim)
+    w = np.exp(2j * np.pi / dim)
+    f = np.array([[w ** (x * y) for x in range(dim)] for y in range(dim)]) / np.sqrt(dim)
+    np.testing.assert_allclose(sv(psi), f @ vec, atol=1e-12)
+
+
+def test_compile_circuit_pure_function(env_local):
+    c = qt.random_circuit(4, depth=2, seed=1)
+    run = qt.compile_circuit(c)
+    psi = qt.createQureg(4, env_local)
+    qt.initZeroState(psi)
+    out = run(psi.amps)
+    assert out.shape == (2, 16)
+    norm = float(np.sum(np.asarray(out) ** 2))
+    assert norm == pytest.approx(1.0, abs=1e-12)
