@@ -1,0 +1,34 @@
+"""Bernstein–Vazirani circuit (ref analogue:
+examples/bernstein_vazirani_circuit.c) — recovers a secret bitstring with one
+oracle query."""
+
+import quest_tpu as qt
+
+num_qubits = 9
+secret_num = 2 ** 4 + 1
+
+env = qt.createQuESTEnv()
+qureg = qt.createQureg(num_qubits, env)
+qt.initZeroState(qureg)
+
+# NOT the ancilla (qubit 0)
+qt.pauliX(qureg, 0)
+
+# CNOT secret bits with the ancilla
+bits = secret_num
+for qb in range(1, num_qubits):
+    bit, bits = bits % 2, bits // 2
+    if bit:
+        qt.controlledNot(qureg, 0, qb)
+
+# probability of reading out the secret string
+success_prob = 1.0
+bits = secret_num
+for qb in range(1, num_qubits):
+    bit, bits = bits % 2, bits // 2
+    success_prob *= qt.calcProbOfOutcome(qureg, qb, bit)
+
+print(f"probability of successfully determining the secret number: {success_prob:g}")
+
+qt.destroyQureg(qureg, env)
+qt.destroyQuESTEnv(env)

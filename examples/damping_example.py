@@ -1,0 +1,25 @@
+"""Single-qubit amplitude damping on a density matrix (ref analogue:
+examples/damping_example.c)."""
+
+import quest_tpu as qt
+
+env = qt.createQuESTEnv()
+
+print("-------------------------------------------------------")
+print("Running quest_tpu damping example:\n\t Basic circuit involving damping of a qubit.")
+print("-------------------------------------------------------")
+
+qubits = qt.createDensityQureg(1, env)
+qt.initPlusState(qubits)
+
+print("\n Reporting the qubit state to screen:")
+qt.reportStateToScreen(qubits, env, 0)
+
+print("\n Applying damping 10 times with probability 0.1")
+for counter in range(10):
+    qt.mixDamping(qubits, 0, 0.1)
+    print(f"\n Qubit state after applying damping {counter + 1} times:")
+    qt.reportStateToScreen(qubits, env, 0)
+
+qt.destroyQureg(qubits, env)
+qt.destroyQuESTEnv(env)

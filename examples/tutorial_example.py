@@ -1,0 +1,59 @@
+"""The 3-qubit tutorial circuit (ref analogue: examples/tutorial_example.c).
+
+Output is bit-identical to the reference binary at float64 — the verification
+anchor for the framework (BASELINE.md config 1)."""
+
+import quest_tpu as qt
+
+env = qt.createQuESTEnv()
+
+print("-------------------------------------------------------")
+print("Running quest_tpu tutorial:\n\t Basic circuit involving a system of 3 qubits.")
+print("-------------------------------------------------------")
+
+qubits = qt.createQureg(3, env)
+qt.initZeroState(qubits)
+
+print("\nThis is our environment:")
+qt.reportQuregParams(qubits)
+qt.reportQuESTEnv(env)
+
+# apply circuit (ref: tutorial_example.c:49-82)
+qt.hadamard(qubits, 0)
+qt.controlledNot(qubits, 0, 1)
+qt.rotateY(qubits, 2, 0.1)
+
+qt.multiControlledPhaseFlip(qubits, [0, 1, 2], 3)
+
+u = qt.ComplexMatrix2(real=[[0.5, 0.5], [0.5, 0.5]],
+                      imag=[[0.5, -0.5], [-0.5, 0.5]])
+qt.unitary(qubits, 0, u)
+
+a = qt.Complex(0.5, 0.5)
+b = qt.Complex(0.5, -0.5)
+qt.compactUnitary(qubits, 1, a, b)
+
+v = qt.Vector(1, 0, 0)
+qt.rotateAroundAxis(qubits, 2, 3.14 / 2, v)
+
+qt.controlledCompactUnitary(qubits, 0, 1, a, b)
+qt.multiControlledUnitary(qubits, [0, 1], 2, 2, u)
+
+toff = qt.createComplexMatrixN(3)
+toff[6, 7] = 1
+toff[7, 6] = 1
+for i in range(6):
+    toff[i, i] = 1
+qt.multiQubitUnitary(qubits, [0, 1, 2], 3, toff)
+
+# study the output state
+print("\nCircuit output:")
+print(f"Probability amplitude of |111>: {qt.getProbAmp(qubits, 7):g}")
+print(f"Probability of qubit 2 being in state 1: {qt.calcProbOfOutcome(qubits, 2, 1):g}")
+outcome = qt.measure(qubits, 0)
+print(f"Qubit 0 was measured in state {outcome}")
+outcome, prob = qt.measureWithStats(qubits, 2)
+print(f"Qubit 2 collapsed to {outcome} with probability {prob:g}")
+
+qt.destroyQureg(qubits, env)
+qt.destroyQuESTEnv(env)
