@@ -1,0 +1,8 @@
+"""Algorithm library: canonical circuit families as :class:`quest_tpu.Circuit`
+builders.  The reference ships these only as examples (examples/*.c); here
+they are first-class, compiled workloads (and the benchmark configs of
+BASELINE.md)."""
+
+from .algorithms import (bernstein_vazirani_circuit, ghz_circuit,  # noqa: F401
+                         grover_circuit, phase_estimation_circuit,
+                         qft_circuit, random_circuit, trotter_circuit)

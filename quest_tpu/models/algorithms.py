@@ -1,0 +1,172 @@
+"""Canonical quantum-algorithm circuit builders.
+
+All return :class:`quest_tpu.circuit.Circuit` objects that compile to single
+fused XLA programs via ``compile_circuit`` / ``apply_circuit``.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..circuit import Circuit, qft_circuit, random_circuit  # noqa: F401
+
+
+def ghz_circuit(num_qubits: int) -> Circuit:
+    """|0..0> + |1..1> (unnormalised notation): H then a CNOT chain."""
+    c = Circuit(num_qubits)
+    c.h(0)
+    for q in range(num_qubits - 1):
+        c.cnot(q, q + 1)
+    return c
+
+
+def bernstein_vazirani_circuit(num_qubits: int, secret: int) -> Circuit:
+    """One-query secret-string recovery (ref analogue:
+    examples/bernstein_vazirani_circuit.c — qubit 0 is the ancilla)."""
+    c = Circuit(num_qubits)
+    c.x(0)
+    bits = secret
+    for qb in range(1, num_qubits):
+        if bits & 1:
+            c.cnot(0, qb)
+        bits >>= 1
+    return c
+
+
+def grover_circuit(num_qubits: int, marked: int, iterations: int | None = None) -> Circuit:
+    """Grover search for basis state ``marked`` on n qubits."""
+    n = num_qubits
+    if iterations is None:
+        iterations = max(1, int(round(math.pi / 4 * math.sqrt(2 ** n))))
+    c = Circuit(n)
+    for q in range(n):
+        c.h(q)
+    for _ in range(iterations):
+        # oracle: phase-flip |marked> — Z on qubit n-1 controlled on the rest
+        # matching the marked bit pattern
+        controls = tuple(range(n - 1))
+        states = tuple((marked >> q) & 1 for q in range(n - 1))
+        if (marked >> (n - 1)) & 1:
+            c.ops.append(_controlled_z(n - 1, controls, states))
+        else:
+            c.x(n - 1)
+            c.ops.append(_controlled_z(n - 1, controls, states))
+            c.x(n - 1)
+        # diffusion: H X (multi-controlled Z) X H
+        for q in range(n):
+            c.h(q)
+        for q in range(n):
+            c.x(q)
+        c.z(n - 1, controls=tuple(range(n - 1)))
+        for q in range(n):
+            c.x(q)
+        for q in range(n):
+            c.h(q)
+    return c
+
+
+def _controlled_z(target: int, controls, states):
+    from ..circuit import GateOp
+    dp = np.stack([np.array([1.0, -1.0]), np.zeros(2)])
+    return GateOp("diagonal", (target,), tuple(controls), tuple(states),
+                  tuple(dp.ravel()), dp.shape)
+
+
+def phase_estimation_circuit(num_eval_qubits: int, phase: float) -> Circuit:
+    """Estimate the eigenphase of a Z-rotation eigenstate: ``phase`` in [0,1)
+    appears on the evaluation register after an inverse QFT.
+
+    Layout: qubits [0, m) = evaluation register, qubit m = eigenstate |1>."""
+    m = num_eval_qubits
+    c = Circuit(m + 1)
+    c.x(m)  # eigenstate |1> of the phase gate
+    for q in range(m):
+        c.h(q)
+    for q in range(m):
+        # controlled-U^(2^q), U = diag(1, e^{2 pi i phase})
+        c.phase_shift(m, 2 * math.pi * phase * (1 << q), controls=(q,))
+    # inverse QFT on the evaluation register (reverse the QFT gate sequence,
+    # conjugating the phases)
+    fwd = qft_circuit(m)
+    inv_ops = []
+    for op in reversed(fwd.ops):
+        if op.kind == "diagonal":
+            p = np.asarray(op.matrix, dtype=np.float64).reshape(op.shape)
+            conj = np.stack([p[0], -p[1]])
+            from ..circuit import GateOp
+            inv_ops.append(GateOp("diagonal", op.targets, op.controls,
+                                  op.control_states, tuple(conj.ravel()), op.shape))
+        elif op.kind == "matrix":
+            p = np.asarray(op.matrix, dtype=np.float64).reshape(op.shape)
+            # unitary inverse = conjugate transpose
+            inv = np.stack([p[0].T, -p[1].T])
+            from ..circuit import GateOp
+            inv_ops.append(GateOp("matrix", op.targets, op.controls,
+                                  op.control_states, tuple(inv.ravel()), op.shape))
+        else:
+            inv_ops.append(op)  # swap / x are self-inverse
+    # shift eval-register ops are already on qubits [0, m)
+    c.ops.extend(inv_ops)
+    return c
+
+
+def trotter_circuit(hamil, time: float, order: int, reps: int) -> Circuit:
+    """Symmetrized Suzuki-Trotter circuit of a PauliHamil as a compiled
+    Circuit (the fused-program twin of applyTrotterCircuit, which follows the
+    reference's recursion — QuEST_common.c:698-780)."""
+    from ..matrices import PAULI_MATRICES
+
+    n = hamil.num_qubits
+    c = Circuit(n)
+
+    def add_exp_term(coeff, codes, t):
+        # exp(-i coeff t P): basis-change each qubit to Z, multiRotateZ, undo
+        targets = [q for q in range(n) if codes[q] != 0]
+        if not targets:
+            # global phase e^{-i coeff t}: fold into a 1-qubit diagonal
+            ph = np.exp(-1j * coeff * t)
+            c._diag([ph, ph], (0,))
+            return
+        h = np.array([[1, 1], [1, -1]]) / math.sqrt(2)
+        sdg_h = np.array([[1, -1j], [1, 1j]]) / math.sqrt(2)  # Y -> Z basis
+        for q in targets:
+            if codes[q] == 1:
+                c._mat(h, (q,))
+            elif codes[q] == 2:
+                c._mat(sdg_h, (q,))
+        # exp(-i (coeff t) Z..Z) = multiRotateZ with angle 2*coeff*t
+        angle = 2.0 * coeff * t
+        dim = 1 << len(targets)
+        diag = np.array([np.exp(-1j * angle / 2 * (1 - 2 * (bin(i).count("1") % 2)))
+                         for i in range(dim)])
+        c._diag(diag, tuple(targets))
+        for q in targets:
+            if codes[q] == 1:
+                c._mat(h, (q,))
+            elif codes[q] == 2:
+                c._mat(sdg_h.conj().T, (q,))
+
+    def trotterize(t, ord_):
+        terms = list(range(hamil.num_sum_terms))
+        if ord_ == 1:
+            for k in terms:
+                add_exp_term(hamil.term_coeffs[k], hamil.pauli_codes[k], t)
+        elif ord_ == 2:
+            for k in terms:
+                add_exp_term(hamil.term_coeffs[k], hamil.pauli_codes[k], t / 2)
+            for k in reversed(terms):
+                add_exp_term(hamil.term_coeffs[k], hamil.pauli_codes[k], t / 2)
+        else:
+            # Suzuki recursion (ref: QuEST_common.c:744-762)
+            p = 1.0 / (4 - 4 ** (1.0 / (ord_ - 1)))
+            for _ in range(2):
+                trotterize(p * t, ord_ - 2)
+            trotterize((1 - 4 * p) * t, ord_ - 2)
+            for _ in range(2):
+                trotterize(p * t, ord_ - 2)
+
+    for _ in range(reps):
+        trotterize(time / reps, order)
+    return c

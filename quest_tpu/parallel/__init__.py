@@ -1,0 +1,23 @@
+"""Distribution layer: device meshes, amplitude sharding, explicit collectives.
+
+The reference's distribution is component 10 of SURVEY.md §2 — an MPI
+communication planner (QuEST_cpu_distributed.c) deciding per gate whether a
+pairwise chunk exchange is needed.  Here the same decisions exist at three
+levels:
+
+1. implicit — every op in quest_tpu.ops is a pure jnp program; GSPMD
+   partitions it over the mesh and inserts collective-permute / all-gather /
+   psum automatically (the default path, used by the API layer);
+2. explicit — :mod:`.collectives` provides shard_map-based building blocks
+   (pairwise exchange over a hypercube edge, global reductions) mirroring the
+   reference's primitives one-for-one, for kernels that want manual control;
+3. diagnostic — :mod:`.planner` reports which gates of a circuit are
+   shard-local vs cross-shard for a given mesh, the analogue of the
+   reference's halfMatrixBlockFitsInChunk decision procedure
+   (QuEST_cpu_distributed.c:356-361).
+"""
+
+from .mesh import make_amps_mesh, amp_sharding, replicated_sharding  # noqa: F401
+from .collectives import (pairwise_exchange, global_sum,  # noqa: F401
+                          gather_full_state)
+from .planner import comm_plan, is_shard_local  # noqa: F401

@@ -1,0 +1,66 @@
+"""Explicit shard-level collectives via shard_map.
+
+One-for-one TPU translations of the reference's MPI primitives (SURVEY §2b):
+
+| reference (QuEST_cpu_distributed.c)        | here                       |
+|--------------------------------------------|----------------------------|
+| exchangeStateVectors MPI_Sendrecv (:479)   | ``pairwise_exchange``      |
+| MPI_Allreduce(SUM) (:88, :1260, ...)       | ``global_sum``             |
+| copyVecIntoMatrixPairState MPI_Bcast (:371)| ``gather_full_state``      |
+
+The default API path never calls these — GSPMD derives the same collectives
+from sharding propagation.  They exist for manual-control kernels (ring
+pipelines, Pallas RDMA experiments) and as an executable specification of the
+communication pattern.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from .mesh import AMPS_AXIS
+
+
+def pairwise_exchange(state: jax.Array, mesh: Mesh, distance: int) -> jax.Array:
+    """Exchange whole shards between partner devices ``d`` and ``d ^ distance``
+    (the hypercube edge of a gate on sharded qubit ``log2(distance)`` above
+    the local range — ref: getChunkPairId, QuEST_cpu_distributed.c:303-312).
+
+    Returns the partner's shard in place of ours (the reference's
+    pairStateVec, without the 2x memory mirror: XLA streams the permute)."""
+    n_dev = mesh.devices.size
+    perm = [(d, d ^ distance) for d in range(n_dev)]
+
+    @partial(shard_map, mesh=mesh, in_specs=P(None, AMPS_AXIS),
+             out_specs=P(None, AMPS_AXIS))
+    def exchange(shard):
+        return jax.lax.ppermute(shard, AMPS_AXIS, perm)
+
+    return exchange(state)
+
+
+def global_sum(values: jax.Array, mesh: Mesh) -> jax.Array:
+    """Sum a per-shard reduction across the mesh (ref: MPI_Allreduce(SUM))."""
+
+    @partial(shard_map, mesh=mesh, in_specs=P(None, AMPS_AXIS), out_specs=P())
+    def reduce(shard):
+        return jax.lax.psum(jnp.sum(shard, axis=-1, keepdims=True), AMPS_AXIS)
+
+    return jnp.sum(reduce(values))
+
+
+def gather_full_state(state: jax.Array, mesh: Mesh) -> jax.Array:
+    """Replicate the full state onto every device (ref: the rotating MPI_Bcast
+    of copyVecIntoMatrixPairState, QuEST_cpu_distributed.c:371-413)."""
+
+    @partial(shard_map, mesh=mesh, in_specs=P(None, AMPS_AXIS),
+             out_specs=P(None), check_vma=False)
+    def gather(shard):
+        return jax.lax.all_gather(shard, AMPS_AXIS, axis=1, tiled=True)
+
+    return gather(state)

@@ -1,0 +1,57 @@
+"""Communication planner / diagnostics.
+
+The reference decides at runtime, per gate, whether MPI communication is
+needed (halfMatrixBlockFitsInChunk, QuEST_cpu_distributed.c:356-361) and
+routes dense multi-target gates through swap-rerouting (:1381-1479).  Under
+GSPMD those decisions are made by the partitioner at compile time; this
+module reproduces them as an inspectable plan so users can see — before
+compiling — which gates of a circuit will ride ICI and what each costs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+def is_shard_local(target: int, num_qubits: int, num_devices: int) -> bool:
+    """A gate on ``target`` touches only in-shard amplitude pairs iff the
+    target lies below the sharded range (ref: halfMatrixBlockFitsInChunk)."""
+    if num_devices <= 1:
+        return True
+    local_qubits = num_qubits - (num_devices.bit_length() - 1)
+    return target < local_qubits
+
+
+@dataclasses.dataclass
+class GatePlan:
+    index: int
+    kind: str
+    targets: tuple
+    local: bool
+    comm: str          # 'none' | 'permute' | 'reshard'
+    bytes_moved: int   # per device, one direction
+
+
+def comm_plan(circuit, num_devices: int, bytes_per_amp: int = 8) -> list:
+    """Static communication plan of a :class:`quest_tpu.Circuit` over an
+    n-device amplitude mesh.  ``bytes_per_amp`` defaults to f32 SoA (8 B)."""
+    n = circuit.num_qubits
+    shard_amps = (1 << n) // num_devices
+    plans = []
+    for i, op in enumerate(circuit.ops):
+        if op.kind == "diagonal":
+            # diagonal gates never move data (ref: QuEST_cpu.c:2978-3109)
+            plans.append(GatePlan(i, op.kind, op.targets, True, "none", 0))
+            continue
+        cross = [t for t in op.targets if not is_shard_local(t, n, num_devices)]
+        if not cross:
+            plans.append(GatePlan(i, op.kind, op.targets, True, "none", 0))
+        elif len(op.targets) == 1:
+            plans.append(GatePlan(i, op.kind, op.targets, False, "permute",
+                                  shard_amps * bytes_per_amp))
+        else:
+            # dense multi-target with sharded targets: GSPMD reshards (the
+            # reference's swap-rerouting, one all-to-all each way)
+            plans.append(GatePlan(i, op.kind, op.targets, False, "reshard",
+                                  2 * shard_amps * bytes_per_amp))
+    return plans

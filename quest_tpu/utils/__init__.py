@@ -1,0 +1,9 @@
+"""Auxiliary subsystems: checkpointing, profiling, environment reporting.
+
+The reference has none of these (SURVEY §5: no tracing, no checkpointing —
+its nearest checkpoint equivalent is a CSV dump via reportState,
+QuEST_common.c:216-232).  They are first-class here because long distributed
+simulations on pods need them."""
+
+from .checkpoint import save_qureg, load_qureg  # noqa: F401
+from .profiling import trace, annotate  # noqa: F401

@@ -1,0 +1,63 @@
+"""Sharded checkpoint save/restore for Quregs.
+
+The reference's only full-state escape hatches are setAmps/getAmp and a CSV
+dump (ref: QuEST.c:781-795, QuEST_common.c:216-232) — nothing resumable.
+Here a Qureg checkpoints to a directory of per-shard ``.npy`` files plus a
+JSON manifest, written shard-by-shard from each device buffer (no full-state
+host materialisation), and restores onto any mesh whose sharding divides the
+amplitude count — the idiomatic orbax-style layout without requiring the
+orbax dependency for a plain array pair.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import numpy as np
+
+
+def save_qureg(qureg, directory: str) -> None:
+    """Write the Qureg's amplitudes and metadata under ``directory``."""
+    os.makedirs(directory, exist_ok=True)
+    meta = {
+        "num_qubits": qureg.num_qubits_represented,
+        "is_density_matrix": bool(qureg.is_density_matrix),
+        "dtype": str(np.dtype(qureg.dtype)),
+        "num_shards": 0,
+    }
+    shards = []
+    amps = qureg.amps
+    # write each addressable shard without gathering the full state
+    for i, shard in enumerate(sorted(amps.addressable_shards,
+                                     key=lambda s: s.index[1].start or 0)):
+        fn = f"shard_{i:05d}.npy"
+        np.save(os.path.join(directory, fn), np.asarray(shard.data))
+        start = shard.index[1].start or 0
+        shards.append({"file": fn, "start": int(start)})
+    meta["num_shards"] = len(shards)
+    meta["shards"] = shards
+    with open(os.path.join(directory, "manifest.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+
+
+def load_qureg(directory: str, env):
+    """Recreate a Qureg from a checkpoint directory onto ``env``'s mesh."""
+    import quest_tpu as qt
+
+    with open(os.path.join(directory, "manifest.json")) as f:
+        meta = json.load(f)
+    n = meta["num_qubits"]
+    if meta["is_density_matrix"]:
+        q = qt.createDensityQureg(n, env)
+    else:
+        q = qt.createQureg(n, env)
+    total = q.num_amps_total
+    full = np.zeros((2, total), dtype=np.dtype(meta["dtype"]))
+    for rec in meta["shards"]:
+        data = np.load(os.path.join(directory, rec["file"]))
+        full[:, rec["start"]:rec["start"] + data.shape[1]] = data
+    arr = jax.numpy.asarray(full)
+    q.set_amps_array(arr)
+    return q

@@ -1,0 +1,24 @@
+"""Tracing/profiling hooks — a new capability over the reference, which has
+no observability beyond reportState (SURVEY §5).  Thin wrappers over the JAX
+profiler so simulations can be inspected in XProf/TensorBoard."""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a device trace of the enclosed block into ``log_dir``."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region that shows up on the trace timeline."""
+    return jax.profiler.TraceAnnotation(name)

@@ -1,0 +1,155 @@
+"""Distribution building blocks, checkpointing, and the algorithm library."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu.models import (bernstein_vazirani_circuit, ghz_circuit,
+                              grover_circuit, phase_estimation_circuit,
+                              trotter_circuit)
+from quest_tpu.parallel import (comm_plan, gather_full_state, global_sum,
+                                is_shard_local, pairwise_exchange)
+from quest_tpu.utils import load_qureg, save_qureg
+from oracle import NUM_QUBITS, assert_sv, random_statevector, set_sv, sv
+
+N = NUM_QUBITS
+
+
+# ---------------------------------------------------------------------------
+# parallel
+# ---------------------------------------------------------------------------
+
+def test_pairwise_exchange(env_dist):
+    q = qt.createQureg(N, env_dist)
+    qt.initDebugState(q)
+    before = np.asarray(q.amps).copy()
+    out = pairwise_exchange(q.amps, env_dist.mesh, distance=1)
+    got = np.asarray(out)
+    # device d's window now holds device d^1's window
+    shard = before.shape[1] // 8
+    for d in range(8):
+        np.testing.assert_array_equal(
+            got[:, d * shard:(d + 1) * shard],
+            before[:, (d ^ 1) * shard:((d ^ 1) + 1) * shard])
+
+
+def test_global_sum(env_dist):
+    q = qt.createQureg(N, env_dist)
+    qt.initPlusState(q)
+    total = float(global_sum(q.amps ** 2, env_dist.mesh))
+    assert total == pytest.approx(1.0, abs=1e-12)
+
+
+def test_gather_full_state(env_dist):
+    q = qt.createQureg(N, env_dist)
+    qt.initDebugState(q)
+    full = gather_full_state(q.amps, env_dist.mesh)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(q.amps))
+
+
+def test_is_shard_local():
+    # 10 qubits over 8 devices: 7 local qubits per shard
+    assert is_shard_local(6, 10, 8)
+    assert not is_shard_local(7, 10, 8)
+    assert is_shard_local(9, 10, 1)
+
+
+def test_comm_plan():
+    c = qt.Circuit(10).h(0).h(9).phase_shift(9, 0.3).swap(0, 9)
+    plans = comm_plan(c, num_devices=8)
+    assert [p.comm for p in plans] == ["none", "permute", "none", "reshard"]
+    assert plans[1].bytes_moved == (1 << 10) // 8 * 8
+
+
+# ---------------------------------------------------------------------------
+# checkpoint
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_roundtrip(env, tmp_path):
+    vec = random_statevector(N)
+    q = qt.createQureg(N, env)
+    set_sv(q, vec)
+    save_qureg(q, str(tmp_path / "ckpt"))
+    q2 = load_qureg(str(tmp_path / "ckpt"), env)
+    assert_sv(q2, vec)
+    assert not q2.is_density_matrix
+
+
+def test_checkpoint_density(env, tmp_path):
+    q = qt.createDensityQureg(3, env)
+    qt.hadamard(q, 0)
+    qt.mixDamping(q, 0, 0.2)
+    ref = np.asarray(q.amps).copy()
+    save_qureg(q, str(tmp_path / "dm"))
+    q2 = load_qureg(str(tmp_path / "dm"), env)
+    np.testing.assert_allclose(np.asarray(q2.amps), ref)
+    assert q2.is_density_matrix
+
+
+# ---------------------------------------------------------------------------
+# models / algorithms
+# ---------------------------------------------------------------------------
+
+def test_ghz_circuit(env):
+    q = qt.createQureg(N, env)
+    qt.apply_circuit(q, ghz_circuit(N))
+    v = sv(q)
+    s = 1 / np.sqrt(2)
+    expected = np.zeros(1 << N, dtype=complex)
+    expected[0] = s
+    expected[-1] = s
+    np.testing.assert_allclose(v, expected, atol=1e-12)
+
+
+def test_bernstein_vazirani(env):
+    secret = 0b1011
+    q = qt.createQureg(6, env)
+    qt.apply_circuit(q, bernstein_vazirani_circuit(6, secret))
+    prob = 1.0
+    bits = secret
+    for qb in range(1, 6):
+        prob *= qt.calcProbOfOutcome(q, qb, bits & 1)
+        bits >>= 1
+    assert prob == pytest.approx(1.0, abs=1e-12)
+
+
+def test_grover(env):
+    n, marked = 4, 0b1010
+    q = qt.createQureg(n, env)
+    qt.apply_circuit(q, grover_circuit(n, marked))
+    probs = np.abs(sv(q)) ** 2
+    assert probs.argmax() == marked
+    assert probs[marked] > 0.9
+
+
+def test_phase_estimation(env):
+    m, phase = 4, 5 / 16  # exactly representable in 4 bits
+    q = qt.createQureg(m + 1, env)
+    qt.apply_circuit(q, phase_estimation_circuit(m, phase))
+    probs = np.abs(sv(q)) ** 2
+    # eval register (qubits 0..m-1) should read the phase numerator; qubit m=1
+    best = probs.argmax()
+    assert (best >> m) & 1 == 1
+    # the QFT convention may bit-reverse; accept the numerator either way
+    read = best & ((1 << m) - 1)
+    rev = int(format(read, f"0{m}b")[::-1], 2)
+    assert 5 in (read, rev)
+
+
+def test_trotter_circuit_matches_api(env):
+    np.random.seed(23)
+    num_terms = 3
+    codes = np.random.randint(0, 4, size=(num_terms, N))
+    coeffs = np.random.randn(num_terms)
+    hamil = qt.createPauliHamil(N, num_terms)
+    qt.initPauliHamil(hamil, coeffs, codes.ravel())
+    vec = random_statevector(N)
+    q1 = qt.createQureg(N, env)
+    q2 = qt.createQureg(N, env)
+    set_sv(q1, vec)
+    set_sv(q2, vec)
+    qt.applyTrotterCircuit(q1, hamil, 0.3, 2, 3)
+    qt.apply_circuit(q2, trotter_circuit(hamil, 0.3, 2, 3))
+    np.testing.assert_allclose(sv(q2), sv(q1), atol=1e-10)
