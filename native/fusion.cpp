@@ -1,0 +1,291 @@
+// Gate-fusion engine: the native circuit optimizer behind Circuit.optimize().
+//
+// Role analogue in the reference: QuEST has no circuit optimizer — every API
+// call dispatches its own kernel (ref: QuEST/src/QuEST.c:177-660).  On TPU,
+// where every fused gate saves a full HBM pass over the 2^n amplitude array,
+// a scheduler that merges gates before compilation is the single cheapest
+// performance lever, and it belongs in native code like the reference's
+// dispatch layer does.
+//
+// IR: a flat stream of GateRec records (see fusion.h).  The optimizer makes
+// repeated peephole passes:
+//   1. adjacent dense 1q gates on the same target merge into one 2x2 product;
+//   2. adjacent diagonal gates on identical (targets, controls) merge
+//      elementwise;
+//   3. self-inverse cancellations (X X -> id, SWAP SWAP -> id);
+//   4. commuting sink: a gate may hop left over gates acting on disjoint
+//      qubits (and diagonals hop over diagonals on any qubits) to reach a
+//      merge partner.
+// Passes repeat until a fixed point.
+//
+// C ABI only (called from Python via ctypes): quest_fuse_circuit takes the
+// packed op stream and returns a malloc'd packed stream the caller frees
+// with quest_free_buffer.
+
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+#include <complex>
+
+namespace {
+
+enum Kind : int32_t {
+    KIND_MATRIX = 0,
+    KIND_DIAGONAL = 1,
+    KIND_X = 2,
+    KIND_Y = 3,
+    KIND_YCONJ = 4,
+    KIND_SWAP = 5,
+};
+
+struct Gate {
+    int32_t kind;
+    std::vector<int32_t> targets;
+    std::vector<int32_t> controls;
+    std::vector<int32_t> control_states;
+    // matrix payload: dense (2*d*d doubles, re-plane then im-plane, d=2^k)
+    // or diagonal (2*d doubles)
+    std::vector<double> payload;
+
+    bool same_wires(const Gate& o) const {
+        return targets == o.targets && controls == o.controls &&
+               control_states == o.control_states;
+    }
+    bool touches(int32_t q) const {
+        for (int32_t t : targets) if (t == q) return true;
+        for (int32_t c : controls) if (c == q) return true;
+        return false;
+    }
+    bool disjoint(const Gate& o) const {
+        for (int32_t t : o.targets) if (touches(t)) return false;
+        for (int32_t c : o.controls) if (touches(c)) return false;
+        return true;
+    }
+    bool diagonal_like() const { return kind == KIND_DIAGONAL; }
+};
+
+// ---- (de)serialisation ----------------------------------------------------
+// Stream layout (all little-endian host types):
+//   int64 num_gates
+//   per gate:
+//     int32 kind, int32 nt, int32 nc, int64 payload_len
+//     int32 targets[nt], int32 controls[nc], int32 control_states[nc]
+//     double payload[payload_len]
+
+std::vector<Gate> parse(const uint8_t* buf, int64_t len) {
+    std::vector<Gate> gates;
+    const uint8_t* p = buf;
+    const uint8_t* end = buf + len;
+    int64_t n;
+    std::memcpy(&n, p, 8); p += 8;
+    gates.reserve(static_cast<size_t>(n));
+    for (int64_t i = 0; i < n && p < end; i++) {
+        Gate g;
+        int32_t nt, nc; int64_t pl;
+        std::memcpy(&g.kind, p, 4); p += 4;
+        std::memcpy(&nt, p, 4); p += 4;
+        std::memcpy(&nc, p, 4); p += 4;
+        std::memcpy(&pl, p, 8); p += 8;
+        g.targets.resize(nt);
+        std::memcpy(g.targets.data(), p, 4 * nt); p += 4 * nt;
+        g.controls.resize(nc);
+        std::memcpy(g.controls.data(), p, 4 * nc); p += 4 * nc;
+        g.control_states.resize(nc);
+        std::memcpy(g.control_states.data(), p, 4 * nc); p += 4 * nc;
+        g.payload.resize(pl);
+        std::memcpy(g.payload.data(), p, 8 * pl); p += 8 * pl;
+        gates.push_back(std::move(g));
+    }
+    return gates;
+}
+
+std::vector<uint8_t> serialise(const std::vector<Gate>& gates) {
+    size_t bytes = 8;
+    for (const Gate& g : gates)
+        bytes += 4 + 4 + 4 + 8 + 4 * g.targets.size() + 8 * g.controls.size()
+               + 8 * g.payload.size();
+    std::vector<uint8_t> out(bytes);
+    uint8_t* p = out.data();
+    int64_t n = static_cast<int64_t>(gates.size());
+    std::memcpy(p, &n, 8); p += 8;
+    for (const Gate& g : gates) {
+        int32_t nt = static_cast<int32_t>(g.targets.size());
+        int32_t nc = static_cast<int32_t>(g.controls.size());
+        int64_t pl = static_cast<int64_t>(g.payload.size());
+        std::memcpy(p, &g.kind, 4); p += 4;
+        std::memcpy(p, &nt, 4); p += 4;
+        std::memcpy(p, &nc, 4); p += 4;
+        std::memcpy(p, &pl, 8); p += 8;
+        std::memcpy(p, g.targets.data(), 4 * nt); p += 4 * nt;
+        std::memcpy(p, g.controls.data(), 4 * nc); p += 4 * nc;
+        std::memcpy(p, g.control_states.data(), 4 * nc); p += 4 * nc;
+        std::memcpy(p, g.payload.data(), 8 * pl); p += 8 * pl;
+    }
+    return out;
+}
+
+// ---- algebra --------------------------------------------------------------
+
+using cd = std::complex<double>;
+
+// payload (2 planes of d*d) -> complex matrix
+std::vector<cd> to_complex_mat(const Gate& g, int64_t d) {
+    std::vector<cd> m(d * d);
+    for (int64_t i = 0; i < d * d; i++)
+        m[i] = cd(g.payload[i], g.payload[d * d + i]);
+    return m;
+}
+
+void from_complex_mat(Gate& g, const std::vector<cd>& m, int64_t d) {
+    g.payload.resize(2 * d * d);
+    for (int64_t i = 0; i < d * d; i++) {
+        g.payload[i] = m[i].real();
+        g.payload[d * d + i] = m[i].imag();
+    }
+}
+
+// b_after * a_first (matrix product: later gate left-multiplies)
+bool merge_dense(Gate& first, const Gate& later) {
+    if (first.targets.size() != 1 || later.targets.size() != 1) return false;
+    std::vector<cd> a = to_complex_mat(first, 2);
+    std::vector<cd> b = to_complex_mat(later, 2);
+    std::vector<cd> c(4);
+    c[0] = b[0] * a[0] + b[1] * a[2];
+    c[1] = b[0] * a[1] + b[1] * a[3];
+    c[2] = b[2] * a[0] + b[3] * a[2];
+    c[3] = b[2] * a[1] + b[3] * a[3];
+    from_complex_mat(first, c, 2);
+    return true;
+}
+
+bool merge_diagonal(Gate& first, const Gate& later) {
+    int64_t d = static_cast<int64_t>(first.payload.size()) / 2;
+    if (static_cast<int64_t>(later.payload.size()) / 2 != d) return false;
+    for (int64_t i = 0; i < d; i++) {
+        cd a(first.payload[i], first.payload[d + i]);
+        cd b(later.payload[i], later.payload[d + i]);
+        cd c = a * b;
+        first.payload[i] = c.real();
+        first.payload[d + i] = c.imag();
+    }
+    return true;
+}
+
+// promote an X/Y gate (no controls) to its dense 2x2 so it can fuse
+void densify(Gate& g) {
+    if (g.kind == KIND_X) {
+        g.kind = KIND_MATRIX;
+        g.payload = {0, 1, 1, 0, 0, 0, 0, 0};
+    } else if (g.kind == KIND_Y || g.kind == KIND_YCONJ) {
+        double s = (g.kind == KIND_Y) ? 1.0 : -1.0;
+        g.kind = KIND_MATRIX;
+        g.payload = {0, 0, 0, 0, 0, -s, s, 0};
+    } else if (g.kind == KIND_DIAGONAL && g.targets.size() == 1) {
+        g.kind = KIND_MATRIX;
+        g.payload = {g.payload[0], 0, 0, g.payload[1],
+                     g.payload[2], 0, 0, g.payload[3]};
+    }
+}
+
+bool is_dense_1q_candidate(const Gate& g) {
+    // controls allowed: same_wires guarantees both gates share them, and
+    // ctrl-U then ctrl-V on identical wires is ctrl-(V*U)
+    return g.targets.size() == 1 &&
+           (g.kind == KIND_MATRIX || g.kind == KIND_X || g.kind == KIND_Y ||
+            g.kind == KIND_YCONJ || g.kind == KIND_DIAGONAL);
+}
+
+bool is_identity(const Gate& g) {
+    constexpr double eps = 1e-14;
+    if (g.kind == KIND_DIAGONAL) {
+        int64_t d = static_cast<int64_t>(g.payload.size()) / 2;
+        for (int64_t i = 0; i < d; i++)
+            if (std::abs(g.payload[i] - 1.0) > eps ||
+                std::abs(g.payload[d + i]) > eps) return false;
+        return true;
+    }
+    if (g.kind == KIND_MATRIX) {
+        int64_t dd = static_cast<int64_t>(g.payload.size()) / 2;
+        int64_t d = 1;
+        while (d * d < dd) d++;
+        if (d * d != dd) return false;
+        for (int64_t r = 0; r < d; r++)
+            for (int64_t c = 0; c < d; c++) {
+                double want = (r == c) ? 1.0 : 0.0;
+                if (std::abs(g.payload[r * d + c] - want) > eps ||
+                    std::abs(g.payload[dd + r * d + c]) > eps) return false;
+            }
+        return true;
+    }
+    return false;
+}
+
+// can `g` hop left over `prev`?
+bool commutes_past(const Gate& g, const Gate& prev) {
+    if (g.disjoint(prev)) return true;
+    // diagonals commute with diagonals regardless of wire overlap
+    if (g.diagonal_like() && prev.diagonal_like()) return true;
+    return false;
+}
+
+bool one_pass(std::vector<Gate>& gates) {
+    bool changed = false;
+    std::vector<Gate> out;
+    out.reserve(gates.size());
+    for (Gate& g : gates) {
+        bool merged = false;
+        // look backwards for a merge partner this gate can reach
+        for (int64_t j = static_cast<int64_t>(out.size()) - 1; j >= 0; j--) {
+            Gate& cand = out[j];
+            // identical-wire merges
+            if (cand.same_wires(g)) {
+                if (g.kind == KIND_DIAGONAL && cand.kind == KIND_DIAGONAL) {
+                    merged = merge_diagonal(cand, g);
+                } else if (is_dense_1q_candidate(g) && is_dense_1q_candidate(cand)) {
+                    densify(cand); densify(g);
+                    merged = merge_dense(cand, g);
+                } else if (g.kind == cand.kind &&
+                           (g.kind == KIND_X || g.kind == KIND_SWAP)) {
+                    out.erase(out.begin() + j);  // self-inverse pair cancels
+                    merged = true;
+                }
+                if (merged) {
+                    changed = true;
+                    if (j < static_cast<int64_t>(out.size()) &&
+                        is_identity(out[j]))
+                        out.erase(out.begin() + j);
+                }
+                break;
+            }
+            if (!commutes_past(g, cand)) break;
+        }
+        if (!merged) out.push_back(std::move(g));
+    }
+    gates = std::move(out);
+    return changed;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Fuse the packed circuit; returns a malloc'd packed stream (caller frees
+// with quest_free_buffer) and writes its length to *out_len.
+uint8_t* quest_fuse_circuit(const uint8_t* buf, int64_t len, int64_t* out_len) {
+    std::vector<Gate> gates = parse(buf, len);
+    for (int pass = 0; pass < 32; pass++)
+        if (!one_pass(gates)) break;
+    std::vector<uint8_t> out = serialise(gates);
+    uint8_t* result = static_cast<uint8_t*>(std::malloc(out.size()));
+    std::memcpy(result, out.data(), out.size());
+    *out_len = static_cast<int64_t>(out.size());
+    return result;
+}
+
+void quest_free_buffer(uint8_t* buf) { std::free(buf); }
+
+int64_t quest_fusion_abi_version() { return 1; }
+
+}  // extern "C"

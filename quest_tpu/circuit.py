@@ -134,6 +134,15 @@ class Circuit:
     def key(self) -> tuple:
         return tuple(self.ops)
 
+    def optimize(self) -> "Circuit":
+        """Run the native gate-fusion engine (native/fusion.cpp): merges
+        adjacent/commuting gates so the compiled program makes fewer HBM
+        passes.  No-op if the native library is unavailable."""
+        from .native import fuse_ops
+        self.ops = fuse_ops(self.ops)
+        self._shadow_cache = None
+        return self
+
 
 def _apply_one(state: jax.Array, op: GateOp) -> jax.Array:
     if op.kind == "matrix":

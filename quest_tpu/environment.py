@@ -16,13 +16,12 @@ from __future__ import annotations
 import dataclasses
 
 import jax
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
 
 from . import rng
+from .parallel.mesh import (AMPS_AXIS, amp_sharding,  # noqa: F401
+                            make_amps_mesh, replicated_sharding)
 from .validation import validate_num_ranks
-
-AMPS_AXIS = "amps"
 
 
 def _largest_pow2_leq(x: int) -> int:
@@ -47,12 +46,12 @@ class QuESTEnv:
         reference's contiguous chunk-per-rank layout."""
         if self.mesh is None or self.num_ranks == 1:
             return None
-        return NamedSharding(self.mesh, P(None, AMPS_AXIS))
+        return amp_sharding(self.mesh)
 
     def replicated(self) -> NamedSharding | None:
         if self.mesh is None or self.num_ranks == 1:
             return None
-        return NamedSharding(self.mesh, P())
+        return replicated_sharding(self.mesh)
 
 
 def create_quest_env(num_devices: int | None = None, devices=None) -> QuESTEnv:
@@ -74,8 +73,7 @@ def create_quest_env(num_devices: int | None = None, devices=None) -> QuESTEnv:
     if num_devices == 1:
         env = QuESTEnv(mesh=None, num_ranks=1)
     else:
-        mesh = Mesh(np.asarray(devices), (AMPS_AXIS,))
-        env = QuESTEnv(mesh=mesh, num_ranks=num_devices)
+        env = QuESTEnv(mesh=make_amps_mesh(devices), num_ranks=num_devices)
     rng.seed_quest_default()
     return env
 

@@ -116,8 +116,9 @@ def trotter_circuit(hamil, time: float, order: int, reps: int) -> Circuit:
     """Symmetrized Suzuki-Trotter circuit of a PauliHamil as a compiled
     Circuit (the fused-program twin of applyTrotterCircuit, which follows the
     reference's recursion — QuEST_common.c:698-780)."""
-    from ..matrices import PAULI_MATRICES
+    from ..validation import validate_trotter_params
 
+    validate_trotter_params(order, reps, "trotter_circuit")
     n = hamil.num_qubits
     c = Circuit(n)
 

@@ -1,0 +1,116 @@
+"""ctypes binding to the native gate-fusion engine (native/fusion.cpp).
+
+The shared library is built on first use with the system toolchain and cached
+under ``native/build/``.  If no compiler is available the fusion API degrades
+to a no-op (circuits still run, just without native pre-fusion).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+
+import numpy as np
+
+_KINDS = {"matrix": 0, "diagonal": 1, "x": 2, "y": 3, "y*": 4, "swap": 5}
+_KIND_NAMES = {v: k for k, v in _KINDS.items()}
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(_ROOT, "native", "fusion.cpp")
+_BUILD_DIR = os.path.join(_ROOT, "native", "build")
+_LIB = os.path.join(_BUILD_DIR, "libquest_fusion.so")
+
+_lib = None
+_load_failed = False
+
+
+def _ensure_lib():
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    try:
+        if not os.path.exists(_LIB) or (os.path.getmtime(_LIB)
+                                        < os.path.getmtime(_SRC)):
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            subprocess.run(["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                            _SRC, "-o", _LIB], check=True, capture_output=True)
+        lib = ctypes.CDLL(_LIB)
+        lib.quest_fuse_circuit.restype = ctypes.POINTER(ctypes.c_uint8)
+        lib.quest_fuse_circuit.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                           ctypes.POINTER(ctypes.c_int64)]
+        lib.quest_free_buffer.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        assert lib.quest_fusion_abi_version() == 1
+        _lib = lib
+    except Exception:
+        _load_failed = True
+    return _lib
+
+
+def _pack(ops) -> bytes:
+    parts = [struct.pack("<q", len(ops))]
+    for op in ops:
+        kind = _KINDS[op.kind]
+        targets = np.asarray(op.targets, dtype=np.int32)
+        controls = np.asarray(op.controls, dtype=np.int32)
+        states = np.asarray(op.control_states if op.control_states
+                            else (1,) * len(op.controls), dtype=np.int32)
+        payload = (np.asarray(op.matrix, dtype=np.float64)
+                   if op.matrix is not None else np.zeros(0))
+        parts.append(struct.pack("<iiiq", kind, targets.size, controls.size,
+                                 payload.size))
+        parts.append(targets.tobytes())
+        parts.append(controls.tobytes())
+        parts.append(states.tobytes())
+        parts.append(payload.tobytes())
+    return b"".join(parts)
+
+
+def _unpack(buf: bytes):
+    from .circuit import GateOp
+
+    ops = []
+    (n,) = struct.unpack_from("<q", buf, 0)
+    off = 8
+    for _ in range(n):
+        kind, nt, nc, pl = struct.unpack_from("<iiiq", buf, off)
+        off += 20
+        targets = np.frombuffer(buf, np.int32, nt, off); off += 4 * nt
+        controls = np.frombuffer(buf, np.int32, nc, off); off += 4 * nc
+        states = np.frombuffer(buf, np.int32, nc, off); off += 4 * nc
+        payload = np.frombuffer(buf, np.float64, pl, off); off += 8 * pl
+        name = _KIND_NAMES[kind]
+        if name == "matrix":
+            d = int(round((pl // 2) ** 0.5))
+            shape = (2, d, d)
+        elif name == "diagonal":
+            shape = (2, pl // 2)
+        else:
+            shape = None
+        ops.append(GateOp(name, tuple(int(t) for t in targets),
+                          tuple(int(c) for c in controls),
+                          tuple(int(s) for s in states) if nc else (),
+                          tuple(payload) if pl else None, shape))
+    return ops
+
+
+def fuse_ops(ops):
+    """Run the native fusion pass over a GateOp list; returns the (possibly
+    shorter) equivalent list, or the input unchanged if the library is
+    unavailable."""
+    lib = _ensure_lib()
+    if lib is None or not ops:
+        return list(ops)
+    packed = _pack(ops)
+    out_len = ctypes.c_int64()
+    ptr = lib.quest_fuse_circuit(packed, len(packed), ctypes.byref(out_len))
+    try:
+        data = ctypes.string_at(ptr, out_len.value)
+    finally:
+        lib.quest_free_buffer(ptr)
+    return _unpack(data)
+
+
+def available() -> bool:
+    return _ensure_lib() is not None

@@ -19,7 +19,16 @@ import numpy as np
 
 
 def save_qureg(qureg, directory: str) -> None:
-    """Write the Qureg's amplitudes and metadata under ``directory``."""
+    """Write the Qureg's amplitudes and metadata under ``directory``.
+
+    Multi-host note: each process sees only its addressable shards; a
+    correct multi-host checkpoint needs one directory per process (or a
+    shared filesystem with per-process file names).  Until that lands we
+    refuse rather than write a silently partial checkpoint."""
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "save_qureg on multi-host meshes needs per-process shard files; "
+            "gather to one host or checkpoint with orbax for now")
     os.makedirs(directory, exist_ok=True)
     meta = {
         "num_qubits": qureg.num_qubits_represented,
@@ -49,10 +58,11 @@ def load_qureg(directory: str, env):
     with open(os.path.join(directory, "manifest.json")) as f:
         meta = json.load(f)
     n = meta["num_qubits"]
+    dtype = np.dtype(meta["dtype"])
     if meta["is_density_matrix"]:
-        q = qt.createDensityQureg(n, env)
+        q = qt.createDensityQureg(n, env, dtype=dtype)
     else:
-        q = qt.createQureg(n, env)
+        q = qt.createQureg(n, env, dtype=dtype)
     total = q.num_amps_total
     full = np.zeros((2, total), dtype=np.dtype(meta["dtype"]))
     for rec in meta["shards"]:

@@ -1,0 +1,92 @@
+"""The native C++ gate-fusion engine: semantic equivalence + actual fusion."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import native
+from oracle import NUM_QUBITS, random_statevector, set_sv, sv
+
+N = NUM_QUBITS
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _need_native():
+    if not native.available():
+        pytest.skip("native fusion library unavailable")
+
+
+def _equiv(env, circuit):
+    vec = random_statevector(circuit.num_qubits)
+    q1 = qt.createQureg(circuit.num_qubits, env)
+    q2 = qt.createQureg(circuit.num_qubits, env)
+    set_sv(q1, vec)
+    set_sv(q2, vec)
+    qt.apply_circuit(q1, circuit)
+    import copy
+    opt = copy.deepcopy(circuit).optimize()
+    qt.apply_circuit(q2, opt)
+    np.testing.assert_allclose(sv(q2), sv(q1), atol=1e-12)
+    return opt
+
+
+def test_adjacent_1q_gates_merge(env_local):
+    c = qt.Circuit(3)
+    c.h(0).rz(0, 0.3).ry(0, -0.5).h(1).t(1)
+    opt = _equiv(env_local, c)
+    # three ops on qubit 0 fuse to one, two on qubit 1 fuse to one
+    assert len(opt) == 2
+
+
+def test_self_inverse_cancellation(env_local):
+    c = qt.Circuit(3)
+    c.x(0).x(0).swap(1, 2).swap(1, 2).h(0)
+    opt = _equiv(env_local, c)
+    assert len(opt) == 1  # only the H survives
+
+
+def test_hh_cancels_to_identity(env_local):
+    c = qt.Circuit(2)
+    c.h(0).h(0)
+    opt = _equiv(env_local, c)
+    assert len(opt) == 0
+
+
+def test_diagonals_commute_and_merge(env_local):
+    c = qt.Circuit(4)
+    # diagonal on q0, diagonal on q2, then another diagonal on q0 — the
+    # commuting sink must merge the two q0 diagonals across the q2 one
+    c.rz(0, 0.2).phase_shift(2, 0.5).rz(0, 0.7).s(2)
+    opt = _equiv(env_local, c)
+    assert len(opt) == 2
+
+
+def test_cnot_pair_cancels(env_local):
+    c = qt.Circuit(3)
+    c.cnot(0, 1).cnot(0, 1).ry(2, 0.4)
+    opt = _equiv(env_local, c)
+    assert len(opt) == 1
+
+
+def test_controlled_dense_merge(env_local):
+    c = qt.Circuit(3)
+    c.phase_shift(1, 0.3, controls=(0,)).phase_shift(1, -0.3, controls=(0,))
+    opt = _equiv(env_local, c)
+    assert len(opt) == 0  # merged then identity-eliminated
+
+
+def test_disjoint_hop(env_local):
+    c = qt.Circuit(4)
+    # dense gate on q3 sits between two q0 gates; q0 gates hop across
+    c.ry(0, 0.1).ry(3, 0.9).ry(0, 0.2)
+    opt = _equiv(env_local, c)
+    assert len(opt) == 2
+
+
+def test_random_circuit_equivalence(env):
+    c = qt.random_circuit(N, depth=4, seed=9)
+    before = len(c)
+    opt = _equiv(env, c)
+    assert len(opt) <= before
