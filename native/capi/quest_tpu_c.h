@@ -1,0 +1,131 @@
+/* C front-end for the quest_tpu TPU-native simulation framework.
+ *
+ * Declares a QuEST-compatible C API (same function names, argument orders
+ * and value-struct conventions as QuEST.h v3.2 — independently written) so
+ * existing C driver programs compile against this framework unchanged and
+ * execute on the JAX/XLA runtime via an embedded Python interpreter.
+ *
+ * Link: -lquest_tpu_c (built by native/capi/build.sh).
+ */
+
+#ifndef QUEST_TPU_C_H
+#define QUEST_TPU_C_H
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef double qreal;
+
+typedef struct Complex {
+    qreal real;
+    qreal imag;
+} Complex;
+
+typedef struct ComplexMatrix2 {
+    qreal real[2][2];
+    qreal imag[2][2];
+} ComplexMatrix2;
+
+typedef struct ComplexMatrix4 {
+    qreal real[4][4];
+    qreal imag[4][4];
+} ComplexMatrix4;
+
+typedef struct ComplexMatrixN {
+    int numQubits;
+    qreal **real;
+    qreal **imag;
+} ComplexMatrixN;
+
+typedef struct Vector {
+    qreal x, y, z;
+} Vector;
+
+enum pauliOpType {PAULI_I = 0, PAULI_X = 1, PAULI_Y = 2, PAULI_Z = 3};
+
+typedef struct QuESTEnv {
+    int rank;
+    int numRanks;
+    void *handle;
+} QuESTEnv;
+
+typedef struct Qureg {
+    int isDensityMatrix;
+    int numQubitsRepresented;
+    long long int numAmpsTotal;
+    void *handle;
+} Qureg;
+
+/* environment */
+QuESTEnv createQuESTEnv(void);
+void destroyQuESTEnv(QuESTEnv env);
+void syncQuESTEnv(QuESTEnv env);
+void reportQuESTEnv(QuESTEnv env);
+void seedQuEST(unsigned long int *seedArray, int numSeeds);
+
+/* registers */
+Qureg createQureg(int numQubits, QuESTEnv env);
+Qureg createDensityQureg(int numQubits, QuESTEnv env);
+void destroyQureg(Qureg qureg, QuESTEnv env);
+void reportQuregParams(Qureg qureg);
+void reportStateToScreen(Qureg qureg, QuESTEnv env, int reportRank);
+
+/* matrices */
+ComplexMatrixN createComplexMatrixN(int numQubits);
+void destroyComplexMatrixN(ComplexMatrixN matr);
+
+/* state initialisation */
+void initZeroState(Qureg qureg);
+void initPlusState(Qureg qureg);
+void initClassicalState(Qureg qureg, long long int stateInd);
+void initBlankState(Qureg qureg);
+
+/* gates */
+void hadamard(Qureg qureg, int targetQubit);
+void pauliX(Qureg qureg, int targetQubit);
+void pauliY(Qureg qureg, int targetQubit);
+void pauliZ(Qureg qureg, int targetQubit);
+void sGate(Qureg qureg, int targetQubit);
+void tGate(Qureg qureg, int targetQubit);
+void phaseShift(Qureg qureg, int targetQubit, qreal angle);
+void rotateX(Qureg qureg, int rotQubit, qreal angle);
+void rotateY(Qureg qureg, int rotQubit, qreal angle);
+void rotateZ(Qureg qureg, int rotQubit, qreal angle);
+void rotateAroundAxis(Qureg qureg, int rotQubit, qreal angle, Vector axis);
+void controlledNot(Qureg qureg, int controlQubit, int targetQubit);
+void controlledPhaseFlip(Qureg qureg, int idQubit1, int idQubit2);
+void controlledPhaseShift(Qureg qureg, int idQubit1, int idQubit2, qreal angle);
+void multiControlledPhaseFlip(Qureg qureg, int *controlQubits, int numControlQubits);
+void swapGate(Qureg qureg, int qubit1, int qubit2);
+void unitary(Qureg qureg, int targetQubit, ComplexMatrix2 u);
+void compactUnitary(Qureg qureg, int targetQubit, Complex alpha, Complex beta);
+void controlledCompactUnitary(Qureg qureg, int controlQubit, int targetQubit,
+                              Complex alpha, Complex beta);
+void controlledUnitary(Qureg qureg, int controlQubit, int targetQubit,
+                       ComplexMatrix2 u);
+void multiControlledUnitary(Qureg qureg, int *controlQubits,
+                            int numControlQubits, int targetQubit,
+                            ComplexMatrix2 u);
+void multiQubitUnitary(Qureg qureg, int *targs, int numTargs, ComplexMatrixN u);
+
+/* measurement & calculations */
+int measure(Qureg qureg, int measureQubit);
+int measureWithStats(Qureg qureg, int measureQubit, qreal *outcomeProb);
+qreal collapseToOutcome(Qureg qureg, int measureQubit, int outcome);
+qreal calcProbOfOutcome(Qureg qureg, int measureQubit, int outcome);
+qreal calcTotalProb(Qureg qureg);
+qreal getProbAmp(Qureg qureg, long long int index);
+qreal getRealAmp(Qureg qureg, long long int index);
+qreal getImagAmp(Qureg qureg, long long int index);
+
+/* decoherence */
+void mixDamping(Qureg qureg, int targetQubit, qreal prob);
+void mixDephasing(Qureg qureg, int targetQubit, qreal prob);
+void mixDepolarising(Qureg qureg, int targetQubit, qreal prob);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* QUEST_TPU_C_H */

@@ -267,16 +267,177 @@ bool one_pass(std::vector<Gate>& gates) {
     return changed;
 }
 
+// ---- gate packing ---------------------------------------------------------
+// The TPU-specific scheduler pass: kron-merge runs of parallel gates so the
+// compiled program applies up to 2^7 = 128 basis states per matmul — one
+// lane-aligned MXU contraction and ONE HBM pass where the unpacked circuit
+// made k passes.  (The reference has no analogue: its per-gate kernels each
+// stream the whole state, QuEST_cpu.c:1688.)
+
+// kron of dense payloads: C = A (x) B where A is the HIGHER target bits
+std::vector<double> kron_dense(const std::vector<double>& a, int64_t da,
+                               const std::vector<double>& b, int64_t db) {
+    int64_t d = da * db;
+    std::vector<double> out(2 * d * d, 0.0);
+    for (int64_t ar = 0; ar < da; ar++)
+        for (int64_t ac = 0; ac < da; ac++) {
+            cd av(a[ar * da + ac], a[da * da + ar * da + ac]);
+            for (int64_t br = 0; br < db; br++)
+                for (int64_t bc = 0; bc < db; bc++) {
+                    cd bv(b[br * db + bc], b[db * db + br * db + bc]);
+                    cd cv = av * bv;
+                    int64_t r = ar * db + br, c = ac * db + bc;
+                    out[r * d + c] = cv.real();
+                    out[d * d + r * d + c] = cv.imag();
+                }
+        }
+    return out;
+}
+
+std::vector<double> kron_diag(const std::vector<double>& a, int64_t da,
+                              const std::vector<double>& b, int64_t db) {
+    int64_t d = da * db;
+    std::vector<double> out(2 * d);
+    for (int64_t i = 0; i < da; i++) {
+        cd av(a[i], a[da + i]);
+        for (int64_t j = 0; j < db; j++) {
+            cd bv(b[j], b[db + j]);
+            cd cv = av * bv;
+            out[i * db + j] = cv.real();
+            out[d + i * db + j] = cv.imag();
+        }
+    }
+    return out;
+}
+
+// rewrite a controlled diagonal as an uncontrolled diagonal over
+// (targets..., controls...): entries are the original diag where every
+// control bit matches its required state, 1 elsewhere
+void absorb_diagonal_controls(Gate& g) {
+    if (g.kind != KIND_DIAGONAL || g.controls.empty()) return;
+    int64_t dt = static_cast<int64_t>(g.payload.size()) / 2;
+    int64_t nc = static_cast<int64_t>(g.controls.size());
+    int64_t d = dt << nc;
+    std::vector<double> out(2 * d);
+    for (int64_t i = 0; i < d; i++) {
+        int64_t tbits = i % dt;
+        int64_t cbits = i / dt;
+        bool active = true;
+        for (int64_t c = 0; c < nc; c++)
+            if (((cbits >> c) & 1) != g.control_states[c]) active = false;
+        cd v = active ? cd(g.payload[tbits], g.payload[dt + tbits]) : cd(1.0, 0.0);
+        out[i] = v.real();
+        out[d + i] = v.imag();
+    }
+    for (int64_t c = 0; c < nc; c++) g.targets.push_back(g.controls[c]);
+    g.controls.clear();
+    g.control_states.clear();
+    g.payload = std::move(out);
+}
+
+// pack runs of parallel uncontrolled gates (dense with dense, diagonal with
+// diagonal) into multi-target gates of <= max_pack qubits
+void pack_pass(std::vector<Gate>& gates, int32_t max_pack) {
+    std::vector<Gate> out;
+    out.reserve(gates.size());
+
+    // multiply a diagonal whose targets are a subset of the pack's targets
+    // into the packed diagonal elementwise
+    auto merge_diag_subset = [](Gate& pack, const Gate& g) -> bool {
+        std::vector<int64_t> pos;  // position of each g target within pack
+        for (int32_t t : g.targets) {
+            int64_t p = -1;
+            for (size_t i = 0; i < pack.targets.size(); i++)
+                if (pack.targets[i] == t) { p = static_cast<int64_t>(i); break; }
+            if (p < 0) return false;
+            pos.push_back(p);
+        }
+        int64_t dp = static_cast<int64_t>(pack.payload.size()) / 2;
+        int64_t dg = static_cast<int64_t>(g.payload.size()) / 2;
+        for (int64_t i = 0; i < dp; i++) {
+            int64_t gi = 0;
+            for (size_t b = 0; b < pos.size(); b++)
+                gi |= ((i >> pos[b]) & 1) << b;
+            (void)dg;
+            cd a(pack.payload[i], pack.payload[dp + i]);
+            cd bv(g.payload[gi], g.payload[dg + gi]);
+            cd c = a * bv;
+            pack.payload[i] = c.real();
+            pack.payload[dp + i] = c.imag();
+        }
+        return true;
+    };
+
+    auto try_join = [&](Gate& g) -> bool {
+        if (out.empty()) return false;
+        Gate& last = out.back();
+        if (!last.controls.empty() || !g.controls.empty()) return false;
+        if (last.kind == KIND_DIAGONAL && g.kind == KIND_DIAGONAL &&
+            !last.disjoint(g))
+            return merge_diag_subset(last, g);
+        if (!last.disjoint(g)) return false;
+        int32_t combined = static_cast<int32_t>(last.targets.size()
+                                                + g.targets.size());
+        if (combined > max_pack) return false;
+        if (last.kind == KIND_MATRIX && g.kind == KIND_MATRIX) {
+            int64_t dl = int64_t{1} << last.targets.size();
+            int64_t dg = int64_t{1} << g.targets.size();
+            // g's targets become the HIGH bits: targets list order is
+            // least-significant-first, so append g's targets after last's
+            last.payload = kron_dense(g.payload, dg, last.payload, dl);
+            for (int32_t t : g.targets) last.targets.push_back(t);
+            return true;
+        }
+        if (last.kind == KIND_DIAGONAL && g.kind == KIND_DIAGONAL) {
+            int64_t dl = int64_t{1} << last.targets.size();
+            int64_t dg = int64_t{1} << g.targets.size();
+            last.payload = kron_diag(g.payload, dg, last.payload, dl);
+            for (int32_t t : g.targets) last.targets.push_back(t);
+            return true;
+        }
+        if (last.kind == KIND_MATRIX && g.kind == KIND_DIAGONAL &&
+            g.targets.size() == 1) {
+            // absorb a lone 1q diagonal into the dense pack (saves a pass)
+            Gate gd = g;
+            densify(gd);
+            int64_t dl = int64_t{1} << last.targets.size();
+            last.payload = kron_dense(gd.payload, 2, last.payload, dl);
+            last.targets.push_back(g.targets[0]);
+            return true;
+        }
+        return false;
+    };
+
+    for (Gate& g : gates) {
+        if (g.controls.empty() &&
+            (g.kind == KIND_X || g.kind == KIND_Y || g.kind == KIND_YCONJ))
+            densify(g);
+        if (g.kind == KIND_DIAGONAL) absorb_diagonal_controls(g);
+        if ((g.kind == KIND_MATRIX || g.kind == KIND_DIAGONAL) &&
+            g.controls.empty() &&
+            static_cast<int32_t>(g.targets.size()) <= max_pack) {
+            if (try_join(g)) continue;
+        }
+        out.push_back(std::move(g));
+    }
+    gates = std::move(out);
+}
+
 }  // namespace
 
 extern "C" {
 
 // Fuse the packed circuit; returns a malloc'd packed stream (caller frees
-// with quest_free_buffer) and writes its length to *out_len.
-uint8_t* quest_fuse_circuit(const uint8_t* buf, int64_t len, int64_t* out_len) {
+// with quest_free_buffer) and writes its length to *out_len.  max_pack > 1
+// additionally kron-packs runs of parallel gates into multi-target gates of
+// up to that many qubits (7 = 128 lanes, the f32 MXU tile width).
+uint8_t* quest_fuse_circuit(const uint8_t* buf, int64_t len, int64_t* out_len,
+                            int32_t max_pack) {
     std::vector<Gate> gates = parse(buf, len);
     for (int pass = 0; pass < 32; pass++)
         if (!one_pass(gates)) break;
+    if (max_pack > 1)
+        pack_pass(gates, max_pack);
     std::vector<uint8_t> out = serialise(gates);
     uint8_t* result = static_cast<uint8_t*>(std::malloc(out.size()));
     std::memcpy(result, out.data(), out.size());
@@ -286,6 +447,6 @@ uint8_t* quest_fuse_circuit(const uint8_t* buf, int64_t len, int64_t* out_len) {
 
 void quest_free_buffer(uint8_t* buf) { std::free(buf); }
 
-int64_t quest_fusion_abi_version() { return 1; }
+int64_t quest_fusion_abi_version() { return 2; }
 
 }  // extern "C"

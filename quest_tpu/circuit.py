@@ -134,12 +134,14 @@ class Circuit:
     def key(self) -> tuple:
         return tuple(self.ops)
 
-    def optimize(self) -> "Circuit":
+    def optimize(self, max_pack: int = 7) -> "Circuit":
         """Run the native gate-fusion engine (native/fusion.cpp): merges
-        adjacent/commuting gates so the compiled program makes fewer HBM
-        passes.  No-op if the native library is unavailable."""
+        adjacent/commuting gates and kron-packs runs of parallel gates into
+        multi-target gates of up to ``max_pack`` qubits (7 = one 128-wide
+        MXU tile), so the compiled program makes far fewer HBM passes.
+        No-op if the native library is unavailable."""
         from .native import fuse_ops
-        self.ops = fuse_ops(self.ops)
+        self.ops = fuse_ops(self.ops, max_pack=max_pack)
         self._shadow_cache = None
         return self
 

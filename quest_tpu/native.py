@@ -39,9 +39,10 @@ def _ensure_lib():
         lib = ctypes.CDLL(_LIB)
         lib.quest_fuse_circuit.restype = ctypes.POINTER(ctypes.c_uint8)
         lib.quest_fuse_circuit.argtypes = [ctypes.c_char_p, ctypes.c_int64,
-                                           ctypes.POINTER(ctypes.c_int64)]
+                                           ctypes.POINTER(ctypes.c_int64),
+                                           ctypes.c_int32]
         lib.quest_free_buffer.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
-        assert lib.quest_fusion_abi_version() == 1
+        assert lib.quest_fusion_abi_version() == 2
         _lib = lib
     except Exception:
         _load_failed = True
@@ -95,16 +96,18 @@ def _unpack(buf: bytes):
     return ops
 
 
-def fuse_ops(ops):
+def fuse_ops(ops, max_pack: int = 7):
     """Run the native fusion pass over a GateOp list; returns the (possibly
     shorter) equivalent list, or the input unchanged if the library is
-    unavailable."""
+    unavailable.  ``max_pack`` is the kron-packing width: 7 qubits = 128
+    basis states = one f32 MXU tile (pass 1 to disable packing)."""
     lib = _ensure_lib()
     if lib is None or not ops:
         return list(ops)
     packed = _pack(ops)
     out_len = ctypes.c_int64()
-    ptr = lib.quest_fuse_circuit(packed, len(packed), ctypes.byref(out_len))
+    ptr = lib.quest_fuse_circuit(packed, len(packed), ctypes.byref(out_len),
+                                 max_pack)
     try:
         data = ctypes.string_at(ptr, out_len.value)
     finally:

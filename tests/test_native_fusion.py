@@ -18,7 +18,7 @@ def _need_native():
         pytest.skip("native fusion library unavailable")
 
 
-def _equiv(env, circuit):
+def _equiv(env, circuit, max_pack=1):
     vec = random_statevector(circuit.num_qubits)
     q1 = qt.createQureg(circuit.num_qubits, env)
     q2 = qt.createQureg(circuit.num_qubits, env)
@@ -26,7 +26,7 @@ def _equiv(env, circuit):
     set_sv(q2, vec)
     qt.apply_circuit(q1, circuit)
     import copy
-    opt = copy.deepcopy(circuit).optimize()
+    opt = copy.deepcopy(circuit).optimize(max_pack=max_pack)
     qt.apply_circuit(q2, opt)
     np.testing.assert_allclose(sv(q2), sv(q1), atol=1e-12)
     return opt
@@ -90,3 +90,47 @@ def test_random_circuit_equivalence(env):
     before = len(c)
     opt = _equiv(env, c)
     assert len(opt) <= before
+
+
+# ---------------------------------------------------------------------------
+# kron packing (max_pack > 1): parallel gates merge into multi-target gates
+# ---------------------------------------------------------------------------
+
+def test_pack_parallel_1q_gates(env_local):
+    c = qt.Circuit(5)
+    for q in range(5):
+        c.ry(q, 0.1 * (q + 1))
+    opt = _equiv(env_local, c, max_pack=7)
+    assert len(opt) == 1
+    assert sorted(opt.ops[0].targets) == [0, 1, 2, 3, 4]
+
+
+def test_pack_respects_width(env_local):
+    c = qt.Circuit(5)
+    for q in range(5):
+        c.ry(q, 0.3)
+    opt = _equiv(env_local, c, max_pack=2)
+    assert len(opt) == 3  # 2 + 2 + 1
+
+
+def test_pack_diagonals_and_cz(env_local):
+    c = qt.Circuit(6)
+    c.cz(0, 1).cz(2, 3).cz(4, 5).rz(0, 0.4)
+    opt = _equiv(env_local, c, max_pack=7)
+    # CZs absorb their controls into 2q diagonals; all pack with the rz
+    assert len(opt) == 1
+    assert opt.ops[0].kind == "diagonal"
+
+
+def test_pack_random_circuit(env):
+    c = qt.random_circuit(N, depth=3, seed=31)
+    opt = _equiv(env, c, max_pack=7)
+    # each depth layer (5 gates + CZs) packs to ~1 dense + 1 diagonal op
+    assert len(opt) <= 8
+
+
+def test_pack_x_y_promotion(env_local):
+    c = qt.Circuit(4)
+    c.x(0).y(1).h(2).z(3)
+    opt = _equiv(env_local, c, max_pack=7)
+    assert len(opt) == 1
