@@ -1,0 +1,3 @@
+/* Compatibility alias: lets programs written against the reference's
+ * `#include "QuEST.h"` compile against the quest_tpu C front-end unchanged. */
+#include "quest_tpu_c.h"

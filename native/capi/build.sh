@@ -1,0 +1,11 @@
+#!/bin/sh
+# Build libquest_tpu_c.so — the QuEST-compatible C front-end over the
+# quest_tpu Python/JAX runtime.
+set -e
+cd "$(dirname "$0")"
+mkdir -p build
+CFLAGS="$(python3-config --includes)"
+LDFLAGS="$(python3-config --ldflags --embed)"
+g++ -O2 -std=c++17 -shared -fPIC quest_shim.cpp -o build/libquest_tpu_c.so \
+    $CFLAGS $LDFLAGS
+echo "built native/capi/build/libquest_tpu_c.so"

@@ -1,0 +1,370 @@
+// C front-end implementation: bridges the QuEST-compatible C API
+// (quest_tpu_c.h) onto the quest_tpu Python/JAX runtime via an embedded
+// CPython interpreter.
+//
+// Architecture: the reference links user C programs against native kernels
+// directly (libQuEST.so); here the "kernels" are XLA programs managed by the
+// Python runtime, so the shim owns an interpreter, imports quest_tpu once,
+// and forwards each C call.  Handles in the public structs are PyObject
+// pointers.  Every call clears/raises on Python errors by printing and
+// exiting, matching the reference's exit-on-invalid-input behaviour
+// (ref: QuEST_validation.c exitWithError:167-173).
+
+#include "quest_tpu_c.h"
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstdlib>
+
+namespace {
+
+PyObject* g_module = nullptr;
+
+void die_on_python_error() {
+    if (PyErr_Occurred()) {
+        PyErr_Print();
+        std::exit(1);
+    }
+}
+
+PyObject* mod() {
+    if (!g_module) {
+        if (!Py_IsInitialized()) {
+            Py_Initialize();
+        }
+        g_module = PyImport_ImportModule("quest_tpu");
+        die_on_python_error();
+    }
+    return g_module;
+}
+
+// call quest_tpu.<name>(args...) with a new reference result.  stdout is
+// flushed on both sides so C printf and Python print interleave in order.
+PyObject* call(const char* name, PyObject* args) {
+    std::fflush(stdout);
+    PyObject* fn = PyObject_GetAttrString(mod(), name);
+    die_on_python_error();
+    PyObject* result = PyObject_CallObject(fn, args);
+    Py_XDECREF(fn);
+    Py_XDECREF(args);
+    die_on_python_error();
+    PyRun_SimpleString("import sys; sys.stdout.flush()");
+    return result;
+}
+
+PyObject* int_list(const int* xs, int n) {
+    PyObject* list = PyList_New(n);
+    for (int i = 0; i < n; i++)
+        PyList_SET_ITEM(list, i, PyLong_FromLong(xs[i]));
+    return list;
+}
+
+PyObject* complex_obj(Complex c) {
+    return PyComplex_FromDoubles(c.real, c.imag);
+}
+
+PyObject* matrix2_obj(ComplexMatrix2 u) {
+    PyObject* rows = PyList_New(2);
+    for (int r = 0; r < 2; r++) {
+        PyObject* row = PyList_New(2);
+        for (int c = 0; c < 2; c++)
+            PyList_SET_ITEM(row, c, PyComplex_FromDoubles(u.real[r][c],
+                                                          u.imag[r][c]));
+        PyList_SET_ITEM(rows, r, row);
+    }
+    return rows;
+}
+
+PyObject* matrixN_obj(ComplexMatrixN u) {
+    int dim = 1 << u.numQubits;
+    PyObject* rows = PyList_New(dim);
+    for (int r = 0; r < dim; r++) {
+        PyObject* row = PyList_New(dim);
+        for (int c = 0; c < dim; c++)
+            PyList_SET_ITEM(row, c, PyComplex_FromDoubles(u.real[r][c],
+                                                          u.imag[r][c]));
+        PyList_SET_ITEM(rows, r, row);
+    }
+    return rows;
+}
+
+double as_double(PyObject* o) {
+    double v = PyFloat_AsDouble(o);
+    die_on_python_error();
+    Py_XDECREF(o);
+    return v;
+}
+
+long as_long(PyObject* o) {
+    long v = PyLong_AsLong(o);
+    die_on_python_error();
+    Py_XDECREF(o);
+    return v;
+}
+
+PyObject* qureg_handle(Qureg q) {
+    PyObject* h = static_cast<PyObject*>(q.handle);
+    Py_INCREF(h);
+    return h;
+}
+
+// gate helper: quest_tpu.<name>(qureg, ...) discarding the result
+void gate_call(const char* name, Qureg q, PyObject* rest /* tuple or null */) {
+    Py_ssize_t extra = rest ? PyTuple_Size(rest) : 0;
+    PyObject* args = PyTuple_New(1 + extra);
+    PyTuple_SET_ITEM(args, 0, qureg_handle(q));
+    for (Py_ssize_t i = 0; i < extra; i++) {
+        PyObject* item = PyTuple_GetItem(rest, i);
+        Py_INCREF(item);
+        PyTuple_SET_ITEM(args, 1 + i, item);
+    }
+    Py_XDECREF(rest);
+    Py_XDECREF(call(name, args));
+}
+
+}  // namespace
+
+extern "C" {
+
+QuESTEnv createQuESTEnv(void) {
+    PyObject* env = call("createQuESTEnv", nullptr);
+    QuESTEnv out;
+    out.rank = 0;
+    PyObject* nr = PyObject_GetAttrString(env, "num_ranks");
+    out.numRanks = static_cast<int>(PyLong_AsLong(nr));
+    Py_XDECREF(nr);
+    out.handle = env;
+    return out;
+}
+
+void destroyQuESTEnv(QuESTEnv env) {
+    Py_XDECREF(static_cast<PyObject*>(env.handle));
+}
+
+void syncQuESTEnv(QuESTEnv env) {
+    PyObject* args = PyTuple_New(1);
+    PyObject* h = static_cast<PyObject*>(env.handle);
+    Py_INCREF(h);
+    PyTuple_SET_ITEM(args, 0, h);
+    Py_XDECREF(call("syncQuESTEnv", args));
+}
+
+void reportQuESTEnv(QuESTEnv env) {
+    PyObject* args = PyTuple_New(1);
+    PyObject* h = static_cast<PyObject*>(env.handle);
+    Py_INCREF(h);
+    PyTuple_SET_ITEM(args, 0, h);
+    Py_XDECREF(call("reportQuESTEnv", args));
+}
+
+void seedQuEST(unsigned long int* seedArray, int numSeeds) {
+    PyObject* list = PyList_New(numSeeds);
+    for (int i = 0; i < numSeeds; i++)
+        PyList_SET_ITEM(list, i, PyLong_FromUnsignedLong(seedArray[i]));
+    PyObject* args = PyTuple_Pack(2, list, PyLong_FromLong(numSeeds));
+    Py_XDECREF(call("seedQuEST", args));
+}
+
+static Qureg make_qureg(const char* ctor, int numQubits, QuESTEnv env) {
+    PyObject* h = static_cast<PyObject*>(env.handle);
+    Py_INCREF(h);
+    PyObject* args = PyTuple_New(2);
+    PyTuple_SET_ITEM(args, 0, PyLong_FromLong(numQubits));
+    PyTuple_SET_ITEM(args, 1, h);
+    PyObject* q = call(ctor, args);
+    Qureg out;
+    PyObject* isdm = PyObject_GetAttrString(q, "is_density_matrix");
+    out.isDensityMatrix = PyObject_IsTrue(isdm);
+    Py_XDECREF(isdm);
+    out.numQubitsRepresented = numQubits;
+    out.numAmpsTotal = 1LL << (numQubits * (out.isDensityMatrix ? 2 : 1));
+    out.handle = q;
+    return out;
+}
+
+Qureg createQureg(int numQubits, QuESTEnv env) {
+    return make_qureg("createQureg", numQubits, env);
+}
+
+Qureg createDensityQureg(int numQubits, QuESTEnv env) {
+    return make_qureg("createDensityQureg", numQubits, env);
+}
+
+void destroyQureg(Qureg qureg, QuESTEnv env) {
+    (void)env;
+    gate_call("destroyQureg", qureg, nullptr);
+    Py_XDECREF(static_cast<PyObject*>(qureg.handle));
+}
+
+void reportQuregParams(Qureg qureg) { gate_call("reportQuregParams", qureg, nullptr); }
+
+void reportStateToScreen(Qureg qureg, QuESTEnv env, int reportRank) {
+    PyObject* h = static_cast<PyObject*>(env.handle);
+    Py_INCREF(h);
+    gate_call("reportStateToScreen", qureg,
+              PyTuple_Pack(2, h, PyLong_FromLong(reportRank)));
+}
+
+ComplexMatrixN createComplexMatrixN(int numQubits) {
+    int dim = 1 << numQubits;
+    ComplexMatrixN m;
+    m.numQubits = numQubits;
+    m.real = static_cast<qreal**>(std::calloc(dim, sizeof(qreal*)));
+    m.imag = static_cast<qreal**>(std::calloc(dim, sizeof(qreal*)));
+    for (int r = 0; r < dim; r++) {
+        m.real[r] = static_cast<qreal*>(std::calloc(dim, sizeof(qreal)));
+        m.imag[r] = static_cast<qreal*>(std::calloc(dim, sizeof(qreal)));
+    }
+    return m;
+}
+
+void destroyComplexMatrixN(ComplexMatrixN m) {
+    int dim = 1 << m.numQubits;
+    for (int r = 0; r < dim; r++) {
+        std::free(m.real[r]);
+        std::free(m.imag[r]);
+    }
+    std::free(m.real);
+    std::free(m.imag);
+}
+
+/* state initialisation */
+void initZeroState(Qureg q) { gate_call("initZeroState", q, nullptr); }
+void initPlusState(Qureg q) { gate_call("initPlusState", q, nullptr); }
+void initBlankState(Qureg q) { gate_call("initBlankState", q, nullptr); }
+void initClassicalState(Qureg q, long long int s) {
+    gate_call("initClassicalState", q, PyTuple_Pack(1, PyLong_FromLongLong(s)));
+}
+
+/* gates */
+void hadamard(Qureg q, int t) { gate_call("hadamard", q, PyTuple_Pack(1, PyLong_FromLong(t))); }
+void pauliX(Qureg q, int t) { gate_call("pauliX", q, PyTuple_Pack(1, PyLong_FromLong(t))); }
+void pauliY(Qureg q, int t) { gate_call("pauliY", q, PyTuple_Pack(1, PyLong_FromLong(t))); }
+void pauliZ(Qureg q, int t) { gate_call("pauliZ", q, PyTuple_Pack(1, PyLong_FromLong(t))); }
+void sGate(Qureg q, int t) { gate_call("sGate", q, PyTuple_Pack(1, PyLong_FromLong(t))); }
+void tGate(Qureg q, int t) { gate_call("tGate", q, PyTuple_Pack(1, PyLong_FromLong(t))); }
+
+void phaseShift(Qureg q, int t, qreal a) {
+    gate_call("phaseShift", q, PyTuple_Pack(2, PyLong_FromLong(t), PyFloat_FromDouble(a)));
+}
+void rotateX(Qureg q, int t, qreal a) {
+    gate_call("rotateX", q, PyTuple_Pack(2, PyLong_FromLong(t), PyFloat_FromDouble(a)));
+}
+void rotateY(Qureg q, int t, qreal a) {
+    gate_call("rotateY", q, PyTuple_Pack(2, PyLong_FromLong(t), PyFloat_FromDouble(a)));
+}
+void rotateZ(Qureg q, int t, qreal a) {
+    gate_call("rotateZ", q, PyTuple_Pack(2, PyLong_FromLong(t), PyFloat_FromDouble(a)));
+}
+
+void rotateAroundAxis(Qureg q, int t, qreal a, Vector axis) {
+    PyObject* ax = PyTuple_Pack(3, PyFloat_FromDouble(axis.x),
+                                PyFloat_FromDouble(axis.y),
+                                PyFloat_FromDouble(axis.z));
+    gate_call("rotateAroundAxis", q,
+              PyTuple_Pack(3, PyLong_FromLong(t), PyFloat_FromDouble(a), ax));
+}
+
+void controlledNot(Qureg q, int c, int t) {
+    gate_call("controlledNot", q, PyTuple_Pack(2, PyLong_FromLong(c), PyLong_FromLong(t)));
+}
+void controlledPhaseFlip(Qureg q, int a, int b) {
+    gate_call("controlledPhaseFlip", q, PyTuple_Pack(2, PyLong_FromLong(a), PyLong_FromLong(b)));
+}
+void controlledPhaseShift(Qureg q, int a, int b, qreal angle) {
+    gate_call("controlledPhaseShift", q,
+              PyTuple_Pack(3, PyLong_FromLong(a), PyLong_FromLong(b),
+                           PyFloat_FromDouble(angle)));
+}
+void multiControlledPhaseFlip(Qureg q, int* qs, int n) {
+    gate_call("multiControlledPhaseFlip", q,
+              PyTuple_Pack(2, int_list(qs, n), PyLong_FromLong(n)));
+}
+void swapGate(Qureg q, int a, int b) {
+    gate_call("swapGate", q, PyTuple_Pack(2, PyLong_FromLong(a), PyLong_FromLong(b)));
+}
+
+void unitary(Qureg q, int t, ComplexMatrix2 u) {
+    gate_call("unitary", q, PyTuple_Pack(2, PyLong_FromLong(t), matrix2_obj(u)));
+}
+void compactUnitary(Qureg q, int t, Complex alpha, Complex beta) {
+    gate_call("compactUnitary", q,
+              PyTuple_Pack(3, PyLong_FromLong(t), complex_obj(alpha), complex_obj(beta)));
+}
+void controlledCompactUnitary(Qureg q, int c, int t, Complex alpha, Complex beta) {
+    gate_call("controlledCompactUnitary", q,
+              PyTuple_Pack(4, PyLong_FromLong(c), PyLong_FromLong(t),
+                           complex_obj(alpha), complex_obj(beta)));
+}
+void controlledUnitary(Qureg q, int c, int t, ComplexMatrix2 u) {
+    gate_call("controlledUnitary", q,
+              PyTuple_Pack(3, PyLong_FromLong(c), PyLong_FromLong(t), matrix2_obj(u)));
+}
+void multiControlledUnitary(Qureg q, int* cs, int n, int t, ComplexMatrix2 u) {
+    gate_call("multiControlledUnitary", q,
+              PyTuple_Pack(4, int_list(cs, n), PyLong_FromLong(n),
+                           PyLong_FromLong(t), matrix2_obj(u)));
+}
+void multiQubitUnitary(Qureg q, int* ts, int n, ComplexMatrixN u) {
+    gate_call("multiQubitUnitary", q,
+              PyTuple_Pack(3, int_list(ts, n), PyLong_FromLong(n), matrixN_obj(u)));
+}
+
+/* measurement & calculations */
+static PyObject* q1(Qureg q, long long x) {
+    PyObject* args = PyTuple_New(2);
+    PyTuple_SET_ITEM(args, 0, qureg_handle(q));
+    PyTuple_SET_ITEM(args, 1, PyLong_FromLongLong(x));
+    return args;
+}
+
+int measure(Qureg q, int t) { return static_cast<int>(as_long(call("measure", q1(q, t)))); }
+
+int measureWithStats(Qureg q, int t, qreal* outcomeProb) {
+    PyObject* pair = call("measureWithStats", q1(q, t));
+    int outcome = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(pair, 0)));
+    *outcomeProb = PyFloat_AsDouble(PyTuple_GetItem(pair, 1));
+    die_on_python_error();
+    Py_XDECREF(pair);
+    return outcome;
+}
+
+qreal collapseToOutcome(Qureg q, int t, int outcome) {
+    PyObject* args = PyTuple_New(3);
+    PyTuple_SET_ITEM(args, 0, qureg_handle(q));
+    PyTuple_SET_ITEM(args, 1, PyLong_FromLong(t));
+    PyTuple_SET_ITEM(args, 2, PyLong_FromLong(outcome));
+    return as_double(call("collapseToOutcome", args));
+}
+
+qreal calcProbOfOutcome(Qureg q, int t, int outcome) {
+    PyObject* args = PyTuple_New(3);
+    PyTuple_SET_ITEM(args, 0, qureg_handle(q));
+    PyTuple_SET_ITEM(args, 1, PyLong_FromLong(t));
+    PyTuple_SET_ITEM(args, 2, PyLong_FromLong(outcome));
+    return as_double(call("calcProbOfOutcome", args));
+}
+
+qreal calcTotalProb(Qureg q) {
+    PyObject* args = PyTuple_New(1);
+    PyTuple_SET_ITEM(args, 0, qureg_handle(q));
+    return as_double(call("calcTotalProb", args));
+}
+
+qreal getProbAmp(Qureg q, long long int i) { return as_double(call("getProbAmp", q1(q, i))); }
+qreal getRealAmp(Qureg q, long long int i) { return as_double(call("getRealAmp", q1(q, i))); }
+qreal getImagAmp(Qureg q, long long int i) { return as_double(call("getImagAmp", q1(q, i))); }
+
+/* decoherence */
+void mixDamping(Qureg q, int t, qreal p) {
+    gate_call("mixDamping", q, PyTuple_Pack(2, PyLong_FromLong(t), PyFloat_FromDouble(p)));
+}
+void mixDephasing(Qureg q, int t, qreal p) {
+    gate_call("mixDephasing", q, PyTuple_Pack(2, PyLong_FromLong(t), PyFloat_FromDouble(p)));
+}
+void mixDepolarising(Qureg q, int t, qreal p) {
+    gate_call("mixDepolarising", q, PyTuple_Pack(2, PyLong_FromLong(t), PyFloat_FromDouble(p)));
+}
+
+}  // extern "C"

@@ -1,0 +1,72 @@
+"""The C front-end (native/capi): compile and run a C program against
+libquest_tpu_c and check its output."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CAPI = os.path.join(ROOT, "native", "capi")
+LIB = os.path.join(CAPI, "build", "libquest_tpu_c.so")
+
+C_PROGRAM = r"""
+#include <stdio.h>
+#include "QuEST.h"
+
+int main(void) {
+    QuESTEnv env = createQuESTEnv();
+    Qureg q = createQureg(3, env);
+    initZeroState(q);
+    hadamard(q, 0);
+    controlledNot(q, 0, 1);
+    rotateY(q, 2, 0.1);
+    printf("amp0=%.10f\n", getRealAmp(q, 0));
+    printf("total=%.10f\n", calcTotalProb(q));
+    printf("p2=%.10f\n", calcProbOfOutcome(q, 2, 1));
+    destroyQureg(q, env);
+    destroyQuESTEnv(env);
+    return 0;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def c_binary(tmp_path_factory):
+    if shutil.which("gcc") is None:
+        pytest.skip("no C compiler")
+    if not os.path.exists(LIB):
+        r = subprocess.run([os.path.join(CAPI, "build.sh")],
+                           capture_output=True, text=True)
+        if r.returncode != 0:
+            pytest.skip(f"C shim build failed: {r.stderr[-500:]}")
+    d = tmp_path_factory.mktemp("capi")
+    src = d / "prog.c"
+    src.write_text(C_PROGRAM)
+    binary = d / "prog"
+    subprocess.run(["gcc", str(src), "-I", CAPI,
+                    "-L", os.path.dirname(LIB), "-lquest_tpu_c",
+                    f"-Wl,-rpath,{os.path.dirname(LIB)}", "-o", str(binary)],
+                   check=True, capture_output=True)
+    return binary
+
+
+def test_c_program_runs(c_binary):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    out = subprocess.run([str(c_binary)], capture_output=True, text=True,
+                         env=env, timeout=300)
+    assert out.returncode == 0, out.stderr[-500:]
+    vals = dict(line.split("=") for line in out.stdout.strip().splitlines()
+                if "=" in line)
+    # H(0) CNOT(0,1) RY(2, .1): amp0 = cos(.05)/sqrt(2)
+    import math
+    assert abs(float(vals["amp0"]) - math.cos(0.05) / math.sqrt(2)) < 1e-9
+    assert abs(float(vals["total"]) - 1.0) < 1e-9
+    assert abs(float(vals["p2"]) - math.sin(0.05) ** 2) < 1e-9
