@@ -310,11 +310,19 @@ std::vector<double> kron_diag(const std::vector<double>& a, int64_t da,
     return out;
 }
 
+// widest uncontrolled diagonal the packer may build: 2^16 entries (1 MiB of
+// payload) — wide diagonals are still one broadcast multiply at runtime, but
+// the payload must stay bounded (a (n-1)-control phase flip must not
+// materialise a state-sized table)
+constexpr int64_t kDiagCap = 16;
+
 // rewrite a controlled diagonal as an uncontrolled diagonal over
 // (targets..., controls...): entries are the original diag where every
 // control bit matches its required state, 1 elsewhere
 void absorb_diagonal_controls(Gate& g) {
     if (g.kind != KIND_DIAGONAL || g.controls.empty()) return;
+    if (static_cast<int64_t>(g.targets.size() + g.controls.size()) > kDiagCap)
+        return;  // keep controlled form rather than blow up the payload
     int64_t dt = static_cast<int64_t>(g.payload.size()) / 2;
     int64_t nc = static_cast<int64_t>(g.controls.size());
     int64_t d = dt << nc;
@@ -335,75 +343,142 @@ void absorb_diagonal_controls(Gate& g) {
     g.payload = std::move(out);
 }
 
-// pack runs of parallel uncontrolled gates (dense with dense, diagonal with
-// diagonal) into multi-target gates of <= max_pack qubits
+// positions of each g target within pack.targets, or empty if not a subset
+std::vector<int64_t> subset_positions(const Gate& g, const Gate& pack) {
+    std::vector<int64_t> pos;
+    for (int32_t t : g.targets) {
+        int64_t p = -1;
+        for (size_t i = 0; i < pack.targets.size(); i++)
+            if (pack.targets[i] == t) { p = static_cast<int64_t>(i); break; }
+        if (p < 0) return {};
+        pos.push_back(p);
+    }
+    return pos;
+}
+
+// a diagonal AFTER a dense pack whose targets cover it: left-multiply = scale
+// each matrix row by the diagonal entry of that row's bits
+bool diag_into_dense(Gate& pack, const Gate& g) {
+    std::vector<int64_t> pos = subset_positions(g, pack);
+    if (pos.empty() && !g.targets.empty()) return false;
+    int64_t d = int64_t{1} << pack.targets.size();
+    int64_t dg = static_cast<int64_t>(g.payload.size()) / 2;
+    for (int64_t r = 0; r < d; r++) {
+        int64_t gi = 0;
+        for (size_t b = 0; b < pos.size(); b++)
+            gi |= ((r >> pos[b]) & 1) << b;
+        cd f(g.payload[gi], g.payload[dg + gi]);
+        for (int64_t c = 0; c < d; c++) {
+            cd v(pack.payload[r * d + c], pack.payload[d * d + r * d + c]);
+            v *= f;
+            pack.payload[r * d + c] = v.real();
+            pack.payload[d * d + r * d + c] = v.imag();
+        }
+    }
+    return true;
+}
+
+// a dense 1q gate AFTER a pack that contains its target: pack = (I⊗g⊗I)·pack
+bool dense1q_into_pack(Gate& pack, const Gate& g) {
+    std::vector<int64_t> pos = subset_positions(g, pack);
+    if (pos.size() != 1) return false;
+    int64_t p = pos[0];
+    int64_t d = int64_t{1} << pack.targets.size();
+    std::vector<cd> m = to_complex_mat(pack, d);
+    std::vector<cd> gm = to_complex_mat(g, 2);
+    for (int64_t r = 0; r < d; r++) {
+        if ((r >> p) & 1) continue;
+        int64_t r1 = r | (int64_t{1} << p);
+        for (int64_t c = 0; c < d; c++) {
+            cd a = m[r * d + c], b = m[r1 * d + c];
+            m[r * d + c] = gm[0] * a + gm[1] * b;
+            m[r1 * d + c] = gm[2] * a + gm[3] * b;
+        }
+    }
+    from_complex_mat(pack, m, d);
+    return true;
+}
+
+// merge diagonal g into diagonal pack over the UNION of their targets
+bool merge_diag_union(Gate& pack, const Gate& g, int64_t cap) {
+    std::vector<int32_t> u = pack.targets;
+    for (int32_t t : g.targets) {
+        bool found = false;
+        for (int32_t x : u) if (x == t) { found = true; break; }
+        if (!found) u.push_back(t);
+    }
+    if (static_cast<int64_t>(u.size()) > cap) return false;
+    std::vector<int64_t> gp;
+    for (int32_t t : g.targets)
+        for (size_t i = 0; i < u.size(); i++)
+            if (u[i] == t) { gp.push_back(static_cast<int64_t>(i)); break; }
+    int64_t d = int64_t{1} << u.size();
+    int64_t dp = static_cast<int64_t>(pack.payload.size()) / 2;
+    int64_t dg = static_cast<int64_t>(g.payload.size()) / 2;
+    std::vector<double> outp(2 * d);
+    for (int64_t i = 0; i < d; i++) {
+        int64_t pi = i & (dp - 1);  // pack targets are the low union bits
+        int64_t gi = 0;
+        for (size_t b = 0; b < gp.size(); b++)
+            gi |= ((i >> gp[b]) & 1) << b;
+        cd v = cd(pack.payload[pi], pack.payload[dp + pi])
+             * cd(g.payload[gi], g.payload[dg + gi]);
+        outp[i] = v.real();
+        outp[d + i] = v.imag();
+    }
+    pack.targets = std::move(u);
+    pack.payload = std::move(outp);
+    return true;
+}
+
+// pack runs of parallel uncontrolled gates into multi-target gates: dense
+// packs of <= max_pack qubits (one MXU contraction each), diagonal packs of
+// <= kDiagCap qubits (one broadcast multiply each).  A gate scans BACKWARDS
+// over gates it commutes past (disjoint wires; diagonals additionally hop
+// any diagonal) so e.g. the CZ ladder of a brickwork layer folds into the
+// dense packs of the same layer — row scalings, costing zero extra HBM
+// passes at runtime.
 void pack_pass(std::vector<Gate>& gates, int32_t max_pack) {
     std::vector<Gate> out;
     out.reserve(gates.size());
 
-    // multiply a diagonal whose targets are a subset of the pack's targets
-    // into the packed diagonal elementwise
-    auto merge_diag_subset = [](Gate& pack, const Gate& g) -> bool {
-        std::vector<int64_t> pos;  // position of each g target within pack
-        for (int32_t t : g.targets) {
-            int64_t p = -1;
-            for (size_t i = 0; i < pack.targets.size(); i++)
-                if (pack.targets[i] == t) { p = static_cast<int64_t>(i); break; }
-            if (p < 0) return false;
-            pos.push_back(p);
-        }
-        int64_t dp = static_cast<int64_t>(pack.payload.size()) / 2;
-        int64_t dg = static_cast<int64_t>(g.payload.size()) / 2;
-        for (int64_t i = 0; i < dp; i++) {
-            int64_t gi = 0;
-            for (size_t b = 0; b < pos.size(); b++)
-                gi |= ((i >> pos[b]) & 1) << b;
-            (void)dg;
-            cd a(pack.payload[i], pack.payload[dp + i]);
-            cd bv(g.payload[gi], g.payload[dg + gi]);
-            cd c = a * bv;
-            pack.payload[i] = c.real();
-            pack.payload[dp + i] = c.imag();
-        }
-        return true;
-    };
-
-    auto try_join = [&](Gate& g) -> bool {
-        if (out.empty()) return false;
-        Gate& last = out.back();
-        if (!last.controls.empty() || !g.controls.empty()) return false;
-        if (last.kind == KIND_DIAGONAL && g.kind == KIND_DIAGONAL &&
-            !last.disjoint(g))
-            return merge_diag_subset(last, g);
-        if (!last.disjoint(g)) return false;
-        int32_t combined = static_cast<int32_t>(last.targets.size()
-                                                + g.targets.size());
-        if (combined > max_pack) return false;
-        if (last.kind == KIND_MATRIX && g.kind == KIND_MATRIX) {
-            int64_t dl = int64_t{1} << last.targets.size();
-            int64_t dg = int64_t{1} << g.targets.size();
-            // g's targets become the HIGH bits: targets list order is
-            // least-significant-first, so append g's targets after last's
-            last.payload = kron_dense(g.payload, dg, last.payload, dl);
-            for (int32_t t : g.targets) last.targets.push_back(t);
-            return true;
-        }
-        if (last.kind == KIND_DIAGONAL && g.kind == KIND_DIAGONAL) {
-            int64_t dl = int64_t{1} << last.targets.size();
-            int64_t dg = int64_t{1} << g.targets.size();
-            last.payload = kron_diag(g.payload, dg, last.payload, dl);
-            for (int32_t t : g.targets) last.targets.push_back(t);
-            return true;
-        }
-        if (last.kind == KIND_MATRIX && g.kind == KIND_DIAGONAL &&
-            g.targets.size() == 1) {
-            // absorb a lone 1q diagonal into the dense pack (saves a pass)
-            Gate gd = g;
-            densify(gd);
-            int64_t dl = int64_t{1} << last.targets.size();
-            last.payload = kron_dense(gd.payload, 2, last.payload, dl);
-            last.targets.push_back(g.targets[0]);
-            return true;
+    auto find_merge = [&](Gate& g) -> bool {
+        if (!g.controls.empty()) return false;
+        for (int64_t j = static_cast<int64_t>(out.size()) - 1; j >= 0; j--) {
+            Gate& cand = out[j];
+            bool open = cand.controls.empty();
+            if (g.kind == KIND_DIAGONAL) {
+                if (open && cand.kind == KIND_MATRIX &&
+                    diag_into_dense(cand, g))
+                    return true;
+                if (open && cand.kind == KIND_DIAGONAL &&
+                    merge_diag_union(cand, g, kDiagCap))
+                    return true;
+                if (cand.diagonal_like() || g.disjoint(cand))
+                    continue;  // hop: commutes past
+                return false;
+            }
+            if (g.kind == KIND_MATRIX) {
+                if (open && cand.kind == KIND_MATRIX) {
+                    if (g.targets.size() == 1 && dense1q_into_pack(cand, g))
+                        return true;
+                    if (g.disjoint(cand) &&
+                        static_cast<int32_t>(cand.targets.size()
+                                             + g.targets.size()) <= max_pack) {
+                        // g's targets become the HIGH bits: targets list
+                        // order is least-significant-first
+                        int64_t dl = int64_t{1} << cand.targets.size();
+                        int64_t dg = int64_t{1} << g.targets.size();
+                        cand.payload = kron_dense(g.payload, dg,
+                                                  cand.payload, dl);
+                        for (int32_t t : g.targets) cand.targets.push_back(t);
+                        return true;
+                    }
+                }
+                if (g.disjoint(cand)) continue;  // hop
+                return false;
+            }
+            return false;
         }
         return false;
     };
@@ -414,9 +489,8 @@ void pack_pass(std::vector<Gate>& gates, int32_t max_pack) {
             densify(g);
         if (g.kind == KIND_DIAGONAL) absorb_diagonal_controls(g);
         if ((g.kind == KIND_MATRIX || g.kind == KIND_DIAGONAL) &&
-            g.controls.empty() &&
-            static_cast<int32_t>(g.targets.size()) <= max_pack) {
-            if (try_join(g)) continue;
+            g.controls.empty()) {
+            if (find_merge(g)) continue;
         }
         out.push_back(std::move(g));
     }
@@ -447,6 +521,6 @@ uint8_t* quest_fuse_circuit(const uint8_t* buf, int64_t len, int64_t* out_len,
 
 void quest_free_buffer(uint8_t* buf) { std::free(buf); }
 
-int64_t quest_fusion_abi_version() { return 2; }
+int64_t quest_fusion_abi_version() { return 3; }
 
 }  // extern "C"

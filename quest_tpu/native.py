@@ -42,7 +42,7 @@ def _ensure_lib():
                                            ctypes.POINTER(ctypes.c_int64),
                                            ctypes.c_int32]
         lib.quest_free_buffer.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
-        assert lib.quest_fusion_abi_version() == 2
+        assert lib.quest_fusion_abi_version() == 3
         _lib = lib
     except Exception:
         _load_failed = True

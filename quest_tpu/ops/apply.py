@@ -10,29 +10,48 @@ arithmetic explicitly on real operands — which also makes the f32 and f64
 paths identical and keeps every matmul on the MXU's native types.
 Matrices are likewise passed as ``(2, 2^k, 2^k)`` real pairs.
 
-Design (TPU-first, not a port): the 2^n amplitude vector is viewed as an
-n-axis tensor of shape (2,)*n, with axis ``n-1-q`` holding qubit ``q`` (qubit
-0 is the least-significant index bit, matching the reference's amplitude
-ordering).  A k-qubit dense gate is then a (2^k x 2^k) x (2^k x 2^(n-k))
-real-matmul quartet after transposing the target axes to the front — fused
-XLA ops the compiler tiles onto the MXU, instead of the reference's
-hand-written pair-index loops (ref: QuEST_cpu.c:1688 compactUnitaryLocal,
-:1846 multiControlledMultiQubitUnitaryLocal).  Controlled gates are static
-slices, diagonal gates broadcast multiplies, Pauli-X/SWAP are axis
-flips/transposes — all static shapes, so everything jits once per
-(n, targets, controls) class and XLA fuses adjacent ops.
+Design (TPU-first, not a port).  Two hardware facts drive everything:
+
+1. **Tiling.**  TPU buffers are tiled (8, 128) over their last two dims; any
+   reshape that exposes a small trailing axis pays up to 64x padding in
+   memory (measured: a (…,2,2,…,2) view of a 64 MB state materialised 16 GB
+   and OOM'd the chip).  So the minor 7 qubits (128 = lane width) are NEVER
+   split into their own axes, and neither are the next 3 (8 = f32 sublanes):
+   every view of the state ends in (…, 8, 128) exactly matching the tile.
+
+2. **MXU.**  The matrix unit natively contracts 128-wide operands.  A gate
+   touching the lane block is therefore *expanded* (kron with identity +
+   static bit-reorder, built inside the traced program so matrices stay
+   runtime values) to act on the whole 128-wide lane axis — one native MXU
+   matmul per gate, instead of the reference's pair-index loops
+   (ref: QuEST_cpu.c:1688 compactUnitaryLocal, :1846
+   multiControlledMultiQubitUnitaryLocal).  Gates on the sublane block
+   contract the 8-wide axis; gates on higher ("prefix") qubits get their own
+   size-2 axes and contract those directly.  Program rank stays O(k) —
+   independent of n — so XLA compile time is flat as the state grows (a full
+   (2,)*n factorisation hit multi-minute compiles by 24 qubits).
+
+Controlled gates: controls on prefix qubits are static slices (halving the
+memory traffic per control); controls inside the lane/sublane blocks are
+folded into the expanded matrix as diag(I, U).  Diagonal gates are broadcast
+multiplies by a block-expanded factor — never any data movement, and the
+factor's trailing dims match the tile.  Parity phases (multiRotateZ) use a
+fused iota + population_count pass with no reshape at all.
 
 When the trailing amplitude axis is sharded over the device mesh, these same
-programs are partitioned by GSPMD: a matmul over a sharded target axis
-becomes the collective-permute exchange the reference hand-rolls with
-MPI_Sendrecv (ref: QuEST_cpu_distributed.c:479-507), and axis transposes
-become all-to-all reshards (the reference's swap-based rerouting,
-:1381-1479).
+programs are partitioned by GSPMD: the sharded prefix of the amplitude axis
+maps to the leading merged axis of the grouped view, a contraction over a
+sharded prefix axis becomes the collective-permute exchange the reference
+hand-rolls with MPI_Sendrecv (ref: QuEST_cpu_distributed.c:479-507), and
+axis transposes become all-to-all reshards (the reference's swap-based
+rerouting, :1381-1479).  The lane/sublane blocks are always shard-local, so
+the hot MXU matmuls never communicate.
 """
 
 from __future__ import annotations
 
-from functools import partial
+import dataclasses
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -41,6 +60,10 @@ import numpy as np
 # Real matmuls must not be demoted to bf16 on the MXU: amplitudes need full
 # mantissas.  HIGHEST keeps f32 gates f32-accurate (and f64 stays f64).
 _PRECISION = jax.lax.Precision.HIGHEST
+
+LANE_QUBITS = 7  # 2^7 = 128: the TPU lane width (minor tile dim)
+SUB_QUBITS = 3   # 2^3 = 8: f32 sublane count (second-minor tile dim)
+_EXPAND_CAP = 10  # max bits in an expanded matrix (2^10 = 1024) before rerouting
 
 
 def mat_pair(u) -> np.ndarray:
@@ -55,25 +78,225 @@ def num_qubits_of(state: jax.Array) -> int:
     return n
 
 
-def _as_tensor(state: jax.Array) -> jax.Array:
-    """(2, 2^n) -> (2,)+(2,)*n; axis of qubit q is ``n - q`` (axis 0 is re/im)."""
-    n = num_qubits_of(state)
-    return state.reshape((2,) + (2,) * n)
+@lru_cache(maxsize=None)
+def _blocks(n: int) -> tuple[int, int]:
+    """(lane, sublane) block widths in qubits: lane covers qubits [0, l),
+    sublane [l, l+s); qubits >= l+s are 'prefix' qubits."""
+    l = min(LANE_QUBITS, n)
+    s = min(SUB_QUBITS, n - l)
+    return l, s
 
 
-def _axis(q: int, n: int) -> int:
-    """Axis of qubit q within a (2,)*n single-part tensor."""
-    return n - 1 - q
+@lru_cache(maxsize=None)
+def grouped_shape(n: int, groups: tuple, isolate_sub: bool = False):
+    """Minimal-rank factorisation of the 2^n amplitude axis.
+
+    ``groups`` is a tuple of disjoint ``(start_qubit, length)`` runs of
+    *prefix* qubits; each run is isolated as ONE axis of dim 2^length (so a
+    contiguous multi-qubit gate contracts a single wide axis — one MXU
+    matmul, not a tangle of size-2 contractions).  Every maximal run of
+    untouched prefix qubits merges into one axis; the lane block is always
+    the minor axis, and the sublane block is isolated only when the gate
+    touches it (``isolate_sub``), else it merges into the run above — either
+    way the trailing two dims are at least (8, 128), matching the f32 tile,
+    so no view ever pays layout padding.  Returns
+    ``(dims, axis_of, sub_axis, lane_axis)`` with ``dims`` ordered
+    most-significant-first (qubit 0 is the least-significant index bit,
+    matching the reference's amplitude ordering); ``axis_of[start_qubit]``
+    is the axis index within ``dims``.
+    """
+    l, s = _blocks(n)
+    lo = l + s
+    by_top = {start + length - 1: (start, length) for start, length in groups}
+    assert all(start >= lo for start, _ in groups), \
+        f"groups {groups} inside minor blocks"
+    dims: list[int] = []
+    axis_of: dict[int, int] = {}
+    run = 0
+    q = n - 1
+    while q >= lo:
+        if q in by_top:
+            start, length = by_top[q]
+            if run:
+                dims.append(1 << run)
+                run = 0
+            axis_of[start] = len(dims)
+            dims.append(1 << length)
+            q = start - 1
+        else:
+            run += 1
+            q -= 1
+    sub_axis = None
+    if s and isolate_sub:
+        if run:
+            dims.append(1 << run)
+            run = 0
+        sub_axis = len(dims)
+        dims.append(1 << s)
+    else:
+        run += s  # sublane qubits join the trailing merged run
+    if run:
+        dims.append(1 << run)
+    lane_axis = None
+    if l:
+        lane_axis = len(dims)
+        dims.append(1 << l)
+    return tuple(dims), axis_of, sub_axis, lane_axis
 
 
-def _control_index(n: int, controls, control_states):
-    """Index tuple slicing the sub-tensor where each control axis is fixed at
-    its required bit (leading re/im axis untouched), plus remaining qubits."""
-    idx = [slice(None)] * (n + 1)
-    for c, s in zip(controls, control_states):
-        idx[1 + _axis(c, n)] = int(s)
-    remaining = [q for q in range(n - 1, -1, -1) if q not in set(controls)]
-    return tuple(idx), remaining
+# ---------------------------------------------------------------------------
+# gate plans: the static (host-side, cached) structure of one gate application
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class _Plan:
+    """Static structure of one gate application on an n-qubit state."""
+    n: int
+    dims: tuple            # grouped single-part shape
+    slice_idx: tuple | None  # prefix-control slice (incl. leading re/im axis)
+    slot_axes: tuple       # single-part axes of matrix slots, MSB-first
+    slot_dims: tuple       # dim of each slot, MSB-first
+    fold_k: int            # gate targets count (matrix is 2^fold_k wide pre-fold)
+    fold_pattern: int | None  # minor-control bit pattern to fold, or None
+    fold_c: int            # number of folded minor controls
+    kron_bits: int         # identity-expansion bits
+    perm: tuple | None     # bit-reorder permutation of the expanded matrix
+    reroute: tuple         # ((from_qubit, to_qubit), ...) swaps when too wide
+
+
+@lru_cache(maxsize=None)
+def _gate_plan(n: int, targets: tuple, controls: tuple,
+               control_states: tuple, diagonal: bool) -> _Plan:
+    l, s = _blocks(n)
+    lo = l + s
+    pctrl = [(c, st) for c, st in zip(controls, control_states) if c >= lo]
+    mctrl = [(c, st) for c, st in zip(controls, control_states) if c < lo]
+    gate_bits = list(targets) + [c for c, _ in mctrl]
+    lane_inv = l and any(q < l for q in gate_bits)
+    sub_inv = s and any(l <= q < lo for q in gate_bits)
+
+    # desired LSB-first bit order of the (expanded) matrix
+    slots_lsb: list = []
+    if lane_inv:
+        slots_lsb += list(range(l))
+    if sub_inv:
+        slots_lsb += list(range(l, lo))
+    prefix_targets = sorted(q for q in targets if q >= lo)
+    slots_lsb += prefix_targets
+    m = len(slots_lsb)
+
+    if not diagonal and m > _EXPAND_CAP and (lane_inv or sub_inv):
+        # too wide to expand: swap every minor gate qubit up to a free prefix
+        # position first (the reference's own rerouting trick,
+        # ref: QuEST_cpu_distributed.c:1381-1479)
+        busy = set(gate_bits) | {c for c, _ in pctrl}
+        free = [q for q in range(n - 1, lo - 1, -1) if q not in busy]
+        minors = sorted(b for b in gate_bits if b < lo)
+        if len(free) >= len(minors):  # else: oversized expansion beats crashing
+            moves, mapping = [], {}
+            for q in minors:
+                p = free.pop(0)
+                moves.append((q, p))
+                mapping[q] = p
+            return dataclasses.replace(
+                _gate_plan(n,
+                           tuple(mapping.get(q, q) for q in targets),
+                           tuple(mapping.get(c, c) for c in controls),
+                           control_states, diagonal),
+                reroute=tuple(moves))
+
+    # maximal contiguous runs of prefix targets — each one axis, one wide
+    # contraction dim
+    runs: list[tuple[int, int]] = []
+    for q in prefix_targets:
+        if runs and q == runs[-1][0] + runs[-1][1]:
+            runs[-1] = (runs[-1][0], runs[-1][1] + 1)
+        else:
+            runs.append((q, 1))
+    groups = tuple(sorted(runs + [(c, 1) for c, _ in pctrl]))
+    dims, axis_of, sub_axis, lane_axis = grouped_shape(n, groups, bool(sub_inv))
+    rank = len(dims) + 1  # leading re/im axis
+
+    slice_idx = None
+    removed: list[int] = []
+    if pctrl:
+        idx: list = [slice(None)] * rank
+        for c, st in pctrl:
+            idx[1 + axis_of[c]] = int(st)
+        slice_idx = tuple(idx)
+        removed = sorted(axis_of[c] for c, _ in pctrl)
+
+    def adj(a: int) -> int:
+        return a - sum(1 for r in removed if r < a)
+
+    # slots MSB-first: prefix runs desc, then sublane, then lane — which is
+    # ascending single-part axis order by construction
+    slot_axes: list[int] = []
+    slot_dims: list[int] = []
+    for start, length in reversed(runs):
+        slot_axes.append(adj(axis_of[start]))
+        slot_dims.append(1 << length)
+    if sub_inv:
+        slot_axes.append(adj(sub_axis))
+        slot_dims.append(1 << s)
+    if lane_inv:
+        slot_axes.append(adj(lane_axis))
+        slot_dims.append(1 << l)
+
+    # matrix bit order: current = targets + folded minor controls + identity
+    # expansion bits (ascending); desired = slots_lsb
+    cur = gate_bits + [q for q in slots_lsb if q not in set(gate_bits)]
+    qpos = {q: i for i, q in enumerate(cur)}
+    idx_arr = np.arange(1 << m, dtype=np.int64)
+    to_cur = np.zeros_like(idx_arr)
+    for i, q in enumerate(slots_lsb):
+        to_cur |= ((idx_arr >> i) & 1) << qpos[q]
+    perm = None if np.array_equal(to_cur, idx_arr) else tuple(to_cur.tolist())
+
+    pattern = None
+    if mctrl:
+        pattern = sum(st << i for i, (_, st) in enumerate(mctrl))
+
+    return _Plan(n=n, dims=dims, slice_idx=slice_idx,
+                 slot_axes=tuple(slot_axes), slot_dims=tuple(slot_dims),
+                 fold_k=len(targets), fold_pattern=pattern, fold_c=len(mctrl),
+                 kron_bits=m - len(gate_bits), perm=perm, reroute=())
+
+
+def _expand_matrix(u: jax.Array, plan: _Plan, dtype) -> jax.Array:
+    """Fold minor controls, kron-expand with identity over untouched block
+    qubits, and bit-reorder — all inside the traced program so the matrix
+    stays a runtime value (parametrised gates don't recompile)."""
+    u = u.astype(dtype)
+    if plan.fold_pattern is not None:
+        dim = 1 << (plan.fold_k + plan.fold_c)
+        off = plan.fold_pattern << plan.fold_k
+        ur = jax.lax.dynamic_update_slice(jnp.eye(dim, dtype=dtype), u[0], (off, off))
+        ui = jax.lax.dynamic_update_slice(jnp.zeros((dim, dim), dtype=dtype), u[1], (off, off))
+        u = jnp.stack([ur, ui])
+    if plan.kron_bits:
+        eye = jnp.eye(1 << plan.kron_bits, dtype=dtype)
+        u = jnp.stack([jnp.kron(eye, u[0]), jnp.kron(eye, u[1])])
+    if plan.perm is not None:
+        p = np.asarray(plan.perm)
+        u = u[:, p][:, :, p]
+    return u
+
+
+def _expand_diag(d: jax.Array, plan: _Plan, dtype) -> jax.Array:
+    """Diagonal analogue of :func:`_expand_matrix` (vector form)."""
+    d = d.astype(dtype)
+    if plan.fold_pattern is not None:
+        dim = 1 << (plan.fold_k + plan.fold_c)
+        off = plan.fold_pattern << plan.fold_k
+        dr = jax.lax.dynamic_update_slice(jnp.ones(dim, dtype=dtype), d[0], (off,))
+        di = jax.lax.dynamic_update_slice(jnp.zeros(dim, dtype=dtype), d[1], (off,))
+        d = jnp.stack([dr, di])
+    if plan.kron_bits:
+        d = jnp.concatenate([d] * (1 << plan.kron_bits), axis=1)
+    if plan.perm is not None:
+        d = d[:, np.asarray(plan.perm)]
+    return d
 
 
 def _cmul(ar, ai, br, bi):
@@ -81,24 +304,30 @@ def _cmul(ar, ai, br, bi):
     return ar * br - ai * bi, ar * bi + ai * br
 
 
-def _apply_dense_to_axes(t: jax.Array, u: jax.Array, targets, axis_qubits):
-    """Apply a (2,2^k,2^k) real-pair matrix on the axes of ``t`` (leading
-    re/im axis) holding ``targets``.  Matrix basis convention matches the
-    reference: targets[0] is the least-significant bit of the row index."""
-    k = len(targets)
-    pos = {q: a for a, q in enumerate(axis_qubits)}
-    src = [1 + pos[q] for q in reversed(targets)]  # row bit order: targets[0] last
-    t = jnp.moveaxis(t, src, range(1, k + 1))
-    shape = t.shape
-    t = t.reshape(2, 1 << k, -1)
-    re, im = t[0], t[1]
-    ur, ui = u[0].astype(t.dtype), u[1].astype(t.dtype)
-    out_re = (jnp.matmul(ur, re, precision=_PRECISION)
-              - jnp.matmul(ui, im, precision=_PRECISION))
-    out_im = (jnp.matmul(ur, im, precision=_PRECISION)
-              + jnp.matmul(ui, re, precision=_PRECISION))
-    t = jnp.stack([out_re, out_im]).reshape(shape)
-    return jnp.moveaxis(t, range(1, k + 1), src)
+def _dense_on(sub: jax.Array, u: jax.Array, plan: _Plan) -> jax.Array:
+    """Contract the (2, D, D) expanded matrix against the slot axes of
+    ``sub`` (leading re/im axis).  One integer-label einsum per real product
+    — a single dot_general whose flattened contraction is up to 128 wide
+    (the MXU's native tile) with the lane axis minor."""
+    dims = plan.slot_dims
+    ur = u[0].reshape(dims + dims)
+    ui = u[1].reshape(dims + dims)
+    rank = sub.ndim - 1
+    ns = len(dims)
+    s_lab = list(range(rank))
+    o_lab = [rank + i for i in range(ns)]
+    u_lab = o_lab + [s_lab[a] for a in plan.slot_axes]
+    r_lab = list(s_lab)
+    for i, a in enumerate(plan.slot_axes):
+        r_lab[a] = o_lab[i]
+
+    def mm(mat, s):
+        return jnp.einsum(mat, u_lab, s, s_lab, r_lab, precision=_PRECISION)
+
+    re, im = sub[0], sub[1]
+    out_re = mm(ur, re) - mm(ui, im)
+    out_im = mm(ur, im) + mm(ui, re)
+    return jnp.stack([out_re, out_im])
 
 
 @partial(jax.jit, static_argnames=("targets", "controls", "control_states"))
@@ -110,123 +339,133 @@ def apply_matrix(state: jax.Array, u: jax.Array, targets: tuple,
     ``u`` is a (2, 2^k, 2^k) real pair and may represent a non-unitary matrix
     (used by applyMatrixN / Kraus superoperators)."""
     n = num_qubits_of(state)
+    targets = tuple(int(t) for t in targets)
+    controls = tuple(int(c) for c in controls)
     if not control_states:
         control_states = (1,) * len(controls)
-    t = _as_tensor(state)
-    if controls:
-        idx, remaining = _control_index(n, controls, control_states)
-        sub = t[idx]
-        sub = _apply_dense_to_axes(sub, u, targets, remaining)
-        t = t.at[idx].set(sub)
+    control_states = tuple(int(s) for s in control_states)
+    plan = _gate_plan(n, targets, controls, control_states, False)
+    if plan.reroute:
+        mapping = dict(plan.reroute)
+        for a, b in plan.reroute:
+            state = swap_qubit_amps(state, a, b)
+        state = apply_matrix(state, u,
+                             tuple(mapping.get(q, q) for q in targets),
+                             tuple(mapping.get(c, c) for c in controls),
+                             control_states)
+        for a, b in reversed(plan.reroute):
+            state = swap_qubit_amps(state, a, b)
+        return state
+    u = _expand_matrix(u, plan, state.dtype)
+    t = state.reshape((2,) + plan.dims)
+    if plan.slice_idx is not None:
+        t = t.at[plan.slice_idx].set(_dense_on(t[plan.slice_idx], u, plan))
     else:
-        t = _apply_dense_to_axes(t, u, targets, list(range(n - 1, -1, -1)))
+        t = _dense_on(t, u, plan)
     return t.reshape(2, -1)
-
-
-def _diag_factor(k: int, n: int, diag: jax.Array, targets, axis_qubits):
-    """Broadcastable (fr, fi) factors for a (2, 2^k) diagonal over the target
-    axes of a (2,)*len(axis_qubits) single-part tensor."""
-    pos = {q: a for a, q in enumerate(axis_qubits)}
-    d = diag.reshape((2,) + (2,) * k)  # axis 1+j holds targets[k-1-j]
-    axes_pos = [pos[q] for q in reversed(targets)]
-    order = list(np.argsort(axes_pos))
-    d = jnp.moveaxis(d, [1 + j for j in order], range(1, k + 1))
-    shape = [1] * len(axis_qubits)
-    for p in axes_pos:
-        shape[p] = 2
-    d = d.reshape((2,) + tuple(shape))
-    return d[0], d[1]
 
 
 @partial(jax.jit, static_argnames=("targets", "controls", "control_states"))
 def apply_diagonal(state: jax.Array, diag: jax.Array, targets: tuple,
                    controls: tuple = (), control_states: tuple = ()) -> jax.Array:
     """Diagonal gate: amplitudes multiplied by ``diag[bits(targets)]``, given
-    as a (2, 2^k) real pair.  Never moves data — a pure broadcast multiply,
+    as a (2, 2^k) real pair.  Never moves data — a pure broadcast multiply by
+    a block-expanded factor whose trailing dims match the (8, 128) tile,
     embarrassingly parallel on a sharded state (the reference's diagonal
     kernels are likewise comm-free, ref: QuEST_cpu.c:2978-3109)."""
     n = num_qubits_of(state)
-    k = len(targets)
+    targets = tuple(int(t) for t in targets)
+    controls = tuple(int(c) for c in controls)
     if not control_states:
         control_states = (1,) * len(controls)
-    t = _as_tensor(state)
+    control_states = tuple(int(s) for s in control_states)
+    plan = _gate_plan(n, targets, controls, control_states, True)
+    d = _expand_diag(diag, plan, state.dtype)
+    t = state.reshape((2,) + plan.dims)
 
-    def mul(sub, axis_qubits):
-        fr, fi = _diag_factor(k, n, diag.astype(sub.dtype), targets, axis_qubits)
-        re, im = sub[0], sub[1]
-        out_re, out_im = _cmul(re, im, fr, fi)
+    def mul(sub):
+        rank = sub.ndim - 1
+        shape = [1] * rank
+        for a, dim in zip(plan.slot_axes, plan.slot_dims):
+            shape[a] = dim
+        f = d.reshape((2,) + tuple(shape))
+        out_re, out_im = _cmul(sub[0], sub[1], f[0], f[1])
         return jnp.stack([out_re, out_im])
 
-    if controls:
-        idx, remaining = _control_index(n, controls, control_states)
-        t = t.at[idx].set(mul(t[idx], remaining))
+    if plan.slice_idx is not None:
+        t = t.at[plan.slice_idx].set(mul(t[plan.slice_idx]))
     else:
-        t = mul(t, list(range(n - 1, -1, -1)))
+        t = mul(t)
     return t.reshape(2, -1)
+
+
+_X_PAIR = np.stack([np.array([[0.0, 1.0], [1.0, 0.0]]), np.zeros((2, 2))])
+_SWAP_PAIR = np.stack([np.array([[1, 0, 0, 0], [0, 0, 1, 0],
+                                 [0, 1, 0, 0], [0, 0, 0, 1]], dtype=np.float64),
+                       np.zeros((4, 4))])
 
 
 @partial(jax.jit, static_argnames=("target", "controls", "control_states"))
 def apply_pauli_x(state: jax.Array, target: int,
                   controls: tuple = (), control_states: tuple = ()) -> jax.Array:
-    """X / CNOT / Toffoli as an axis flip — a pure permutation, no arithmetic
-    (ref analogue: pauliXLocal QuEST_cpu.c:2498, controlledNotLocal :2584)."""
+    """X / CNOT / Toffoli (ref analogue: pauliXLocal QuEST_cpu.c:2498,
+    controlledNotLocal :2584).  On prefix qubits a pure axis flip — no
+    arithmetic; inside the minor blocks it routes through the expanded-matrix
+    engine (a 128-wide permutation matmul)."""
     n = num_qubits_of(state)
+    target = int(target)
+    controls = tuple(int(c) for c in controls)
     if not control_states:
         control_states = (1,) * len(controls)
-    t = _as_tensor(state)
-    if controls:
-        idx, remaining = _control_index(n, controls, control_states)
-        sub = t[idx]
-        a = 1 + remaining.index(target)
-        t = t.at[idx].set(jnp.flip(sub, axis=a))
-    else:
-        t = jnp.flip(t, axis=1 + _axis(target, n))
-    return t.reshape(2, -1)
+    l, s = _blocks(n)
+    lo = l + s
+    if target >= lo and all(c >= lo for c in controls):
+        groups = tuple(sorted((q, 1) for q in {target, *controls}))
+        dims, axis_of, _, _ = grouped_shape(n, groups)
+        t = state.reshape((2,) + dims)
+        if controls:
+            idx = [slice(None)] * t.ndim
+            for c, st in zip(controls, control_states):
+                idx[1 + axis_of[c]] = int(st)
+            removed = sorted(axis_of[c] for c in controls)
+            a = 1 + axis_of[target] - sum(1 for r in removed if r < axis_of[target])
+            t = t.at[tuple(idx)].set(jnp.flip(t[tuple(idx)], axis=a))
+        else:
+            t = jnp.flip(t, axis=1 + axis_of[target])
+        return t.reshape(2, -1)
+    u = jnp.asarray(_X_PAIR, dtype=state.dtype)
+    return apply_matrix(state, u, (target,), controls, control_states)
 
 
 @partial(jax.jit, static_argnames=("target", "controls", "control_states", "conj_fac"))
 def apply_pauli_y(state: jax.Array, target: int,
                   controls: tuple = (), control_states: tuple = (),
                   conj_fac: int = 1) -> jax.Array:
-    """Y = flip + (−i, +i) phases; ``conj_fac=-1`` gives Y* for density-matrix
-    shadow ops (ref analogue: pauliYLocal(conjFac), QuEST_cpu.c:2682).
-
-    Multiplying (re, im) by ±i is a swap-and-negate — still no arithmetic
-    beyond sign flips."""
-    n = num_qubits_of(state)
-    if not control_states:
-        control_states = (1,) * len(controls)
-    t = _as_tensor(state)
-
-    def y_on(sub, a):
-        flipped = jnp.flip(sub, axis=a)
-        re, im = flipped[0], flipped[1]
-        # phase is (−i) at bit 0 and (+i) at bit 1 (times conj_fac):
-        # (+i)(re+i im) = −im + i re ;  s = ∓1 selects the bit's sign
-        s = jnp.array([-conj_fac, conj_fac], dtype=sub.dtype)
-        shape = [1] * (sub.ndim - 1)
-        shape[a - 1] = 2
-        s = s.reshape(shape)
-        return jnp.stack([-s * im, s * re])
-
-    if controls:
-        idx, remaining = _control_index(n, controls, control_states)
-        sub = t[idx]
-        t = t.at[idx].set(y_on(sub, 1 + remaining.index(target)))
-    else:
-        t = y_on(t, 1 + _axis(target, n))
-    return t.reshape(2, -1)
+    """Y gate; ``conj_fac=-1`` gives Y* for density-matrix shadow ops
+    (ref analogue: pauliYLocal(conjFac), QuEST_cpu.c:2682)."""
+    y = np.stack([np.zeros((2, 2)),
+                  np.array([[0.0, -conj_fac], [conj_fac, 0.0]])])
+    u = jnp.asarray(y, dtype=state.dtype)
+    return apply_matrix(state, u, (int(target),), controls, control_states)
 
 
 @partial(jax.jit, static_argnames=("q1", "q2"))
 def swap_qubit_amps(state: jax.Array, q1: int, q2: int) -> jax.Array:
-    """SWAP gate = transpose of two tensor axes (ref analogue:
-    swapQubitAmpsLocal/Distributed, QuEST_cpu.c:3536/:3579 — there a pairwise
-    rewrite, here a layout change XLA turns into an all-to-all when sharded)."""
+    """SWAP gate (ref analogue: swapQubitAmpsLocal/Distributed,
+    QuEST_cpu.c:3536/:3579).  Prefix-prefix swaps are pure axis transposes
+    (an all-to-all reshard when the axes straddle the mesh); swaps touching
+    the minor blocks route through the expanded-matrix engine."""
     n = num_qubits_of(state)
-    t = _as_tensor(state)
-    t = jnp.swapaxes(t, 1 + _axis(q1, n), 1 + _axis(q2, n))
-    return t.reshape(2, -1)
+    q1, q2 = int(q1), int(q2)
+    l, s = _blocks(n)
+    lo = l + s
+    if q1 >= lo and q2 >= lo:
+        dims, axis_of, _, _ = grouped_shape(n, tuple(sorted((q, 1) for q in {q1, q2})))
+        t = state.reshape((2,) + dims)
+        t = jnp.swapaxes(t, 1 + axis_of[q1], 1 + axis_of[q2])
+        return t.reshape(2, -1)
+    u = jnp.asarray(_SWAP_PAIR, dtype=state.dtype)
+    return apply_matrix(state, u, (q1, q2))
 
 
 @partial(jax.jit, static_argnames=("targets",))
@@ -234,22 +473,20 @@ def apply_multi_rotate_z(state: jax.Array, angle: jax.Array, targets: tuple) -> 
     """exp(-i angle/2 Z⊗..⊗Z): phase by ±angle/2 keyed on bit-parity of the
     target mask (ref analogue: multiRotateZ, QuEST_cpu.c:3109).
 
-    Separable trick: z = Π_q (1-2 b_q) ∈ {±1} is a broadcast product, then the
-    phase is cos(θ/2) − i sin(θ/2)·z — no gather, no parity popcount."""
+    One fused flat pass: iota + population_count gives the ±1 parity sign —
+    no reshape, no gather, no data movement."""
     n = num_qubits_of(state)
-    t = _as_tensor(state)
-    z = jnp.ones((), dtype=t.dtype)
-    pm = jnp.array([1.0, -1.0], dtype=t.dtype)
+    mask = 0
     for q in targets:
-        shape = [1] * n
-        shape[_axis(q, n)] = 2
-        z = z * pm.reshape(shape)
-    half = angle.astype(t.dtype) / 2
+        mask |= 1 << int(q)
+    k = jax.lax.iota(jnp.uint32, 1 << n) if n <= 32 else jax.lax.iota(jnp.uint64, 1 << n)
+    par = jax.lax.population_count(k & jnp.asarray(mask, k.dtype)) & 1
+    z = (1.0 - 2.0 * par.astype(state.dtype))
+    half = angle.astype(state.dtype) / 2
     fr = jnp.cos(half)
     fi = -jnp.sin(half) * z
-    re, im = t[0], t[1]
-    out_re, out_im = _cmul(re, im, fr, fi)
-    return jnp.stack([out_re, out_im]).reshape(2, -1)
+    out_re, out_im = _cmul(state[0], state[1], fr, fi)
+    return jnp.stack([out_re, out_im])
 
 
 @jax.jit

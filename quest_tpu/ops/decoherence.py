@@ -3,17 +3,18 @@
 A density matrix of N qubits lives as a 2N-qubit amplitude pair with the
 row (ket) index in qubits 0..N-1 and the column (bra) index in N..2N-1
 (ref: getDensityAmp, QuEST.c:709-719).  A channel touching target q acts on
-the two axes (q, q+N).
+the two qubits (q, q+N) of the doubled space, so every channel here is a
+superoperator routed through the universal gate engine: dephasing-type
+channels are *diagonal* superoperators (pure broadcast multiplies, never any
+data movement — matching the reference's observation that its dephasing
+kernels are comm-free, ref: densmatr_oneQubitDegradeOffDiagonal,
+QuEST_cpu.c:48), while population-mixing channels (depolarising, damping)
+are small dense superoperators — one block-expanded matmul.  General Kraus
+maps become one dense superoperator matrix on the doubled targets
+(ref: populateKrausSuperOperator path, QuEST_common.c:541-605).
 
-Dephasing-type channels are *diagonal* in this basis — pure broadcast
-multiplies by real factors, never any data movement, matching the reference's
-observation that its dephasing kernels are comm-free
-(ref: densmatr_oneQubitDegradeOffDiagonal, QuEST_cpu.c:48).  Population-mixing
-channels (depolarising, damping) combine the four (row-bit, col-bit)
-sub-blocks with static slices and real coefficients.  General Kraus maps
-become one dense superoperator matrix applied on the doubled axes via the
-universal gate engine (ref: populateKrausSuperOperator path,
-QuEST_common.c:541-605).
+Superoperator index convention: for targets (q, q+N) the 4-dim gate index is
+``row_bit + 2*col_bit``, i.e. [ρ00, ρ10, ρ01, ρ11].
 """
 
 from __future__ import annotations
@@ -24,39 +25,26 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .apply import _axis, apply_matrix, mat_pair
+from .apply import apply_diagonal, apply_matrix, mat_pair
 
-
-def _rc_axes(target: int, num_qubits: int):
-    n = 2 * num_qubits
-    return _axis(target, n), _axis(target + num_qubits, n)
-
-
-def _block_idx(n: int, axes_bits):
-    """Index tuple over a (2,)+(2,)*n tensor fixing given (axis, bit) pairs."""
-    idx = [slice(None)] * (n + 1)
-    for a, b in axes_bits:
-        idx[1 + a] = b
-    return tuple(idx)
-
-
-def _xor_pattern(n: int, ar: int, ac: int, dtype):
-    """Broadcastable {0,1} tensor (over a single-part (2,)*n view): 1 where
-    row bit != col bit of one qubit."""
-    m = jnp.array([[0.0, 1.0], [1.0, 0.0]], dtype=dtype)
-    return m.reshape([2 if i in (ar, ac) else 1 for i in range(n)])
+_F = jnp.float64
 
 
 @partial(jax.jit, static_argnames=("target", "num_qubits"))
 def mix_dephasing(state: jax.Array, prob: jax.Array, target: int, num_qubits: int) -> jax.Array:
     """ρ → (1-p)ρ + p ZρZ: off-diagonals (in q) scale by 1-2p
     (ref: densmatr_mixDephasing, QuEST_cpu.c:79)."""
-    n = 2 * num_qubits
-    t = state.reshape((2,) + (2,) * n)
-    ar, ac = _rc_axes(target, num_qubits)
-    d = _xor_pattern(n, ar, ac, state.dtype)
-    factor = (1.0 - (2.0 * prob).astype(state.dtype) * d)[None]
-    return (t * factor).reshape(2, -1)
+    f = 1.0 - 2.0 * prob.astype(_F)
+    dr = jnp.ones(4, dtype=_F).at[1].set(f).at[2].set(f)
+    d = jnp.stack([dr, jnp.zeros_like(dr)])
+    return apply_diagonal(state, d, (int(target), int(target) + num_qubits))
+
+
+# off-diagonal pattern for two qubits: 1 where r1 != c1 or r2 != c2
+# (bit order of the 16-dim diagonal: r1, r2, c1, c2)
+_OFF2 = np.array([1.0 if (((i >> 0) & 1) != ((i >> 2) & 1)
+                          or ((i >> 1) & 1) != ((i >> 3) & 1)) else 0.0
+                  for i in range(16)])
 
 
 @partial(jax.jit, static_argnames=("q1", "q2", "num_qubits"))
@@ -65,15 +53,10 @@ def mix_two_qubit_dephasing(state: jax.Array, prob: jax.Array, q1: int, q2: int,
     """ρ → (1-p)ρ + p/3 (Z1ρZ1 + Z2ρZ2 + Z1Z2ρZ1Z2): every element that is
     off-diagonal in either qubit scales by 1-4p/3
     (ref: densmatr_mixTwoQubitDephasing, QuEST_cpu.c:84)."""
-    n = 2 * num_qubits
-    t = state.reshape((2,) + (2,) * n)
-    r1, c1 = _rc_axes(q1, num_qubits)
-    r2, c2 = _rc_axes(q2, num_qubits)
-    d1 = _xor_pattern(n, r1, c1, state.dtype)
-    d2 = _xor_pattern(n, r2, c2, state.dtype)
-    off = 1.0 - (1.0 - d1) * (1.0 - d2)  # 1 where off-diagonal in q1 or q2
-    factor = (1.0 - (4.0 * prob / 3.0).astype(state.dtype) * off)[None]
-    return (t * factor).reshape(2, -1)
+    dr = 1.0 - (4.0 * prob.astype(_F) / 3.0) * jnp.asarray(_OFF2, dtype=_F)
+    d = jnp.stack([dr, jnp.zeros_like(dr)])
+    return apply_diagonal(state, d, (int(q1), int(q2),
+                                     int(q1) + num_qubits, int(q2) + num_qubits))
 
 
 @partial(jax.jit, static_argnames=("target", "num_qubits"))
@@ -83,21 +66,15 @@ def mix_depolarising(state: jax.Array, prob: jax.Array, target: int,
     (ref: densmatr_mixDepolarisingLocal, QuEST_cpu.c:125, with its
     depolLevel = 4p/3 re-parametrisation resolved analytically):
     off-diag *= 1-4p/3; populations mix as a00' = (1-2p/3)a00 + (2p/3)a11."""
-    n = 2 * num_qubits
-    t = state.reshape((2,) + (2,) * n)
-    ar, ac = _rc_axes(target, num_qubits)
-    i00 = _block_idx(n, [(ar, 0), (ac, 0)])
-    i11 = _block_idx(n, [(ar, 1), (ac, 1)])
-    i01 = _block_idx(n, [(ar, 0), (ac, 1)])
-    i10 = _block_idx(n, [(ar, 1), (ac, 0)])
-    a00, a11 = t[i00], t[i11]
-    mix = (2.0 * prob / 3.0).astype(state.dtype)
-    off = (1.0 - 4.0 * prob / 3.0).astype(state.dtype)
-    t = t.at[i00].set((1.0 - mix) * a00 + mix * a11)
-    t = t.at[i11].set((1.0 - mix) * a11 + mix * a00)
-    t = t.at[i01].set(off * t[i01])
-    t = t.at[i10].set(off * t[i10])
-    return t.reshape(2, -1)
+    p = prob.astype(_F)
+    mix = 2.0 * p / 3.0
+    off = 1.0 - 4.0 * p / 3.0
+    sr = (jnp.zeros((4, 4), dtype=_F)
+          .at[0, 0].set(1.0 - mix).at[0, 3].set(mix)
+          .at[3, 3].set(1.0 - mix).at[3, 0].set(mix)
+          .at[1, 1].set(off).at[2, 2].set(off))
+    s = jnp.stack([sr, jnp.zeros_like(sr)])
+    return apply_matrix(state, s, (int(target), int(target) + num_qubits))
 
 
 @partial(jax.jit, static_argnames=("target", "num_qubits"))
@@ -106,21 +83,14 @@ def mix_damping(state: jax.Array, prob: jax.Array, target: int,
     """Amplitude damping |1><1| → |0><0| with probability p
     (ref: densmatr_mixDampingLocal, QuEST_cpu.c:174):
     a00' = a00 + p·a11, a11' = (1-p)a11, off-diag *= sqrt(1-p)."""
-    n = 2 * num_qubits
-    t = state.reshape((2,) + (2,) * n)
-    ar, ac = _rc_axes(target, num_qubits)
-    i00 = _block_idx(n, [(ar, 0), (ac, 0)])
-    i11 = _block_idx(n, [(ar, 1), (ac, 1)])
-    i01 = _block_idx(n, [(ar, 0), (ac, 1)])
-    i10 = _block_idx(n, [(ar, 1), (ac, 0)])
-    a00, a11 = t[i00], t[i11]
-    p = prob.astype(state.dtype)
+    p = prob.astype(_F)
     keep = jnp.sqrt(1.0 - p)
-    t = t.at[i00].set(a00 + p * a11)
-    t = t.at[i11].set((1.0 - p) * a11)
-    t = t.at[i01].set(keep * t[i01])
-    t = t.at[i10].set(keep * t[i10])
-    return t.reshape(2, -1)
+    sr = (jnp.zeros((4, 4), dtype=_F)
+          .at[0, 0].set(1.0).at[0, 3].set(p)
+          .at[3, 3].set(1.0 - p)
+          .at[1, 1].set(keep).at[2, 2].set(keep))
+    s = jnp.stack([sr, jnp.zeros_like(sr)])
+    return apply_matrix(state, s, (int(target), int(target) + num_qubits))
 
 
 def kraus_superoperator(ops) -> np.ndarray:

@@ -6,7 +6,12 @@ Reductions are plain jnp sums: under a sharded state GSPMD emits the psum the
 reference performed with MPI_Allreduce (QuEST_cpu_distributed.c:1260-1274).
 Accumulation is promoted to float64 to match the reference's double-precision
 Kahan accuracy (QuEST_cpu_local.c:118-167); on TPU f64 is compiler-emulated,
-costing a few extra vector ops on an already bandwidth-bound reduction."""
+costing a few extra vector ops on an already bandwidth-bound reduction.
+
+Probabilities are single fused flat passes (iota bit-mask + multiply +
+reduce — no reshape, so no tile-padding hazards); collapses are diagonal
+multiplies routed through the universal engine's block-expanded broadcast
+path (apply.apply_diagonal)."""
 
 from __future__ import annotations
 
@@ -15,20 +20,26 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from .apply import _axis, num_qubits_of
+from .apply import apply_diagonal, num_qubits_of
 
 _ACC = jnp.float64  # reduction accumulator (f64 even for f32 states)
+
+
+def _bit_mask(num_amps_log2: int, target: int, outcome: int):
+    """Flat {0,1} mask over 2^n amplitudes: 1 where bit ``target`` equals
+    ``outcome``.  A fused iota — never materialised."""
+    dt = jnp.uint32 if num_amps_log2 <= 32 else jnp.uint64
+    k = jax.lax.iota(dt, 1 << num_amps_log2)
+    return ((k >> target) & 1) == outcome
 
 
 @partial(jax.jit, static_argnames=("target",))
 def prob_of_zero(state: jax.Array, target: int) -> jax.Array:
     """P(qubit ``target`` = 0) for a statevector."""
     n = num_qubits_of(state)
-    t = state.reshape((2,) + (2,) * n)
-    idx = [slice(None)] * (n + 1)
-    idx[1 + _axis(target, n)] = 0
-    sub = t[tuple(idx)].astype(_ACC)
-    return jnp.sum(sub[0] ** 2 + sub[1] ** 2)
+    mask = _bit_mask(n, int(target), 0)
+    re, im = state[0].astype(_ACC), state[1].astype(_ACC)
+    return jnp.sum(jnp.where(mask, re * re + im * im, 0.0))
 
 
 @partial(jax.jit, static_argnames=("num_qubits",))
@@ -44,27 +55,20 @@ def densmatr_prob_of_zero(state: jax.Array, target: int, num_qubits: int) -> jax
     """P(target=0) = sum of diagonal elements with bit ``target`` clear
     (ref: densmatr_findProbabilityOfZeroLocal, QuEST_cpu.c:3151)."""
     diag = densmatr_diagonal(state, num_qubits)[0].astype(_ACC)
-    t = diag.reshape((2,) * num_qubits)
-    idx = [slice(None)] * num_qubits
-    idx[_axis(target, num_qubits)] = 0
-    return jnp.sum(t[tuple(idx)])
+    mask = _bit_mask(num_qubits, int(target), 0)
+    return jnp.sum(jnp.where(mask, diag, 0.0))
 
 
 @partial(jax.jit, static_argnames=("target", "outcome"))
 def collapse_to_outcome(state: jax.Array, target: int, outcome: int,
                         outcome_prob: jax.Array) -> jax.Array:
     """Zero the non-outcome half, renormalise the kept half by 1/sqrt(p)
-    (ref: collapseToKnownProbOutcomeLocal, QuEST_cpu.c:3380)."""
-    n = num_qubits_of(state)
-    t = state.reshape((2,) + (2,) * n)
-    a = _axis(target, n)
+    (ref: collapseToKnownProbOutcomeLocal, QuEST_cpu.c:3380) — a real
+    diagonal multiply through the universal engine."""
     renorm = 1.0 / jnp.sqrt(outcome_prob.astype(_ACC))
-    keep = jnp.zeros(2, dtype=_ACC).at[outcome].set(1.0)
-    factor = (keep * renorm).astype(state.dtype)
-    shape = [1] * (n + 1)
-    shape[1 + a] = 2
-    t = t * factor.reshape(shape)
-    return t.reshape(2, -1)
+    dr = jnp.zeros(2, dtype=_ACC).at[outcome].set(renorm)
+    d = jnp.stack([dr, jnp.zeros_like(dr)])
+    return apply_diagonal(state, d, (int(target),))
 
 
 @partial(jax.jit, static_argnames=("target", "outcome", "num_qubits"))
@@ -72,16 +76,9 @@ def densmatr_collapse_to_outcome(state: jax.Array, target: int, outcome: int,
                                  outcome_prob: jax.Array, num_qubits: int) -> jax.Array:
     """Zero every element whose row OR column bit differs from the outcome,
     renormalise survivors by 1/p (ref: densmatr_collapseToKnownProbOutcome,
-    QuEST_cpu.c:785)."""
-    n = 2 * num_qubits
-    t = state.reshape((2,) + (2,) * n)
-    row_axis = _axis(target, n)
-    col_axis = _axis(target + num_qubits, n)
-    keep = jnp.zeros(2, dtype=_ACC).at[outcome].set(1.0)
-    shape_r = [1] * (n + 1)
-    shape_r[1 + row_axis] = 2
-    shape_c = [1] * (n + 1)
-    shape_c[1 + col_axis] = 2
-    mask = (keep.reshape(shape_r) * keep.reshape(shape_c)) / outcome_prob.astype(_ACC)
-    t = t * mask.astype(state.dtype)
-    return t.reshape(2, -1)
+    QuEST_cpu.c:785) — a diagonal multiply on the (row, col) qubit pair of
+    the Choi-flattened matrix."""
+    # targets (q, q+N): index = row_bit + 2*col_bit; survivor at 3*outcome
+    dr = jnp.zeros(4, dtype=_ACC).at[3 * outcome].set(1.0 / outcome_prob.astype(_ACC))
+    d = jnp.stack([dr, jnp.zeros_like(dr)])
+    return apply_diagonal(state, d, (int(target), int(target) + num_qubits))
