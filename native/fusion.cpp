@@ -444,6 +444,10 @@ void pack_pass(std::vector<Gate>& gates, int32_t max_pack) {
 
     auto find_merge = [&](Gate& g) -> bool {
         if (!g.controls.empty()) return false;
+        // A lone 1q diagonal prefers joining a diagonal pack (stays a cheap
+        // broadcast multiply); failing that it densifies into the nearest
+        // disjoint dense pack it commuted past, recorded here.
+        int64_t dense_fallback = -1;
         for (int64_t j = static_cast<int64_t>(out.size()) - 1; j >= 0; j--) {
             Gate& cand = out[j];
             bool open = cand.controls.empty();
@@ -454,9 +458,13 @@ void pack_pass(std::vector<Gate>& gates, int32_t max_pack) {
                 if (open && cand.kind == KIND_DIAGONAL &&
                     merge_diag_union(cand, g, kDiagCap))
                     return true;
+                if (open && cand.kind == KIND_MATRIX && dense_fallback < 0 &&
+                    g.targets.size() == 1 && g.disjoint(cand) &&
+                    static_cast<int32_t>(cand.targets.size()) + 1 <= max_pack)
+                    dense_fallback = j;
                 if (cand.diagonal_like() || g.disjoint(cand))
                     continue;  // hop: commutes past
-                return false;
+                break;
             }
             if (g.kind == KIND_MATRIX) {
                 if (open && cand.kind == KIND_MATRIX) {
@@ -479,6 +487,16 @@ void pack_pass(std::vector<Gate>& gates, int32_t max_pack) {
                 return false;
             }
             return false;
+        }
+        if (g.kind == KIND_DIAGONAL && dense_fallback >= 0) {
+            // densify the 1q diagonal and kron it onto the recorded pack
+            // (valid: g commuted past everything to the pack's right)
+            densify(g);
+            Gate& cand = out[dense_fallback];
+            int64_t dl = int64_t{1} << cand.targets.size();
+            cand.payload = kron_dense(g.payload, 2, cand.payload, dl);
+            cand.targets.push_back(g.targets[0]);
+            return true;
         }
         return false;
     };

@@ -209,15 +209,20 @@ def apply_circuit(qureg, circuit: Circuit) -> None:
     get the conjugated shadow ops, cached per (circuit, n))."""
     if qureg.is_density_matrix:
         n = qureg.num_qubits_represented
+        src = circuit.key()
+        # cache keyed on (n, source ops): appending gates after a previous
+        # density application must rebuild the shadow list (tuple equality
+        # short-circuits on element identity, so a hit is O(len) pointer
+        # compares)
         cache = getattr(circuit, "_shadow_cache", None)
-        if cache is None or cache[0] != n:
+        if cache is None or cache[0] != n or cache[1] != src:
             ops = []
-            for op in circuit.ops:
+            for op in src:
                 ops.append(op)
                 ops.append(_shadow_op(op, n))
-            cache = (n, tuple(ops))
+            cache = (n, src, tuple(ops))
             circuit._shadow_cache = cache
-        qureg.amps = _run_ops(qureg.amps, cache[1])
+        qureg.amps = _run_ops(qureg.amps, cache[2])
     else:
         qureg.amps = _run_ops(qureg.amps, circuit.key())
 

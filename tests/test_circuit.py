@@ -82,3 +82,25 @@ def test_compile_circuit_pure_function(env_local):
     assert out.shape == (2, 16)
     norm = float(np.sum(np.asarray(out) ** 2))
     assert norm == pytest.approx(1.0, abs=1e-12)
+
+
+def test_density_shadow_cache_invalidated_on_append(env):
+    """Regression (r2 verdict): gates appended to a Circuit after a
+    density-matrix application must not be dropped by the shadow-op cache."""
+    c = qt.Circuit(3).h(0)
+    rho = qt.createDensityQureg(3, env)
+    qt.apply_circuit(rho, c)          # primes the shadow cache
+    np.testing.assert_allclose(np.diag(dm(rho))[:2], [0.5, 0.5], atol=1e-12)
+
+    c.x(0)                            # append AFTER the cache was built
+    qt.initZeroState(rho)
+    qt.apply_circuit(rho, c)          # must include the appended X
+    ref = qt.createDensityQureg(3, env)
+    qt.hadamard(ref, 0)
+    qt.pauliX(ref, 0)
+    np.testing.assert_allclose(dm(rho), dm(ref), atol=1e-12)
+
+    # same circuit object re-applied unchanged: cache hit must still be right
+    qt.initZeroState(rho)
+    qt.apply_circuit(rho, c)
+    np.testing.assert_allclose(dm(rho), dm(ref), atol=1e-12)

@@ -134,3 +134,14 @@ def test_pack_x_y_promotion(env_local):
     c.x(0).y(1).h(2).z(3)
     opt = _equiv(env_local, c, max_pack=7)
     assert len(opt) == 1
+
+
+def test_pack_diag_densify_after_break(env_local):
+    """A lone 1q diagonal that scans past a blocker still krons into the
+    disjoint dense pack recorded before the break (the fallback path)."""
+    c = qt.Circuit(3)
+    c.cnot(1, 2).h(0).s(2)
+    # s(2) cannot merge with cnot(1,2) (controlled, shares qubit 2) but must
+    # densify into the h(0) pack it commuted past -> cnot + dense{0,2}
+    opt = _equiv(env_local, c, max_pack=2)
+    assert len(opt) == 2
