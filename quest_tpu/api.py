@@ -1022,8 +1022,24 @@ def calcExpecPauliProd(qureg: Qureg, targets, codes, num_targets=None,
     return float(_calc.inner_product(prod_amps, qureg.amps)[0])
 
 
+def _pauli_sum_masks(codes: np.ndarray):
+    """Per-term bit masks of a (terms, n) Pauli-code array: x = mask(X|Y),
+    zy = mask(Z|Y), yc = #Y mod 4 — the static structure of the fused
+    Pauli-sum kernels (ops/calc.py)."""
+    codes = np.asarray(codes, dtype=np.int64)
+    weights = (np.uint64(1) << np.arange(codes.shape[1], dtype=np.uint64))
+    x = ((codes == PauliOpType.PAULI_X) | (codes == PauliOpType.PAULI_Y)) @ weights
+    zy = ((codes == PauliOpType.PAULI_Z) | (codes == PauliOpType.PAULI_Y)) @ weights
+    yc = (codes == PauliOpType.PAULI_Y).sum(axis=1) % 4
+    return (jnp.asarray(x, dtype=jnp.uint64), jnp.asarray(zy, dtype=jnp.uint64),
+            jnp.asarray(yc, dtype=jnp.int32))
+
+
 def calcExpecPauliSum(qureg: Qureg, all_codes, term_coeffs, num_sum_terms=None,
                       workspace=None) -> float:
+    """Σ_t c_t <P_t> as ONE compiled program — a lax.scan over stacked term
+    masks with no per-term dispatch or workspace clone (SURVEY §3.5; the
+    reference makes O(terms·n) full-state passes, QuEST_common.c:480-492)."""
     if workspace is None and not isinstance(num_sum_terms, (int, np.integer, type(None))):
         workspace = num_sum_terms
         num_sum_terms = None
@@ -1035,18 +1051,15 @@ def calcExpecPauliSum(qureg: Qureg, all_codes, term_coeffs, num_sum_terms=None,
         coeffs = coeffs[:int(num_sum_terms)]
     V.validate_num_pauli_sum_terms(len(codes), "calcExpecPauliSum")
     V.validate_pauli_codes(codes.ravel(), codes.size, "calcExpecPauliSum")
-    targets = tuple(range(n))
-    value = 0.0
-    for t in range(len(codes)):
-        prod_amps = _apply_pauli_prod(qureg.amps, targets, codes[t])
-        if workspace is not None:
-            workspace.amps = prod_amps
-        if qureg.is_density_matrix:
-            term = float(_calc.total_prob_densmatr(prod_amps, n))
-        else:
-            term = float(_calc.inner_product(prod_amps, qureg.amps)[0])
-        value += coeffs[t] * term
-    return value
+    if workspace is not None:
+        # parity with the reference: the workspace ends up holding the last
+        # term's Pauli product (QuEST_common.c:488 leaves it so)
+        workspace.amps = _apply_pauli_prod(qureg.amps, tuple(range(n)), codes[-1])
+    xm, zym, yc = _pauli_sum_masks(codes)
+    cf = jnp.asarray(coeffs)
+    if qureg.is_density_matrix:
+        return float(_calc.expec_pauli_sum_densmatr(qureg.amps, xm, zym, yc, cf, n))
+    return float(_calc.expec_pauli_sum_statevec(qureg.amps, xm, zym, yc, cf))
 
 
 def calcExpecPauliHamil(qureg: Qureg, hamil: PauliHamil, workspace=None) -> float:
@@ -1186,10 +1199,9 @@ def mixDensityMatrix(qureg: Qureg, prob: float, other: Qureg) -> None:
 
 def applyPauliSum(in_qureg: Qureg, all_codes, term_coeffs, num_sum_terms,
                   out_qureg: Qureg) -> None:
-    """out = Σ_t c_t P_t |in> (ref: statevec_applyPauliSum, QuEST_common.c:493-515).
-
-    Functional accumulate — the reference's in-place apply/undo on inQureg is
-    unnecessary under immutable arrays."""
+    """out = Σ_t c_t P_t |in> as ONE compiled scan over the stacked term masks
+    (ref: statevec_applyPauliSum, QuEST_common.c:493-515, which clones and
+    accumulates per term; row-side products on density quregs, as there)."""
     V.validate_matching_qureg_types(in_qureg, out_qureg, "applyPauliSum")
     V.validate_matching_qureg_dims(in_qureg, out_qureg, "applyPauliSum")
     n = in_qureg.num_qubits_represented
@@ -1197,11 +1209,9 @@ def applyPauliSum(in_qureg: Qureg, all_codes, term_coeffs, num_sum_terms,
     coeffs = np.asarray(term_coeffs, dtype=np.float64).ravel()[:int(num_sum_terms)]
     V.validate_num_pauli_sum_terms(len(codes), "applyPauliSum")
     V.validate_pauli_codes(codes.ravel(), codes.size, "applyPauliSum")
-    targets = tuple(range(n))
-    acc = _init.blank_state(in_qureg.num_amps_total, in_qureg.dtype)
-    for t in range(len(codes)):
-        acc = acc + coeffs[t] * _apply_pauli_prod(in_qureg.amps, targets, codes[t])
-    out_qureg.amps = acc.astype(out_qureg.dtype)
+    xm, zym, yc = _pauli_sum_masks(codes)
+    out_qureg.amps = _calc.apply_pauli_sum(
+        in_qureg.amps, xm, zym, yc, jnp.asarray(coeffs)).astype(out_qureg.dtype)
 
 
 def applyPauliHamil(in_qureg: Qureg, hamil: PauliHamil, out_qureg: Qureg) -> None:

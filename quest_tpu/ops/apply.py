@@ -192,18 +192,24 @@ def _gate_plan(n: int, targets: tuple, controls: tuple,
         busy = set(gate_bits) | {c for c, _ in pctrl}
         free = [q for q in range(n - 1, lo - 1, -1) if q not in busy]
         minors = sorted(b for b in gate_bits if b < lo)
-        if len(free) >= len(minors):  # else: oversized expansion beats crashing
-            moves, mapping = [], {}
-            for q in minors:
-                p = free.pop(0)
-                moves.append((q, p))
-                mapping[q] = p
-            return dataclasses.replace(
-                _gate_plan(n,
-                           tuple(mapping.get(q, q) for q in targets),
-                           tuple(mapping.get(c, c) for c in controls),
-                           control_states, diagonal),
-                reroute=tuple(moves))
+        if len(free) < len(minors):
+            # not enough free prefix qubits to reroute: the expanded matrix
+            # would exceed 2^_EXPAND_CAP.  Refuse, like the reference's
+            # fits-in-node guard (ref: QuEST_validation.c:144,
+            # validateMultiQubitMatrixFitsInNode :437)
+            from ..validation import ErrorCode, _throw
+            _throw(ErrorCode.CANNOT_FIT_MULTI_QUBIT_MATRIX)
+        moves, mapping = [], {}
+        for q in minors:
+            p = free.pop(0)
+            moves.append((q, p))
+            mapping[q] = p
+        return dataclasses.replace(
+            _gate_plan(n,
+                       tuple(mapping.get(q, q) for q in targets),
+                       tuple(mapping.get(c, c) for c in controls),
+                       control_states, diagonal),
+            reroute=tuple(moves))
 
     # maximal contiguous runs of prefix targets — each one axis, one wide
     # contraction dim

@@ -394,3 +394,18 @@ def test_multiControlledMultiQubitUnitary(env):
     psi = qt.createQureg(N, env)
     with pytest.raises(qt.QuESTError, match="disjoint"):
         qt.multiControlledMultiQubitUnitary(psi, [0, 1], 2, [1, 2], 2, random_unitary(2))
+
+
+def test_wide_minor_gate_refuses_oversized_expansion(env_local):
+    """A dense gate too wide to expand and with no free prefix qubits to
+    reroute onto must raise the reference's fits-in-node error
+    (ref: QuEST_validation.c:144) rather than build an oversized matrix."""
+    import jax.numpy as jnp
+    from quest_tpu.ops.apply import apply_matrix
+
+    n = 12
+    k = 11  # slots = 7 lane + 3 sublane + 1 prefix = 11 > _EXPAND_CAP
+    state = jnp.zeros((2, 1 << n), dtype=jnp.float32).at[0, 0].set(1.0)
+    mat = jnp.zeros((2, 1 << k, 1 << k), dtype=jnp.float32)
+    with pytest.raises(qt.QuESTError, match="cannot fit"):
+        apply_matrix(state, mat, tuple(range(k)))
