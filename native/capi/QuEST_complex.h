@@ -1,0 +1,37 @@
+/* Complex-scalar shim for the quest_tpu C front-end: adapts the runtime's
+ * value-struct Complex to the language's native complex type (C99 _Complex
+ * or C++ std::complex), mirroring the reference's QuEST_complex.h contract
+ * (qcomp + toComplex/fromComplex) without copying it. */
+
+#ifndef QUEST_TPU_COMPLEX_H
+#define QUEST_TPU_COMPLEX_H
+
+#include "quest_tpu_c.h"
+
+#ifdef __cplusplus
+
+#include <cmath>
+#include <complex>
+
+typedef std::complex<qreal> qcomp;
+/* part of the reference header's contract: user code written against it
+ * relies on std names and 3i-style literals being in scope */
+using namespace std;
+using namespace std::complex_literals;
+#define toComplex(scalar) \
+    (Complex{static_cast<qreal>(std::real(scalar)), \
+             static_cast<qreal>(std::imag(scalar))})
+#define fromComplex(comp) qcomp((comp).real, (comp).imag)
+
+#else
+
+#include <math.h>
+#include <complex.h>
+
+typedef double _Complex qcomp;
+#define toComplex(scalar) ((Complex) {.real = creal(scalar), .imag = cimag(scalar)})
+#define fromComplex(comp) ((comp).real + I*((comp).imag))
+
+#endif
+
+#endif /* QUEST_TPU_COMPLEX_H */

@@ -1,4 +1,4 @@
-// C front-end implementation: bridges the QuEST-compatible C API
+// C front-end implementation: bridges the full QuEST-compatible C API
 // (quest_tpu_c.h) onto the quest_tpu Python/JAX runtime via an embedded
 // CPython interpreter.
 //
@@ -6,27 +6,26 @@
 // directly (libQuEST.so); here the "kernels" are XLA programs managed by the
 // Python runtime, so the shim owns an interpreter, imports quest_tpu once,
 // and forwards each C call.  Handles in the public structs are PyObject
-// pointers.  Every call clears/raises on Python errors by printing and
-// exiting, matching the reference's exit-on-invalid-input behaviour
-// (ref: QuEST_validation.c exitWithError:167-173).
+// pointers.  Argument tuples are built with Py_BuildValue ("N" consumes the
+// reference of every freshly-built object, so nothing leaks per call).
+//
+// Validation errors raised Python-side (quest_tpu.QuESTError) are routed
+// through the weak symbol invalidQuESTInputError — exactly the reference's
+// test hook (ref: QuEST_validation.c:175-178): the default prints and exits,
+// and a test binary may override it with a throwing definition.
 
 #include "quest_tpu_c.h"
 
 #include <Python.h>
 
+#include <cstdarg>
 #include <cstdio>
 #include <cstdlib>
+#include <cstring>
 
 namespace {
 
 PyObject* g_module = nullptr;
-
-void die_on_python_error() {
-    if (PyErr_Occurred()) {
-        PyErr_Print();
-        std::exit(1);
-    }
-}
 
 PyObject* mod() {
     if (!g_module) {
@@ -34,41 +33,169 @@ PyObject* mod() {
             Py_Initialize();
         }
         g_module = PyImport_ImportModule("quest_tpu");
-        die_on_python_error();
+        if (!g_module) {
+            PyErr_Print();
+            std::fprintf(stderr, "quest_tpu_c: cannot import quest_tpu\n");
+            std::exit(1);
+        }
     }
     return g_module;
 }
 
-// call quest_tpu.<name>(args...) with a new reference result.  stdout is
-// flushed on both sides so C printf and Python print interleave in order.
-PyObject* call(const char* name, PyObject* args) {
+// Route a pending Python exception through the invalidQuESTInputError hook
+// (QuESTError) or print-and-exit (anything else).  If the hook returns
+// normally the failed operation is skipped, mirroring the reference's
+// weak-symbol contract.
+void handle_python_error() {
+    if (!PyErr_Occurred()) return;
+    PyObject *type, *value, *tb;
+    PyErr_Fetch(&type, &value, &tb);
+    PyErr_NormalizeException(&type, &value, &tb);
+
+    PyObject* qe_cls = PyObject_GetAttrString(mod(), "QuESTError");
+    bool is_quest = qe_cls && value &&
+                    PyObject_IsInstance(value, qe_cls) == 1;
+    Py_XDECREF(qe_cls);
+    PyErr_Clear();
+
+    if (!is_quest) {
+        PyErr_Restore(type, value, tb);
+        PyErr_Print();
+        std::exit(1);
+    }
+
+    // static: an overriding hook may `throw errMsg` (the reference's own
+    // tests/main.cpp:27-29 does) and the pointer must outlive this frame
+    static char msg[1024];
+    static char func[256];
+    std::snprintf(msg, sizeof msg, "unknown error");
+    func[0] = '\0';
+    PyObject* m = PyObject_GetAttrString(value, "message");
+    if (m) {
+        const char* s = PyUnicode_AsUTF8(m);
+        if (s) std::snprintf(msg, sizeof msg, "%s", s);
+        Py_DECREF(m);
+    }
+    PyErr_Clear();
+    PyObject* f = PyObject_GetAttrString(value, "func");
+    if (f) {
+        if (f != Py_None) {
+            const char* s = PyUnicode_AsUTF8(f);
+            if (s) std::snprintf(func, sizeof func, "%s", s);
+        }
+        Py_DECREF(f);
+    }
+    PyErr_Clear();
+    Py_XDECREF(type);
+    Py_XDECREF(value);
+    Py_XDECREF(tb);
+    invalidQuESTInputError(msg, func);  // may exit, may throw, may return
+}
+
+// call quest_tpu.<name>(args...); fmt is a Py_BuildValue tuple format like
+// "(Nid)" (nullptr fmt = no arguments).  Returns a new reference, or nullptr
+// if a validation error was routed through a returning hook.
+PyObject* pycall(const char* name, const char* fmt, ...) {
     std::fflush(stdout);
+    PyObject* args = nullptr;
+    if (fmt) {
+        va_list va;
+        va_start(va, fmt);
+        args = Py_VaBuildValue(fmt, va);
+        va_end(va);
+        if (!args) { handle_python_error(); return nullptr; }
+    }
     PyObject* fn = PyObject_GetAttrString(mod(), name);
-    die_on_python_error();
+    if (!fn) { Py_XDECREF(args); handle_python_error(); return nullptr; }
     PyObject* result = PyObject_CallObject(fn, args);
-    Py_XDECREF(fn);
+    Py_DECREF(fn);
     Py_XDECREF(args);
-    die_on_python_error();
+    if (!result) handle_python_error();
     PyRun_SimpleString("import sys; sys.stdout.flush()");
     return result;
 }
 
-PyObject* int_list(const int* xs, int n) {
+void drop(PyObject* o) { Py_XDECREF(o); }
+
+double to_double(PyObject* o) {
+    if (!o) return 0.0;
+    double v = PyFloat_AsDouble(o);
+    if (PyErr_Occurred()) { PyErr_Clear(); v = 0.0; }
+    Py_DECREF(o);
+    return v;
+}
+
+long long to_ll(PyObject* o) {
+    if (!o) return 0;
+    long long v = PyLong_AsLongLong(o);
+    if (PyErr_Occurred()) { PyErr_Clear(); v = 0; }
+    Py_DECREF(o);
+    return v;
+}
+
+Complex to_cmplx(PyObject* o) {
+    Complex c = {0.0, 0.0};
+    if (!o) return c;
+    c.real = PyComplex_RealAsDouble(o);
+    c.imag = PyComplex_ImagAsDouble(o);
+    if (PyErr_Occurred()) { PyErr_Clear(); c.real = c.imag = 0.0; }
+    Py_DECREF(o);
+    return c;
+}
+
+// ---- object builders (each returns a NEW reference; pass with "N") --------
+
+PyObject* qh(Qureg q) {
+    PyObject* h = static_cast<PyObject*>(q.handle);
+    Py_INCREF(h);
+    return h;
+}
+
+PyObject* eh(QuESTEnv env) {
+    PyObject* h = static_cast<PyObject*>(env.handle);
+    Py_INCREF(h);
+    return h;
+}
+
+PyObject* dh(DiagonalOp op) {
+    PyObject* h = static_cast<PyObject*>(op.handle);
+    Py_INCREF(h);
+    return h;
+}
+
+PyObject* int_list(const int* xs, long long n) {
     PyObject* list = PyList_New(n);
-    for (int i = 0; i < n; i++)
+    for (long long i = 0; i < n; i++)
         PyList_SET_ITEM(list, i, PyLong_FromLong(xs[i]));
     return list;
 }
 
-PyObject* complex_obj(Complex c) {
-    return PyComplex_FromDoubles(c.real, c.imag);
+PyObject* pauli_list(const enum pauliOpType* xs, long long n) {
+    PyObject* list = PyList_New(n);
+    for (long long i = 0; i < n; i++)
+        PyList_SET_ITEM(list, i, PyLong_FromLong(static_cast<long>(xs[i])));
+    return list;
 }
 
-PyObject* matrix2_obj(ComplexMatrix2 u) {
-    PyObject* rows = PyList_New(2);
-    for (int r = 0; r < 2; r++) {
-        PyObject* row = PyList_New(2);
-        for (int c = 0; c < 2; c++)
+PyObject* double_list(const qreal* xs, long long n) {
+    PyObject* list = PyList_New(n);
+    for (long long i = 0; i < n; i++)
+        PyList_SET_ITEM(list, i, PyFloat_FromDouble(xs[i]));
+    return list;
+}
+
+PyObject* cobj(Complex c) { return PyComplex_FromDoubles(c.real, c.imag); }
+
+PyObject* vec_obj(Vector v) {
+    return Py_BuildValue("(ddd)", v.x, v.y, v.z);
+}
+
+template <typename M>
+PyObject* mat_obj_dim(const M& u, int dim) {
+    PyObject* rows = PyList_New(dim);
+    for (int r = 0; r < dim; r++) {
+        PyObject* row = PyList_New(dim);
+        for (int c = 0; c < dim; c++)
             PyList_SET_ITEM(row, c, PyComplex_FromDoubles(u.real[r][c],
                                                           u.imag[r][c]));
         PyList_SET_ITEM(rows, r, row);
@@ -76,7 +203,10 @@ PyObject* matrix2_obj(ComplexMatrix2 u) {
     return rows;
 }
 
-PyObject* matrixN_obj(ComplexMatrixN u) {
+PyObject* m2(ComplexMatrix2 u) { return mat_obj_dim(u, 2); }
+PyObject* m4(ComplexMatrix4 u) { return mat_obj_dim(u, 4); }
+
+PyObject* mN(ComplexMatrixN u) {
     int dim = 1 << u.numQubits;
     PyObject* rows = PyList_New(dim);
     for (int r = 0; r < dim; r++) {
@@ -89,51 +219,77 @@ PyObject* matrixN_obj(ComplexMatrixN u) {
     return rows;
 }
 
-double as_double(PyObject* o) {
-    double v = PyFloat_AsDouble(o);
-    die_on_python_error();
-    Py_XDECREF(o);
-    return v;
+PyObject* m2_list(const ComplexMatrix2* ops, int n) {
+    PyObject* list = PyList_New(n);
+    for (int i = 0; i < n; i++) PyList_SET_ITEM(list, i, m2(ops[i]));
+    return list;
 }
 
-long as_long(PyObject* o) {
-    long v = PyLong_AsLong(o);
-    die_on_python_error();
-    Py_XDECREF(o);
-    return v;
+PyObject* m4_list(const ComplexMatrix4* ops, int n) {
+    PyObject* list = PyList_New(n);
+    for (int i = 0; i < n; i++) PyList_SET_ITEM(list, i, m4(ops[i]));
+    return list;
 }
 
-PyObject* qureg_handle(Qureg q) {
-    PyObject* h = static_cast<PyObject*>(q.handle);
-    Py_INCREF(h);
-    return h;
+PyObject* mN_list(const ComplexMatrixN* ops, int n) {
+    PyObject* list = PyList_New(n);
+    for (int i = 0; i < n; i++) PyList_SET_ITEM(list, i, mN(ops[i]));
+    return list;
 }
 
-// gate helper: quest_tpu.<name>(qureg, ...) discarding the result
-void gate_call(const char* name, Qureg q, PyObject* rest /* tuple or null */) {
-    Py_ssize_t extra = rest ? PyTuple_Size(rest) : 0;
-    PyObject* args = PyTuple_New(1 + extra);
-    PyTuple_SET_ITEM(args, 0, qureg_handle(q));
-    for (Py_ssize_t i = 0; i < extra; i++) {
-        PyObject* item = PyTuple_GetItem(rest, i);
-        Py_INCREF(item);
-        PyTuple_SET_ITEM(args, 1 + i, item);
+// build a Python PauliHamil mirroring the C struct's current arrays
+PyObject* hamil_obj(PauliHamil h) {
+    PyObject* ph = pycall("createPauliHamil", "(ii)", h.numQubits, h.numSumTerms);
+    if (!ph) return nullptr;
+    drop(pycall("initPauliHamil", "(ONN)", ph,
+                double_list(h.termCoeffs, h.numSumTerms),
+                pauli_list(h.pauliCodes,
+                           (long long)h.numSumTerms * h.numQubits)));
+    return ph;
+}
+
+// copy a (2, numAmps) float64 numpy buffer into a ComplexArray mirror
+void fill_state_mirror(PyObject* buf, ComplexArray dst, long long numAmps) {
+    if (!buf || !dst.real) { drop(buf); return; }
+    Py_buffer view;
+    if (PyObject_GetBuffer(buf, &view, PyBUF_C_CONTIGUOUS) == 0) {
+        const double* d = static_cast<const double*>(view.buf);
+        std::memcpy(dst.real, d, sizeof(double) * numAmps);
+        std::memcpy(dst.imag, d + numAmps, sizeof(double) * numAmps);
+        PyBuffer_Release(&view);
+    } else {
+        PyErr_Clear();
     }
-    Py_XDECREF(rest);
-    Py_XDECREF(call(name, args));
+    drop(buf);
 }
 
 }  // namespace
 
 extern "C" {
 
+// default hook: print and exit, like the reference (QuEST_validation.c:167-178)
+__attribute__((weak)) void invalidQuESTInputError(const char* errMsg,
+                                                  const char* errFunc) {
+    std::printf("!!!\n");
+    std::printf("QuEST Error in function %s: %s\n", errFunc, errMsg);
+    std::printf("!!!\n");
+    std::printf("exiting..\n");
+    std::exit(1);
+}
+
+/* ---- environment ------------------------------------------------------- */
+
 QuESTEnv createQuESTEnv(void) {
-    PyObject* env = call("createQuESTEnv", nullptr);
+    PyObject* env = pycall("createQuESTEnv", nullptr);
     QuESTEnv out;
     out.rank = 0;
-    PyObject* nr = PyObject_GetAttrString(env, "num_ranks");
-    out.numRanks = static_cast<int>(PyLong_AsLong(nr));
-    Py_XDECREF(nr);
+    out.numRanks = 1;
+    if (env) {
+        PyObject* nr = PyObject_GetAttrString(env, "num_ranks");
+        if (nr) out.numRanks = static_cast<int>(PyLong_AsLong(nr));
+        Py_XDECREF(nr);
+        PyErr_Clear();
+    }
     out.handle = env;
     return out;
 }
@@ -142,69 +298,119 @@ void destroyQuESTEnv(QuESTEnv env) {
     Py_XDECREF(static_cast<PyObject*>(env.handle));
 }
 
-void syncQuESTEnv(QuESTEnv env) {
-    PyObject* args = PyTuple_New(1);
-    PyObject* h = static_cast<PyObject*>(env.handle);
-    Py_INCREF(h);
-    PyTuple_SET_ITEM(args, 0, h);
-    Py_XDECREF(call("syncQuESTEnv", args));
+void syncQuESTEnv(QuESTEnv env) { drop(pycall("syncQuESTEnv", "(N)", eh(env))); }
+
+int syncQuESTSuccess(int successCode) {
+    // single-controller SPMD: no cross-rank agreement needed
+    // (ref: Allreduce(LAND), QuEST_cpu_distributed.c:166-170)
+    return successCode;
 }
 
-void reportQuESTEnv(QuESTEnv env) {
-    PyObject* args = PyTuple_New(1);
-    PyObject* h = static_cast<PyObject*>(env.handle);
-    Py_INCREF(h);
-    PyTuple_SET_ITEM(args, 0, h);
-    Py_XDECREF(call("reportQuESTEnv", args));
+void reportQuESTEnv(QuESTEnv env) { drop(pycall("reportQuESTEnv", "(N)", eh(env))); }
+
+void getEnvironmentString(QuESTEnv env, Qureg qureg, char str[200]) {
+    PyObject* s = pycall("getEnvironmentString", "(NN)", eh(env), qh(qureg));
+    str[0] = '\0';
+    if (s) {
+        const char* c = PyUnicode_AsUTF8(s);
+        if (c) std::snprintf(str, 200, "%s", c);
+        PyErr_Clear();
+        Py_DECREF(s);
+    }
 }
 
 void seedQuEST(unsigned long int* seedArray, int numSeeds) {
     PyObject* list = PyList_New(numSeeds);
     for (int i = 0; i < numSeeds; i++)
         PyList_SET_ITEM(list, i, PyLong_FromUnsignedLong(seedArray[i]));
-    PyObject* args = PyTuple_Pack(2, list, PyLong_FromLong(numSeeds));
-    Py_XDECREF(call("seedQuEST", args));
+    drop(pycall("seedQuEST", "(Ni)", list, numSeeds));
 }
 
-static Qureg make_qureg(const char* ctor, int numQubits, QuESTEnv env) {
-    PyObject* h = static_cast<PyObject*>(env.handle);
-    Py_INCREF(h);
-    PyObject* args = PyTuple_New(2);
-    PyTuple_SET_ITEM(args, 0, PyLong_FromLong(numQubits));
-    PyTuple_SET_ITEM(args, 1, h);
-    PyObject* q = call(ctor, args);
+void seedQuESTDefault(void) { drop(pycall("seedQuESTDefault", nullptr)); }
+
+/* ---- registers --------------------------------------------------------- */
+
+static Qureg make_qureg(PyObject* q, int numQubits, int isDensity) {
     Qureg out;
-    PyObject* isdm = PyObject_GetAttrString(q, "is_density_matrix");
-    out.isDensityMatrix = PyObject_IsTrue(isdm);
-    Py_XDECREF(isdm);
+    out.isDensityMatrix = isDensity;
     out.numQubitsRepresented = numQubits;
-    out.numAmpsTotal = 1LL << (numQubits * (out.isDensityMatrix ? 2 : 1));
+    out.numQubitsInStateVec = numQubits * (isDensity ? 2 : 1);
+    out.numAmpsTotal = 1LL << out.numQubitsInStateVec;
+    out.numAmpsPerChunk = out.numAmpsTotal;
+    out.chunkId = 0;
+    out.numChunks = 1;
+    // host SoA mirror, the reference's own memory model (16 B/amp at f64,
+    // ref: QuEST_cpu.c:1279-1315); filled on demand by copyStateFromGPU
+    out.stateVec.real = static_cast<qreal*>(
+        std::malloc(sizeof(qreal) * out.numAmpsTotal));
+    out.stateVec.imag = static_cast<qreal*>(
+        std::malloc(sizeof(qreal) * out.numAmpsTotal));
+    out.pairStateVec.real = nullptr;
+    out.pairStateVec.imag = nullptr;
     out.handle = q;
     return out;
 }
 
 Qureg createQureg(int numQubits, QuESTEnv env) {
-    return make_qureg("createQureg", numQubits, env);
+    PyObject* q = pycall("createQureg", "(iN)", numQubits, eh(env));
+    return make_qureg(q, numQubits, 0);
 }
 
 Qureg createDensityQureg(int numQubits, QuESTEnv env) {
-    return make_qureg("createDensityQureg", numQubits, env);
+    PyObject* q = pycall("createDensityQureg", "(iN)", numQubits, eh(env));
+    return make_qureg(q, numQubits, 1);
+}
+
+Qureg createCloneQureg(Qureg qureg, QuESTEnv env) {
+    PyObject* q = pycall("createCloneQureg", "(NN)", qh(qureg), eh(env));
+    return make_qureg(q, qureg.numQubitsRepresented, qureg.isDensityMatrix);
 }
 
 void destroyQureg(Qureg qureg, QuESTEnv env) {
     (void)env;
-    gate_call("destroyQureg", qureg, nullptr);
+    drop(pycall("destroyQureg", "(N)", qh(qureg)));
+    std::free(qureg.stateVec.real);
+    std::free(qureg.stateVec.imag);
     Py_XDECREF(static_cast<PyObject*>(qureg.handle));
 }
 
-void reportQuregParams(Qureg qureg) { gate_call("reportQuregParams", qureg, nullptr); }
-
-void reportStateToScreen(Qureg qureg, QuESTEnv env, int reportRank) {
-    PyObject* h = static_cast<PyObject*>(env.handle);
-    Py_INCREF(h);
-    gate_call("reportStateToScreen", qureg,
-              PyTuple_Pack(2, h, PyLong_FromLong(reportRank)));
+void cloneQureg(Qureg targetQureg, Qureg copyQureg) {
+    drop(pycall("cloneQureg", "(NN)", qh(targetQureg), qh(copyQureg)));
 }
+
+int getNumQubits(Qureg qureg) { return qureg.numQubitsRepresented; }
+
+long long int getNumAmps(Qureg qureg) {
+    return to_ll(pycall("getNumAmps", "(N)", qh(qureg)));
+}
+
+void reportQuregParams(Qureg q) { drop(pycall("reportQuregParams", "(N)", qh(q))); }
+void reportState(Qureg q) { drop(pycall("reportState", "(N)", qh(q))); }
+
+void reportStateToScreen(Qureg q, QuESTEnv env, int reportRank) {
+    drop(pycall("reportStateToScreen", "(NNi)", qh(q), eh(env), reportRank));
+}
+
+void copyStateToGPU(Qureg q) {
+    // push the host mirror into the device state (ref: QuEST_gpu.cu:451-460)
+    if (!q.stateVec.real) return;
+    if (q.isDensityMatrix)
+        drop(pycall("setDensityAmps", "(NNN)", qh(q),
+                    double_list(q.stateVec.real, q.numAmpsTotal),
+                    double_list(q.stateVec.imag, q.numAmpsTotal)));
+    else
+        drop(pycall("initStateFromAmps", "(NNN)", qh(q),
+                    double_list(q.stateVec.real, q.numAmpsTotal),
+                    double_list(q.stateVec.imag, q.numAmpsTotal)));
+}
+
+void copyStateFromGPU(Qureg q) {
+    // pull the device state into the host mirror (ref: QuEST_gpu.cu:462-473)
+    fill_state_mirror(pycall("_amps_buffer", "(N)", qh(q)), q.stateVec,
+                      q.numAmpsTotal);
+}
+
+/* ---- matrices & operator structs --------------------------------------- */
 
 ComplexMatrixN createComplexMatrixN(int numQubits) {
     int dim = 1 << numQubits;
@@ -229,142 +435,533 @@ void destroyComplexMatrixN(ComplexMatrixN m) {
     std::free(m.imag);
 }
 
-/* state initialisation */
-void initZeroState(Qureg q) { gate_call("initZeroState", q, nullptr); }
-void initPlusState(Qureg q) { gate_call("initPlusState", q, nullptr); }
-void initBlankState(Qureg q) { gate_call("initBlankState", q, nullptr); }
-void initClassicalState(Qureg q, long long int s) {
-    gate_call("initClassicalState", q, PyTuple_Pack(1, PyLong_FromLongLong(s)));
+// C declaration uses VLA types (see header); ABI-compatible flat definition
+void initComplexMatrixN(ComplexMatrixN m, qreal* real, qreal* imag) {
+    int dim = 1 << m.numQubits;
+    for (int r = 0; r < dim; r++)
+        for (int c = 0; c < dim; c++) {
+            m.real[r][c] = real[r * dim + c];
+            m.imag[r][c] = imag[r * dim + c];
+        }
 }
 
-/* gates */
-void hadamard(Qureg q, int t) { gate_call("hadamard", q, PyTuple_Pack(1, PyLong_FromLong(t))); }
-void pauliX(Qureg q, int t) { gate_call("pauliX", q, PyTuple_Pack(1, PyLong_FromLong(t))); }
-void pauliY(Qureg q, int t) { gate_call("pauliY", q, PyTuple_Pack(1, PyLong_FromLong(t))); }
-void pauliZ(Qureg q, int t) { gate_call("pauliZ", q, PyTuple_Pack(1, PyLong_FromLong(t))); }
-void sGate(Qureg q, int t) { gate_call("sGate", q, PyTuple_Pack(1, PyLong_FromLong(t))); }
-void tGate(Qureg q, int t) { gate_call("tGate", q, PyTuple_Pack(1, PyLong_FromLong(t))); }
+ComplexMatrixN bindArraysToStackComplexMatrixN(
+        int numQubits, qreal* re, qreal* im,
+        qreal** reStorage, qreal** imStorage) {
+    int dim = 1 << numQubits;
+    for (int r = 0; r < dim; r++) {
+        reStorage[r] = re + r * dim;
+        imStorage[r] = im + r * dim;
+    }
+    ComplexMatrixN m;
+    m.numQubits = numQubits;
+    m.real = reStorage;
+    m.imag = imStorage;
+    return m;
+}
+
+PauliHamil createPauliHamil(int numQubits, int numSumTerms) {
+    PauliHamil h;
+    h.numQubits = numQubits;
+    h.numSumTerms = numSumTerms;
+    h.pauliCodes = static_cast<enum pauliOpType*>(
+        std::calloc((size_t)numSumTerms * numQubits, sizeof(enum pauliOpType)));
+    h.termCoeffs = static_cast<qreal*>(
+        std::calloc(numSumTerms, sizeof(qreal)));
+    return h;
+}
+
+void destroyPauliHamil(PauliHamil h) {
+    std::free(h.pauliCodes);
+    std::free(h.termCoeffs);
+}
+
+PauliHamil createPauliHamilFromFile(char* fn) {
+    PauliHamil h = {nullptr, nullptr, 0, 0};
+    PyObject* ph = pycall("createPauliHamilFromFile", "(s)", fn);
+    if (!ph) return h;
+    PyObject* pair = pycall("_hamil_buffers", "(O)", ph);
+    PyObject* nq = PyObject_GetAttrString(ph, "num_qubits");
+    PyObject* nt = PyObject_GetAttrString(ph, "num_sum_terms");
+    h.numQubits = nq ? static_cast<int>(PyLong_AsLong(nq)) : 0;
+    h.numSumTerms = nt ? static_cast<int>(PyLong_AsLong(nt)) : 0;
+    Py_XDECREF(nq);
+    Py_XDECREF(nt);
+    PyErr_Clear();
+    h.pauliCodes = static_cast<enum pauliOpType*>(
+        std::calloc((size_t)h.numSumTerms * h.numQubits,
+                    sizeof(enum pauliOpType)));
+    h.termCoeffs = static_cast<qreal*>(std::calloc(h.numSumTerms, sizeof(qreal)));
+    if (pair && PyTuple_Check(pair) && PyTuple_Size(pair) == 2) {
+        Py_buffer cv, fv;
+        if (PyObject_GetBuffer(PyTuple_GetItem(pair, 0), &cv,
+                               PyBUF_C_CONTIGUOUS) == 0) {
+            const int* codes = static_cast<const int*>(cv.buf);
+            for (long long i = 0; i < (long long)h.numSumTerms * h.numQubits; i++)
+                h.pauliCodes[i] = static_cast<enum pauliOpType>(codes[i]);
+            PyBuffer_Release(&cv);
+        } else PyErr_Clear();
+        if (PyObject_GetBuffer(PyTuple_GetItem(pair, 1), &fv,
+                               PyBUF_C_CONTIGUOUS) == 0) {
+            std::memcpy(h.termCoeffs, fv.buf, sizeof(qreal) * h.numSumTerms);
+            PyBuffer_Release(&fv);
+        } else PyErr_Clear();
+    }
+    drop(pair);
+    drop(ph);
+    return h;
+}
+
+void initPauliHamil(PauliHamil h, qreal* coeffs, enum pauliOpType* codes) {
+    std::memcpy(h.termCoeffs, coeffs, sizeof(qreal) * h.numSumTerms);
+    std::memcpy(h.pauliCodes, codes,
+                sizeof(enum pauliOpType) * (size_t)h.numSumTerms * h.numQubits);
+}
+
+void reportPauliHamil(PauliHamil h) {
+    drop(pycall("reportPauliHamil", "(N)", hamil_obj(h)));
+}
+
+DiagonalOp createDiagonalOp(int numQubits, QuESTEnv env) {
+    DiagonalOp op;
+    op.numQubits = numQubits;
+    op.numElemsPerChunk = 1LL << numQubits;
+    op.numChunks = 1;
+    op.chunkId = 0;
+    op.real = static_cast<qreal*>(
+        std::calloc(op.numElemsPerChunk, sizeof(qreal)));
+    op.imag = static_cast<qreal*>(
+        std::calloc(op.numElemsPerChunk, sizeof(qreal)));
+    op.handle = pycall("createDiagonalOp", "(iN)", numQubits, eh(env));
+    return op;
+}
+
+void destroyDiagonalOp(DiagonalOp op, QuESTEnv env) {
+    (void)env;
+    drop(pycall("destroyDiagonalOp", "(N)", dh(op)));
+    std::free(op.real);
+    std::free(op.imag);
+    Py_XDECREF(static_cast<PyObject*>(op.handle));
+}
+
+void syncDiagonalOp(DiagonalOp op) {
+    // push the host elements to the device copy (ref: agnostic_syncDiagonalOp)
+    long long dim = op.numElemsPerChunk;
+    drop(pycall("setDiagonalOpElems", "(NLNNL)", dh(op), 0LL,
+                double_list(op.real, dim), double_list(op.imag, dim), dim));
+}
+
+void initDiagonalOp(DiagonalOp op, qreal* real, qreal* imag) {
+    long long dim = op.numElemsPerChunk;
+    std::memcpy(op.real, real, sizeof(qreal) * dim);
+    std::memcpy(op.imag, imag, sizeof(qreal) * dim);
+    syncDiagonalOp(op);
+}
+
+void setDiagonalOpElems(DiagonalOp op, long long int startInd,
+                        qreal* real, qreal* imag, long long int numElems) {
+    if (startInd >= 0 && startInd + numElems <= op.numElemsPerChunk) {
+        std::memcpy(op.real + startInd, real, sizeof(qreal) * numElems);
+        std::memcpy(op.imag + startInd, imag, sizeof(qreal) * numElems);
+    }
+    drop(pycall("setDiagonalOpElems", "(NLNNL)", dh(op), startInd,
+                double_list(real, numElems), double_list(imag, numElems),
+                numElems));
+}
+
+/* ---- state initialisation ---------------------------------------------- */
+
+void initBlankState(Qureg q) { drop(pycall("initBlankState", "(N)", qh(q))); }
+void initZeroState(Qureg q) { drop(pycall("initZeroState", "(N)", qh(q))); }
+void initPlusState(Qureg q) { drop(pycall("initPlusState", "(N)", qh(q))); }
+
+void initClassicalState(Qureg q, long long int s) {
+    drop(pycall("initClassicalState", "(NL)", qh(q), s));
+}
+
+void initPureState(Qureg q, Qureg pure) {
+    drop(pycall("initPureState", "(NN)", qh(q), qh(pure)));
+}
+
+void initDebugState(Qureg q) { drop(pycall("initDebugState", "(N)", qh(q))); }
+
+void initStateFromAmps(Qureg q, qreal* reals, qreal* imags) {
+    drop(pycall("initStateFromAmps", "(NNN)", qh(q),
+                double_list(reals, q.numAmpsTotal),
+                double_list(imags, q.numAmpsTotal)));
+}
+
+void setAmps(Qureg q, long long int startInd, qreal* reals, qreal* imags,
+             long long int numAmps) {
+    drop(pycall("setAmps", "(NLNNL)", qh(q), startInd,
+                double_list(reals, numAmps), double_list(imags, numAmps),
+                numAmps));
+}
+
+void setWeightedQureg(Complex fac1, Qureg q1, Complex fac2, Qureg q2,
+                      Complex facOut, Qureg out) {
+    drop(pycall("setWeightedQureg", "(NNNNNN)", cobj(fac1), qh(q1),
+                cobj(fac2), qh(q2), cobj(facOut), qh(out)));
+}
+
+/* ---- QASM logging ------------------------------------------------------ */
+
+void startRecordingQASM(Qureg q) { drop(pycall("startRecordingQASM", "(N)", qh(q))); }
+void stopRecordingQASM(Qureg q) { drop(pycall("stopRecordingQASM", "(N)", qh(q))); }
+void clearRecordedQASM(Qureg q) { drop(pycall("clearRecordedQASM", "(N)", qh(q))); }
+void printRecordedQASM(Qureg q) { drop(pycall("printRecordedQASM", "(N)", qh(q))); }
+
+void writeRecordedQASMToFile(Qureg q, char* filename) {
+    drop(pycall("writeRecordedQASMToFile", "(Ns)", qh(q), filename));
+}
+
+/* ---- unitaries --------------------------------------------------------- */
 
 void phaseShift(Qureg q, int t, qreal a) {
-    gate_call("phaseShift", q, PyTuple_Pack(2, PyLong_FromLong(t), PyFloat_FromDouble(a)));
-}
-void rotateX(Qureg q, int t, qreal a) {
-    gate_call("rotateX", q, PyTuple_Pack(2, PyLong_FromLong(t), PyFloat_FromDouble(a)));
-}
-void rotateY(Qureg q, int t, qreal a) {
-    gate_call("rotateY", q, PyTuple_Pack(2, PyLong_FromLong(t), PyFloat_FromDouble(a)));
-}
-void rotateZ(Qureg q, int t, qreal a) {
-    gate_call("rotateZ", q, PyTuple_Pack(2, PyLong_FromLong(t), PyFloat_FromDouble(a)));
+    drop(pycall("phaseShift", "(Nid)", qh(q), t, a));
 }
 
-void rotateAroundAxis(Qureg q, int t, qreal a, Vector axis) {
-    PyObject* ax = PyTuple_Pack(3, PyFloat_FromDouble(axis.x),
-                                PyFloat_FromDouble(axis.y),
-                                PyFloat_FromDouble(axis.z));
-    gate_call("rotateAroundAxis", q,
-              PyTuple_Pack(3, PyLong_FromLong(t), PyFloat_FromDouble(a), ax));
-}
-
-void controlledNot(Qureg q, int c, int t) {
-    gate_call("controlledNot", q, PyTuple_Pack(2, PyLong_FromLong(c), PyLong_FromLong(t)));
-}
-void controlledPhaseFlip(Qureg q, int a, int b) {
-    gate_call("controlledPhaseFlip", q, PyTuple_Pack(2, PyLong_FromLong(a), PyLong_FromLong(b)));
-}
 void controlledPhaseShift(Qureg q, int a, int b, qreal angle) {
-    gate_call("controlledPhaseShift", q,
-              PyTuple_Pack(3, PyLong_FromLong(a), PyLong_FromLong(b),
-                           PyFloat_FromDouble(angle)));
+    drop(pycall("controlledPhaseShift", "(Niid)", qh(q), a, b, angle));
 }
+
+void multiControlledPhaseShift(Qureg q, int* qs, int n, qreal angle) {
+    drop(pycall("multiControlledPhaseShift", "(NNid)", qh(q), int_list(qs, n),
+                n, angle));
+}
+
+void controlledPhaseFlip(Qureg q, int a, int b) {
+    drop(pycall("controlledPhaseFlip", "(Nii)", qh(q), a, b));
+}
+
 void multiControlledPhaseFlip(Qureg q, int* qs, int n) {
-    gate_call("multiControlledPhaseFlip", q,
-              PyTuple_Pack(2, int_list(qs, n), PyLong_FromLong(n)));
+    drop(pycall("multiControlledPhaseFlip", "(NNi)", qh(q), int_list(qs, n), n));
 }
-void swapGate(Qureg q, int a, int b) {
-    gate_call("swapGate", q, PyTuple_Pack(2, PyLong_FromLong(a), PyLong_FromLong(b)));
-}
+
+void sGate(Qureg q, int t) { drop(pycall("sGate", "(Ni)", qh(q), t)); }
+void tGate(Qureg q, int t) { drop(pycall("tGate", "(Ni)", qh(q), t)); }
 
 void unitary(Qureg q, int t, ComplexMatrix2 u) {
-    gate_call("unitary", q, PyTuple_Pack(2, PyLong_FromLong(t), matrix2_obj(u)));
+    drop(pycall("unitary", "(NiN)", qh(q), t, m2(u)));
 }
+
 void compactUnitary(Qureg q, int t, Complex alpha, Complex beta) {
-    gate_call("compactUnitary", q,
-              PyTuple_Pack(3, PyLong_FromLong(t), complex_obj(alpha), complex_obj(beta)));
+    drop(pycall("compactUnitary", "(NiNN)", qh(q), t, cobj(alpha), cobj(beta)));
 }
+
+void rotateX(Qureg q, int t, qreal a) { drop(pycall("rotateX", "(Nid)", qh(q), t, a)); }
+void rotateY(Qureg q, int t, qreal a) { drop(pycall("rotateY", "(Nid)", qh(q), t, a)); }
+void rotateZ(Qureg q, int t, qreal a) { drop(pycall("rotateZ", "(Nid)", qh(q), t, a)); }
+
+void rotateAroundAxis(Qureg q, int t, qreal a, Vector axis) {
+    drop(pycall("rotateAroundAxis", "(NidN)", qh(q), t, a, vec_obj(axis)));
+}
+
+void controlledRotateX(Qureg q, int c, int t, qreal a) {
+    drop(pycall("controlledRotateX", "(Niid)", qh(q), c, t, a));
+}
+
+void controlledRotateY(Qureg q, int c, int t, qreal a) {
+    drop(pycall("controlledRotateY", "(Niid)", qh(q), c, t, a));
+}
+
+void controlledRotateZ(Qureg q, int c, int t, qreal a) {
+    drop(pycall("controlledRotateZ", "(Niid)", qh(q), c, t, a));
+}
+
+void controlledRotateAroundAxis(Qureg q, int c, int t, qreal a, Vector axis) {
+    drop(pycall("controlledRotateAroundAxis", "(NiidN)", qh(q), c, t, a,
+                vec_obj(axis)));
+}
+
 void controlledCompactUnitary(Qureg q, int c, int t, Complex alpha, Complex beta) {
-    gate_call("controlledCompactUnitary", q,
-              PyTuple_Pack(4, PyLong_FromLong(c), PyLong_FromLong(t),
-                           complex_obj(alpha), complex_obj(beta)));
+    drop(pycall("controlledCompactUnitary", "(NiiNN)", qh(q), c, t,
+                cobj(alpha), cobj(beta)));
 }
+
 void controlledUnitary(Qureg q, int c, int t, ComplexMatrix2 u) {
-    gate_call("controlledUnitary", q,
-              PyTuple_Pack(3, PyLong_FromLong(c), PyLong_FromLong(t), matrix2_obj(u)));
+    drop(pycall("controlledUnitary", "(NiiN)", qh(q), c, t, m2(u)));
 }
+
 void multiControlledUnitary(Qureg q, int* cs, int n, int t, ComplexMatrix2 u) {
-    gate_call("multiControlledUnitary", q,
-              PyTuple_Pack(4, int_list(cs, n), PyLong_FromLong(n),
-                           PyLong_FromLong(t), matrix2_obj(u)));
+    drop(pycall("multiControlledUnitary", "(NNiiN)", qh(q), int_list(cs, n), n,
+                t, m2(u)));
 }
+
+void multiStateControlledUnitary(Qureg q, int* cs, int* states, int n, int t,
+                                 ComplexMatrix2 u) {
+    drop(pycall("multiStateControlledUnitary", "(NNNiiN)", qh(q),
+                int_list(cs, n), int_list(states, n), n, t, m2(u)));
+}
+
+void pauliX(Qureg q, int t) { drop(pycall("pauliX", "(Ni)", qh(q), t)); }
+void pauliY(Qureg q, int t) { drop(pycall("pauliY", "(Ni)", qh(q), t)); }
+void pauliZ(Qureg q, int t) { drop(pycall("pauliZ", "(Ni)", qh(q), t)); }
+void hadamard(Qureg q, int t) { drop(pycall("hadamard", "(Ni)", qh(q), t)); }
+
+void controlledNot(Qureg q, int c, int t) {
+    drop(pycall("controlledNot", "(Nii)", qh(q), c, t));
+}
+
+void controlledPauliY(Qureg q, int c, int t) {
+    drop(pycall("controlledPauliY", "(Nii)", qh(q), c, t));
+}
+
+void swapGate(Qureg q, int a, int b) {
+    drop(pycall("swapGate", "(Nii)", qh(q), a, b));
+}
+
+void sqrtSwapGate(Qureg q, int a, int b) {
+    drop(pycall("sqrtSwapGate", "(Nii)", qh(q), a, b));
+}
+
+void multiRotateZ(Qureg q, int* qs, int n, qreal angle) {
+    drop(pycall("multiRotateZ", "(NNid)", qh(q), int_list(qs, n), n, angle));
+}
+
+void multiRotatePauli(Qureg q, int* ts, enum pauliOpType* paulis, int n,
+                      qreal angle) {
+    drop(pycall("multiRotatePauli", "(NNNid)", qh(q), int_list(ts, n),
+                pauli_list(paulis, n), n, angle));
+}
+
+void twoQubitUnitary(Qureg q, int t1, int t2, ComplexMatrix4 u) {
+    drop(pycall("twoQubitUnitary", "(NiiN)", qh(q), t1, t2, m4(u)));
+}
+
+void controlledTwoQubitUnitary(Qureg q, int c, int t1, int t2, ComplexMatrix4 u) {
+    drop(pycall("controlledTwoQubitUnitary", "(NiiiN)", qh(q), c, t1, t2, m4(u)));
+}
+
+void multiControlledTwoQubitUnitary(Qureg q, int* cs, int n, int t1, int t2,
+                                    ComplexMatrix4 u) {
+    drop(pycall("multiControlledTwoQubitUnitary", "(NNiiiN)", qh(q),
+                int_list(cs, n), n, t1, t2, m4(u)));
+}
+
 void multiQubitUnitary(Qureg q, int* ts, int n, ComplexMatrixN u) {
-    gate_call("multiQubitUnitary", q,
-              PyTuple_Pack(3, int_list(ts, n), PyLong_FromLong(n), matrixN_obj(u)));
+    drop(pycall("multiQubitUnitary", "(NNiN)", qh(q), int_list(ts, n), n, mN(u)));
 }
 
-/* measurement & calculations */
-static PyObject* q1(Qureg q, long long x) {
-    PyObject* args = PyTuple_New(2);
-    PyTuple_SET_ITEM(args, 0, qureg_handle(q));
-    PyTuple_SET_ITEM(args, 1, PyLong_FromLongLong(x));
-    return args;
+void controlledMultiQubitUnitary(Qureg q, int c, int* ts, int n, ComplexMatrixN u) {
+    drop(pycall("controlledMultiQubitUnitary", "(NiNiN)", qh(q), c,
+                int_list(ts, n), n, mN(u)));
 }
 
-int measure(Qureg q, int t) { return static_cast<int>(as_long(call("measure", q1(q, t)))); }
+void multiControlledMultiQubitUnitary(Qureg q, int* cs, int nc, int* ts, int nt,
+                                      ComplexMatrixN u) {
+    drop(pycall("multiControlledMultiQubitUnitary", "(NNiNiN)", qh(q),
+                int_list(cs, nc), nc, int_list(ts, nt), nt, mN(u)));
+}
+
+/* ---- operators --------------------------------------------------------- */
+
+void applyMatrix2(Qureg q, int t, ComplexMatrix2 u) {
+    drop(pycall("applyMatrix2", "(NiN)", qh(q), t, m2(u)));
+}
+
+void applyMatrix4(Qureg q, int t1, int t2, ComplexMatrix4 u) {
+    drop(pycall("applyMatrix4", "(NiiN)", qh(q), t1, t2, m4(u)));
+}
+
+void applyMatrixN(Qureg q, int* ts, int n, ComplexMatrixN u) {
+    drop(pycall("applyMatrixN", "(NNiN)", qh(q), int_list(ts, n), n, mN(u)));
+}
+
+void applyMultiControlledMatrixN(Qureg q, int* cs, int nc, int* ts, int nt,
+                                 ComplexMatrixN u) {
+    drop(pycall("applyMultiControlledMatrixN", "(NNiNiN)", qh(q),
+                int_list(cs, nc), nc, int_list(ts, nt), nt, mN(u)));
+}
+
+void applyPauliSum(Qureg inQureg, enum pauliOpType* codes, qreal* coeffs,
+                   int numSumTerms, Qureg outQureg) {
+    drop(pycall("applyPauliSum", "(NNNiN)", qh(inQureg),
+                pauli_list(codes,
+                           (long long)numSumTerms * inQureg.numQubitsRepresented),
+                double_list(coeffs, numSumTerms), numSumTerms, qh(outQureg)));
+}
+
+void applyPauliHamil(Qureg inQureg, PauliHamil hamil, Qureg outQureg) {
+    drop(pycall("applyPauliHamil", "(NNN)", qh(inQureg), hamil_obj(hamil),
+                qh(outQureg)));
+}
+
+void applyTrotterCircuit(Qureg q, PauliHamil hamil, qreal time, int order,
+                         int reps) {
+    drop(pycall("applyTrotterCircuit", "(NNdii)", qh(q), hamil_obj(hamil),
+                time, order, reps));
+}
+
+void applyDiagonalOp(Qureg q, DiagonalOp op) {
+    drop(pycall("applyDiagonalOp", "(NN)", qh(q), dh(op)));
+}
+
+/* ---- decoherence ------------------------------------------------------- */
+
+void mixDephasing(Qureg q, int t, qreal p) {
+    drop(pycall("mixDephasing", "(Nid)", qh(q), t, p));
+}
+
+void mixTwoQubitDephasing(Qureg q, int a, int b, qreal p) {
+    drop(pycall("mixTwoQubitDephasing", "(Niid)", qh(q), a, b, p));
+}
+
+void mixDepolarising(Qureg q, int t, qreal p) {
+    drop(pycall("mixDepolarising", "(Nid)", qh(q), t, p));
+}
+
+void mixTwoQubitDepolarising(Qureg q, int a, int b, qreal p) {
+    drop(pycall("mixTwoQubitDepolarising", "(Niid)", qh(q), a, b, p));
+}
+
+void mixDamping(Qureg q, int t, qreal p) {
+    drop(pycall("mixDamping", "(Nid)", qh(q), t, p));
+}
+
+void mixPauli(Qureg q, int t, qreal px, qreal py, qreal pz) {
+    drop(pycall("mixPauli", "(Niddd)", qh(q), t, px, py, pz));
+}
+
+void mixDensityMatrix(Qureg combineQureg, qreal prob, Qureg otherQureg) {
+    drop(pycall("mixDensityMatrix", "(NdN)", qh(combineQureg), prob,
+                qh(otherQureg)));
+}
+
+void mixKrausMap(Qureg q, int t, ComplexMatrix2* ops, int numOps) {
+    drop(pycall("mixKrausMap", "(NiNi)", qh(q), t, m2_list(ops, numOps), numOps));
+}
+
+void mixTwoQubitKrausMap(Qureg q, int t1, int t2, ComplexMatrix4* ops, int numOps) {
+    drop(pycall("mixTwoQubitKrausMap", "(NiiNi)", qh(q), t1, t2,
+                m4_list(ops, numOps), numOps));
+}
+
+void mixMultiQubitKrausMap(Qureg q, int* ts, int numTargets,
+                           ComplexMatrixN* ops, int numOps) {
+    drop(pycall("mixMultiQubitKrausMap", "(NNiNi)", qh(q),
+                int_list(ts, numTargets), numTargets, mN_list(ops, numOps),
+                numOps));
+}
+
+/* ---- measurement & calculations ---------------------------------------- */
+
+int measure(Qureg q, int t) {
+    return static_cast<int>(to_ll(pycall("measure", "(Ni)", qh(q), t)));
+}
 
 int measureWithStats(Qureg q, int t, qreal* outcomeProb) {
-    PyObject* pair = call("measureWithStats", q1(q, t));
-    int outcome = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(pair, 0)));
-    *outcomeProb = PyFloat_AsDouble(PyTuple_GetItem(pair, 1));
-    die_on_python_error();
-    Py_XDECREF(pair);
+    PyObject* pair = pycall("measureWithStats", "(Ni)", qh(q), t);
+    int outcome = 0;
+    *outcomeProb = 0.0;
+    if (pair && PyTuple_Check(pair) && PyTuple_Size(pair) == 2) {
+        outcome = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(pair, 0)));
+        *outcomeProb = PyFloat_AsDouble(PyTuple_GetItem(pair, 1));
+        PyErr_Clear();
+    }
+    drop(pair);
     return outcome;
 }
 
 qreal collapseToOutcome(Qureg q, int t, int outcome) {
-    PyObject* args = PyTuple_New(3);
-    PyTuple_SET_ITEM(args, 0, qureg_handle(q));
-    PyTuple_SET_ITEM(args, 1, PyLong_FromLong(t));
-    PyTuple_SET_ITEM(args, 2, PyLong_FromLong(outcome));
-    return as_double(call("collapseToOutcome", args));
+    return to_double(pycall("collapseToOutcome", "(Nii)", qh(q), t, outcome));
 }
 
 qreal calcProbOfOutcome(Qureg q, int t, int outcome) {
-    PyObject* args = PyTuple_New(3);
-    PyTuple_SET_ITEM(args, 0, qureg_handle(q));
-    PyTuple_SET_ITEM(args, 1, PyLong_FromLong(t));
-    PyTuple_SET_ITEM(args, 2, PyLong_FromLong(outcome));
-    return as_double(call("calcProbOfOutcome", args));
+    return to_double(pycall("calcProbOfOutcome", "(Nii)", qh(q), t, outcome));
 }
 
 qreal calcTotalProb(Qureg q) {
-    PyObject* args = PyTuple_New(1);
-    PyTuple_SET_ITEM(args, 0, qureg_handle(q));
-    return as_double(call("calcTotalProb", args));
+    return to_double(pycall("calcTotalProb", "(N)", qh(q)));
 }
 
-qreal getProbAmp(Qureg q, long long int i) { return as_double(call("getProbAmp", q1(q, i))); }
-qreal getRealAmp(Qureg q, long long int i) { return as_double(call("getRealAmp", q1(q, i))); }
-qreal getImagAmp(Qureg q, long long int i) { return as_double(call("getImagAmp", q1(q, i))); }
+Complex getAmp(Qureg q, long long int i) {
+    return to_cmplx(pycall("getAmp", "(NL)", qh(q), i));
+}
 
-/* decoherence */
-void mixDamping(Qureg q, int t, qreal p) {
-    gate_call("mixDamping", q, PyTuple_Pack(2, PyLong_FromLong(t), PyFloat_FromDouble(p)));
+qreal getRealAmp(Qureg q, long long int i) {
+    return to_double(pycall("getRealAmp", "(NL)", qh(q), i));
 }
-void mixDephasing(Qureg q, int t, qreal p) {
-    gate_call("mixDephasing", q, PyTuple_Pack(2, PyLong_FromLong(t), PyFloat_FromDouble(p)));
+
+qreal getImagAmp(Qureg q, long long int i) {
+    return to_double(pycall("getImagAmp", "(NL)", qh(q), i));
 }
-void mixDepolarising(Qureg q, int t, qreal p) {
-    gate_call("mixDepolarising", q, PyTuple_Pack(2, PyLong_FromLong(t), PyFloat_FromDouble(p)));
+
+qreal getProbAmp(Qureg q, long long int i) {
+    return to_double(pycall("getProbAmp", "(NL)", qh(q), i));
+}
+
+Complex getDensityAmp(Qureg q, long long int row, long long int col) {
+    return to_cmplx(pycall("getDensityAmp", "(NLL)", qh(q), row, col));
+}
+
+Complex calcInnerProduct(Qureg bra, Qureg ket) {
+    return to_cmplx(pycall("calcInnerProduct", "(NN)", qh(bra), qh(ket)));
+}
+
+qreal calcDensityInnerProduct(Qureg rho1, Qureg rho2) {
+    return to_double(pycall("calcDensityInnerProduct", "(NN)", qh(rho1), qh(rho2)));
+}
+
+qreal calcPurity(Qureg q) { return to_double(pycall("calcPurity", "(N)", qh(q))); }
+
+qreal calcFidelity(Qureg q, Qureg pureState) {
+    return to_double(pycall("calcFidelity", "(NN)", qh(q), qh(pureState)));
+}
+
+qreal calcHilbertSchmidtDistance(Qureg a, Qureg b) {
+    return to_double(pycall("calcHilbertSchmidtDistance", "(NN)", qh(a), qh(b)));
+}
+
+qreal calcExpecPauliProd(Qureg q, int* ts, enum pauliOpType* codes,
+                         int numTargets, Qureg workspace) {
+    return to_double(pycall("calcExpecPauliProd", "(NNNiN)", qh(q),
+                            int_list(ts, numTargets),
+                            pauli_list(codes, numTargets), numTargets,
+                            qh(workspace)));
+}
+
+qreal calcExpecPauliSum(Qureg q, enum pauliOpType* codes, qreal* coeffs,
+                        int numSumTerms, Qureg workspace) {
+    return to_double(pycall("calcExpecPauliSum", "(NNNiN)", qh(q),
+                            pauli_list(codes, (long long)numSumTerms *
+                                       q.numQubitsRepresented),
+                            double_list(coeffs, numSumTerms), numSumTerms,
+                            qh(workspace)));
+}
+
+qreal calcExpecPauliHamil(Qureg q, PauliHamil hamil, Qureg workspace) {
+    return to_double(pycall("calcExpecPauliHamil", "(NNN)", qh(q),
+                            hamil_obj(hamil), qh(workspace)));
+}
+
+Complex calcExpecDiagonalOp(Qureg q, DiagonalOp op) {
+    return to_cmplx(pycall("calcExpecDiagonalOp", "(NN)", qh(q), dh(op)));
+}
+
+/* ---- debug API --------------------------------------------------------- */
+
+void initStateDebug(Qureg q) { drop(pycall("initStateDebug", "(N)", qh(q))); }
+
+void initStateOfSingleQubit(Qureg* q, int qubitId, int outcome) {
+    drop(pycall("initStateOfSingleQubit", "(Nii)", qh(*q), qubitId, outcome));
+}
+
+void setDensityAmps(Qureg q, qreal* reals, qreal* imags) {
+    drop(pycall("setDensityAmps", "(NNN)", qh(q),
+                double_list(reals, q.numAmpsTotal),
+                double_list(imags, q.numAmpsTotal)));
+}
+
+int compareStates(Qureg a, Qureg b, qreal precision) {
+    PyObject* r = pycall("compareStates", "(NNd)", qh(a), qh(b), precision);
+    int ok = r ? (PyObject_IsTrue(r) == 1) : 0;
+    drop(r);
+    return ok;
+}
+
+int QuESTPrecision(void) {
+    return static_cast<int>(to_ll(pycall("QuESTPrecision", nullptr)));
 }
 
 }  // extern "C"

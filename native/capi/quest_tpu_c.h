@@ -1,9 +1,11 @@
 /* C front-end for the quest_tpu TPU-native simulation framework.
  *
- * Declares a QuEST-compatible C API (same function names, argument orders
- * and value-struct conventions as QuEST.h v3.2 — independently written) so
- * existing C driver programs compile against this framework unchanged and
- * execute on the JAX/XLA runtime via an embedded Python interpreter.
+ * Declares the full QuEST-compatible C API (same function names, argument
+ * orders and value-struct conventions as QuEST.h v3.2 — independently
+ * written against that interface contract) so existing C driver programs,
+ * including the reference's own examples, compile against this framework
+ * unchanged and execute on the JAX/XLA runtime via an embedded Python
+ * interpreter.
  *
  * Link: -lquest_tpu_c (built by native/capi/build.sh).
  */
@@ -15,12 +17,27 @@
 extern "C" {
 #endif
 
+/* precision: the C boundary is always double (QuEST precision 2); the
+ * runtime may compute in f32 or f64 (QUEST_TPU_PRECISION). */
+#define QuEST_PREC 2
 typedef double qreal;
+#define REAL_EPS 1e-13
+#define REAL_SPECIFIER "%lf"
+#define REAL_STRING_FORMAT "%.14f"
+#define REAL_QASM_FORMAT "%.14g"
+#define MPI_MAX_AMPS_IN_MSG (1LL<<28)
+#define absReal(X) fabs(X)
 
 typedef struct Complex {
     qreal real;
     qreal imag;
 } Complex;
+
+/* struct-of-arrays amplitude mirror (ref layout: QuEST.h:77-81) */
+typedef struct ComplexArray {
+    qreal *real;
+    qreal *imag;
+} ComplexArray;
 
 typedef struct ComplexMatrix2 {
     qreal real[2][2];
@@ -43,71 +60,187 @@ typedef struct Vector {
 } Vector;
 
 enum pauliOpType {PAULI_I = 0, PAULI_X = 1, PAULI_Y = 2, PAULI_Z = 3};
+enum phaseGateType {SIGMA_Z = 0, S_GATE = 1, T_GATE = 2};
+
+typedef struct PauliHamil {
+    enum pauliOpType *pauliCodes; /* numSumTerms * numQubits, term-major */
+    qreal *termCoeffs;
+    int numSumTerms;
+    int numQubits;
+} PauliHamil;
 
 typedef struct QuESTEnv {
     int rank;
     int numRanks;
-    void *handle;
+    void *handle;                 /* Python QuESTEnv */
 } QuESTEnv;
 
 typedef struct Qureg {
     int isDensityMatrix;
     int numQubitsRepresented;
+    int numQubitsInStateVec;
+    long long int numAmpsPerChunk;
     long long int numAmpsTotal;
-    void *handle;
+    int chunkId;
+    int numChunks;
+    ComplexArray stateVec;        /* host mirror, filled by copyStateFromGPU */
+    ComplexArray pairStateVec;    /* unused (no MPI pair buffer on TPU) */
+    void *handle;                 /* Python Qureg */
 } Qureg;
+
+typedef struct DiagonalOp {
+    int numQubits;
+    long long int numElemsPerChunk;
+    int numChunks;
+    int chunkId;
+    qreal *real;                  /* host elements; push with syncDiagonalOp */
+    qreal *imag;
+    void *handle;                 /* Python DiagonalOp */
+} DiagonalOp;
+
+/* error hook: default prints and exits (ref: QuEST_validation.c:167-178);
+ * override (e.g. to throw a C++ exception in tests) by defining a non-weak
+ * symbol of the same name. */
+void invalidQuESTInputError(const char* errMsg, const char* errFunc);
 
 /* environment */
 QuESTEnv createQuESTEnv(void);
 void destroyQuESTEnv(QuESTEnv env);
 void syncQuESTEnv(QuESTEnv env);
+int syncQuESTSuccess(int successCode);
 void reportQuESTEnv(QuESTEnv env);
+void getEnvironmentString(QuESTEnv env, Qureg qureg, char str[200]);
 void seedQuEST(unsigned long int *seedArray, int numSeeds);
+void seedQuESTDefault(void);
 
 /* registers */
 Qureg createQureg(int numQubits, QuESTEnv env);
 Qureg createDensityQureg(int numQubits, QuESTEnv env);
+Qureg createCloneQureg(Qureg qureg, QuESTEnv env);
 void destroyQureg(Qureg qureg, QuESTEnv env);
+void cloneQureg(Qureg targetQureg, Qureg copyQureg);
+int getNumQubits(Qureg qureg);
+long long int getNumAmps(Qureg qureg);
 void reportQuregParams(Qureg qureg);
+void reportState(Qureg qureg);
 void reportStateToScreen(Qureg qureg, QuESTEnv env, int reportRank);
+void copyStateToGPU(Qureg qureg);
+void copyStateFromGPU(Qureg qureg);
 
-/* matrices */
+/* matrices & operator structs */
 ComplexMatrixN createComplexMatrixN(int numQubits);
 void destroyComplexMatrixN(ComplexMatrixN matr);
+PauliHamil createPauliHamil(int numQubits, int numSumTerms);
+void destroyPauliHamil(PauliHamil hamil);
+PauliHamil createPauliHamilFromFile(char* fn);
+void initPauliHamil(PauliHamil hamil, qreal* coeffs, enum pauliOpType* codes);
+void reportPauliHamil(PauliHamil hamil);
+DiagonalOp createDiagonalOp(int numQubits, QuESTEnv env);
+void destroyDiagonalOp(DiagonalOp op, QuESTEnv env);
+void syncDiagonalOp(DiagonalOp op);
+void initDiagonalOp(DiagonalOp op, qreal* real, qreal* imag);
+void setDiagonalOpElems(DiagonalOp op, long long int startInd,
+                        qreal* real, qreal* imag, long long int numElems);
 
 /* state initialisation */
+void initBlankState(Qureg qureg);
 void initZeroState(Qureg qureg);
 void initPlusState(Qureg qureg);
 void initClassicalState(Qureg qureg, long long int stateInd);
-void initBlankState(Qureg qureg);
+void initPureState(Qureg qureg, Qureg pure);
+void initDebugState(Qureg qureg);
+void initStateFromAmps(Qureg qureg, qreal* reals, qreal* imags);
+void setAmps(Qureg qureg, long long int startInd, qreal* reals, qreal* imags,
+             long long int numAmps);
+void setWeightedQureg(Complex fac1, Qureg qureg1, Complex fac2, Qureg qureg2,
+                      Complex facOut, Qureg out);
 
-/* gates */
-void hadamard(Qureg qureg, int targetQubit);
-void pauliX(Qureg qureg, int targetQubit);
-void pauliY(Qureg qureg, int targetQubit);
-void pauliZ(Qureg qureg, int targetQubit);
+/* QASM logging */
+void startRecordingQASM(Qureg qureg);
+void stopRecordingQASM(Qureg qureg);
+void clearRecordedQASM(Qureg qureg);
+void printRecordedQASM(Qureg qureg);
+void writeRecordedQASMToFile(Qureg qureg, char* filename);
+
+/* unitaries */
+void phaseShift(Qureg qureg, int targetQubit, qreal angle);
+void controlledPhaseShift(Qureg qureg, int idQubit1, int idQubit2, qreal angle);
+void multiControlledPhaseShift(Qureg qureg, int *controlQubits,
+                               int numControlQubits, qreal angle);
+void controlledPhaseFlip(Qureg qureg, int idQubit1, int idQubit2);
+void multiControlledPhaseFlip(Qureg qureg, int *controlQubits, int numControlQubits);
 void sGate(Qureg qureg, int targetQubit);
 void tGate(Qureg qureg, int targetQubit);
-void phaseShift(Qureg qureg, int targetQubit, qreal angle);
+void unitary(Qureg qureg, int targetQubit, ComplexMatrix2 u);
+void compactUnitary(Qureg qureg, int targetQubit, Complex alpha, Complex beta);
 void rotateX(Qureg qureg, int rotQubit, qreal angle);
 void rotateY(Qureg qureg, int rotQubit, qreal angle);
 void rotateZ(Qureg qureg, int rotQubit, qreal angle);
 void rotateAroundAxis(Qureg qureg, int rotQubit, qreal angle, Vector axis);
-void controlledNot(Qureg qureg, int controlQubit, int targetQubit);
-void controlledPhaseFlip(Qureg qureg, int idQubit1, int idQubit2);
-void controlledPhaseShift(Qureg qureg, int idQubit1, int idQubit2, qreal angle);
-void multiControlledPhaseFlip(Qureg qureg, int *controlQubits, int numControlQubits);
-void swapGate(Qureg qureg, int qubit1, int qubit2);
-void unitary(Qureg qureg, int targetQubit, ComplexMatrix2 u);
-void compactUnitary(Qureg qureg, int targetQubit, Complex alpha, Complex beta);
+void controlledRotateX(Qureg qureg, int controlQubit, int targetQubit, qreal angle);
+void controlledRotateY(Qureg qureg, int controlQubit, int targetQubit, qreal angle);
+void controlledRotateZ(Qureg qureg, int controlQubit, int targetQubit, qreal angle);
+void controlledRotateAroundAxis(Qureg qureg, int controlQubit, int targetQubit,
+                                qreal angle, Vector axis);
 void controlledCompactUnitary(Qureg qureg, int controlQubit, int targetQubit,
                               Complex alpha, Complex beta);
 void controlledUnitary(Qureg qureg, int controlQubit, int targetQubit,
                        ComplexMatrix2 u);
-void multiControlledUnitary(Qureg qureg, int *controlQubits,
-                            int numControlQubits, int targetQubit,
-                            ComplexMatrix2 u);
-void multiQubitUnitary(Qureg qureg, int *targs, int numTargs, ComplexMatrixN u);
+void multiControlledUnitary(Qureg qureg, int* controlQubits, int numControlQubits,
+                            int targetQubit, ComplexMatrix2 u);
+void multiStateControlledUnitary(Qureg qureg, int* controlQubits,
+                                 int* controlState, int numControlQubits,
+                                 int targetQubit, ComplexMatrix2 u);
+void pauliX(Qureg qureg, int targetQubit);
+void pauliY(Qureg qureg, int targetQubit);
+void pauliZ(Qureg qureg, int targetQubit);
+void hadamard(Qureg qureg, int targetQubit);
+void controlledNot(Qureg qureg, int controlQubit, int targetQubit);
+void controlledPauliY(Qureg qureg, int controlQubit, int targetQubit);
+void swapGate(Qureg qureg, int qubit1, int qubit2);
+void sqrtSwapGate(Qureg qureg, int qb1, int qb2);
+void multiRotateZ(Qureg qureg, int* qubits, int numQubits, qreal angle);
+void multiRotatePauli(Qureg qureg, int* targetQubits,
+                      enum pauliOpType* targetPaulis, int numTargets, qreal angle);
+void twoQubitUnitary(Qureg qureg, int targetQubit1, int targetQubit2,
+                     ComplexMatrix4 u);
+void controlledTwoQubitUnitary(Qureg qureg, int controlQubit, int targetQubit1,
+                               int targetQubit2, ComplexMatrix4 u);
+void multiControlledTwoQubitUnitary(Qureg qureg, int* controlQubits,
+                                    int numControlQubits, int targetQubit1,
+                                    int targetQubit2, ComplexMatrix4 u);
+void multiQubitUnitary(Qureg qureg, int* targs, int numTargs, ComplexMatrixN u);
+void controlledMultiQubitUnitary(Qureg qureg, int ctrl, int* targs, int numTargs,
+                                 ComplexMatrixN u);
+void multiControlledMultiQubitUnitary(Qureg qureg, int* ctrls, int numCtrls,
+                                      int* targs, int numTargs, ComplexMatrixN u);
+
+/* operators (non-unitary application) */
+void applyMatrix2(Qureg qureg, int targetQubit, ComplexMatrix2 u);
+void applyMatrix4(Qureg qureg, int targetQubit1, int targetQubit2, ComplexMatrix4 u);
+void applyMatrixN(Qureg qureg, int* targs, int numTargs, ComplexMatrixN u);
+void applyMultiControlledMatrixN(Qureg qureg, int* ctrls, int numCtrls,
+                                 int* targs, int numTargs, ComplexMatrixN u);
+void applyPauliSum(Qureg inQureg, enum pauliOpType* allPauliCodes,
+                   qreal* termCoeffs, int numSumTerms, Qureg outQureg);
+void applyPauliHamil(Qureg inQureg, PauliHamil hamil, Qureg outQureg);
+void applyTrotterCircuit(Qureg qureg, PauliHamil hamil, qreal time, int order,
+                         int reps);
+void applyDiagonalOp(Qureg qureg, DiagonalOp op);
+
+/* decoherence */
+void mixDephasing(Qureg qureg, int targetQubit, qreal prob);
+void mixTwoQubitDephasing(Qureg qureg, int qubit1, int qubit2, qreal prob);
+void mixDepolarising(Qureg qureg, int targetQubit, qreal prob);
+void mixTwoQubitDepolarising(Qureg qureg, int qubit1, int qubit2, qreal prob);
+void mixDamping(Qureg qureg, int targetQubit, qreal prob);
+void mixPauli(Qureg qureg, int targetQubit, qreal probX, qreal probY, qreal probZ);
+void mixDensityMatrix(Qureg combineQureg, qreal prob, Qureg otherQureg);
+void mixKrausMap(Qureg qureg, int target, ComplexMatrix2 *ops, int numOps);
+void mixTwoQubitKrausMap(Qureg qureg, int target1, int target2,
+                         ComplexMatrix4 *ops, int numOps);
+void mixMultiQubitKrausMap(Qureg qureg, int* targets, int numTargets,
+                           ComplexMatrixN* ops, int numOps);
 
 /* measurement & calculations */
 int measure(Qureg qureg, int measureQubit);
@@ -115,14 +248,48 @@ int measureWithStats(Qureg qureg, int measureQubit, qreal *outcomeProb);
 qreal collapseToOutcome(Qureg qureg, int measureQubit, int outcome);
 qreal calcProbOfOutcome(Qureg qureg, int measureQubit, int outcome);
 qreal calcTotalProb(Qureg qureg);
-qreal getProbAmp(Qureg qureg, long long int index);
+Complex getAmp(Qureg qureg, long long int index);
 qreal getRealAmp(Qureg qureg, long long int index);
 qreal getImagAmp(Qureg qureg, long long int index);
+qreal getProbAmp(Qureg qureg, long long int index);
+Complex getDensityAmp(Qureg qureg, long long int row, long long int col);
+Complex calcInnerProduct(Qureg bra, Qureg ket);
+qreal calcDensityInnerProduct(Qureg rho1, Qureg rho2);
+qreal calcPurity(Qureg qureg);
+qreal calcFidelity(Qureg qureg, Qureg pureState);
+qreal calcHilbertSchmidtDistance(Qureg a, Qureg b);
+qreal calcExpecPauliProd(Qureg qureg, int* targetQubits,
+                         enum pauliOpType* pauliCodes, int numTargets,
+                         Qureg workspace);
+qreal calcExpecPauliSum(Qureg qureg, enum pauliOpType* allPauliCodes,
+                        qreal* termCoeffs, int numSumTerms, Qureg workspace);
+qreal calcExpecPauliHamil(Qureg qureg, PauliHamil hamil, Qureg workspace);
+Complex calcExpecDiagonalOp(Qureg qureg, DiagonalOp op);
 
-/* decoherence */
-void mixDamping(Qureg qureg, int targetQubit, qreal prob);
-void mixDephasing(Qureg qureg, int targetQubit, qreal prob);
-void mixDepolarising(Qureg qureg, int targetQubit, qreal prob);
+/* debug API (ref: QuEST_debug.h) */
+void initStateDebug(Qureg qureg);
+void initStateOfSingleQubit(Qureg *qureg, int qubitId, int outcome);
+void setDensityAmps(Qureg qureg, qreal* reals, qreal* imags);
+int compareStates(Qureg mq1, Qureg mq2, qreal precision);
+int QuESTPrecision(void);
+
+/* C-only VLA helpers, mirroring the reference's guards (ref: QuEST.h:340,
+ * :3859-3916): succinct ComplexMatrixN population from stack 2D arrays. */
+#ifndef __cplusplus
+void initComplexMatrixN(ComplexMatrixN m, qreal real[][1<<m.numQubits],
+                        qreal imag[][1<<m.numQubits]);
+ComplexMatrixN bindArraysToStackComplexMatrixN(
+    int numQubits, qreal re[][1<<numQubits], qreal im[][1<<numQubits],
+    qreal** reStorage, qreal** imStorage);
+#define UNPACK_ARR(...) __VA_ARGS__
+#define getStaticComplexMatrixN(numQubits, re, im) \
+    bindArraysToStackComplexMatrixN( \
+        numQubits, \
+        (qreal[1<<numQubits][1<<numQubits]) UNPACK_ARR re, \
+        (qreal[1<<numQubits][1<<numQubits]) UNPACK_ARR im, \
+        (double*[1<<numQubits]) {NULL}, (double*[1<<numQubits]) {NULL} \
+    )
+#endif
 
 #ifdef __cplusplus
 }

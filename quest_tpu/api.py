@@ -95,6 +95,7 @@ __all__ = [
     # reporting / debug
     "reportState", "reportStateToScreen", "copyStateToGPU", "copyStateFromGPU",
     "initStateDebug", "compareStates", "initStateOfSingleQubit",
+    "QuESTPrecision",
     # types
     "Qureg", "QuESTEnv", "Complex", "ComplexMatrix2", "ComplexMatrix4",
     "Vector", "PauliHamil", "DiagonalOp", "PauliOpType", "QuESTError",
@@ -239,6 +240,8 @@ def reportQuregParams(qureg: Qureg) -> None:
     print("QUBITS:")
     print(f"Number of qubits is {qureg.num_qubits_represented}.")
     print(f"Number of amps is {qureg.num_amps_total}.")
+    num_chunks = getattr(qureg.env, "num_ranks", 1) or 1
+    print(f"Number of amps per rank is {qureg.num_amps_total // num_chunks}.")
 
 
 # ---------------------------------------------------------------------------
@@ -1351,11 +1354,42 @@ def reportState(qureg: Qureg) -> None:
 
 
 def reportStateToScreen(qureg: Qureg, env: QuESTEnv = None, report_rank: int = 0) -> None:
-    V.validate_report_size(qureg, "reportStateToScreen")
-    arr = np.asarray(qureg.amps)
-    print("Reporting state from rank 0:")
+    """Stdout format matches the reference exactly (ref: QuEST_cpu.c:1366-1388,
+    REAL_STRING_FORMAT = %.14f) so reference-program output diffs clean."""
+    if qureg.num_qubits_in_state_vec > 5:
+        print("Error: reportStateToScreen will not print output for systems of "
+              "more than 5 qubits.")
+        return
+    arr = np.asarray(qureg.amps, dtype=np.float64)
+    if report_rank:
+        print("Reporting state from rank 0 [")
+    else:
+        print("Reporting state [")
+    print("real, imag")
     for re, im in zip(arr[0], arr[1]):
-        print(f"{re:.12f}, {im:.12f}")
+        print(f"{re:.14f}, {im:.14f}")
+    print("]")
+
+
+def QuESTPrecision() -> int:
+    """Runtime precision, 1 (f32) or 2 (f64) (ref: QuEST_debug.h:55 — there a
+    compile-time constant)."""
+    from .precision import get_precision
+    return get_precision()
+
+
+def _amps_buffer(qureg: Qureg) -> np.ndarray:
+    """C-shim helper: the amplitudes as a C-contiguous (2, numAmps) float64
+    array (the shim memcpys this into the C Qureg's host stateVec mirror for
+    copyStateFromGPU, ref: QuEST_gpu.cu:451-473)."""
+    return np.ascontiguousarray(np.asarray(qureg.amps, dtype=np.float64))
+
+
+def _hamil_buffers(hamil: PauliHamil):
+    """C-shim helper: (flat int32 codes, float64 coeffs) contiguous arrays."""
+    codes = np.ascontiguousarray(np.asarray(hamil.pauli_codes, dtype=np.int32).ravel())
+    coeffs = np.ascontiguousarray(np.asarray(hamil.term_coeffs, dtype=np.float64))
+    return codes, coeffs
 
 
 def copyStateToGPU(qureg: Qureg) -> None:

@@ -101,6 +101,10 @@ def get_environment_string(env: QuESTEnv, qureg) -> str:
 
 
 def report_quest_env(env: QuESTEnv) -> None:
+    """Structure mirrors the reference's report (QuEST_cpu_local.c:194-205),
+    describing the actual TPU/XLA execution environment."""
+    from .precision import get_precision
     print("EXECUTION ENVIRONMENT:")
     print(f"Running distributed (SPMD) version on {env.num_ranks} device(s)")
     print(f"Backend platform: {jax.devices()[0].platform}")
+    print(f"Precision: size of qreal is {4 * get_precision()} bytes")

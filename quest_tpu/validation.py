@@ -22,6 +22,7 @@ class QuESTError(ValueError):
     def __init__(self, code: str, message: str, func: str | None = None):
         self.code = code
         self.func = func
+        self.message = message  # un-prefixed text (the C shim's errMsg)
         prefix = f"{func}: " if func else ""
         super().__init__(prefix + message)
 
