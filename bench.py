@@ -1,22 +1,26 @@
-"""Benchmark harness: single-qubit-gate amplitude-update throughput per chip.
+"""Benchmark harness: the full BASELINE.md config matrix on real hardware.
 
-Workload: a random-circuit layer (Haar 1-qubit gate per qubit + a CZ ladder),
-pre-fused by the native scheduler (native/fusion.cpp) into ~n/7 kron-packed
-MXU matmuls, then iterated ``depth`` times INSIDE one jitted
-``lax.fori_loop`` — a single device-resident program, so remote-dispatch
-latency cannot pollute the measurement.  Timing boundaries read back a scalar
-norm, forcing real completion even through async device tunnels.
+Prints exactly ONE JSON line (driver contract).  The headline metric is the
+24q random-circuit f32 fused throughput; the ``matrix`` field carries every
+BASELINE.md config measured in the same run:
 
-Metric (the reference's headline unit, BASELINE.md north star
->=1e8 single-qubit-gate amplitude updates / sec / chip):
+  - random 24q: f32/f64 x fused/unfused  (single-chip hot path)
+  - 20q Clifford+T statevector           (BASELINE config 2)
+  - 14q density matrix, mixDamping + mixDepolarising per layer (config 4)
+  - 28q QFT                              (config 5's diagonal/swap path)
+  - 22q QFT on an 8-virtual-device CPU mesh (cross-shard diagonal + swap
+    routing end-to-end — communication-pattern validation, config 5's
+    distributed regime without multi-chip hardware)
 
-    value = 2^n * n * depth / wall_seconds / n_chips
+Workloads run INSIDE one jitted program (lax.fori_loop over layers where
+applicable) so remote-dispatch latency cannot pollute the measurement; a
+scalar norm readback bounds each timing.
 
-Prints exactly one JSON line:
-  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+Metric: single-qubit-gate amplitude updates / sec / chip — value =
+state_size * gates / wall_seconds (BASELINE.md north star >= 1e8).
 
-Env overrides: QUEST_BENCH_QUBITS (default 24), QUEST_BENCH_DEPTH (default
-50), QUEST_BENCH_PRECISION (1|2, default 1), QUEST_BENCH_FUSE (default 1).
+Env overrides: QUEST_BENCH_QUBITS / DEPTH / PRECISION / FUSE configure the
+headline; QUEST_BENCH_MATRIX=0 skips the extra configs.
 """
 
 from __future__ import annotations
@@ -26,62 +30,268 @@ import os
 import sys
 import time
 
+# must precede any jax import: the sharded-QFT config builds an 8-device CPU
+# mesh alongside the TPU backend
+_N_VIRT = 8
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + f" --xla_force_host_platform_device_count={_N_VIRT}").strip()
+
 BASELINE_AMPS_PER_SEC = 1e8  # driver target (BASELINE.md north star)
+
+
+def _timed(run, *args):
+    """(seconds, result) with dispatch overhead subtracted via a 0-iter call."""
+    float(run(*args, 1))  # warmup/compile
+    t0 = time.perf_counter()
+    base = float(run(*args, 0))
+    overhead = time.perf_counter() - t0
+    return base, overhead
+
+
+def _run_layered(ops_apply, state, depth):
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=())
+    def run(s, iters):
+        def body(_, st):
+            return ops_apply(st)
+        s = jax.lax.fori_loop(0, iters, body, s)
+        return jnp.sum(s[0] * s[0] + s[1] * s[1])
+
+    float(run(state, 1))  # compile + warm
+    t0 = time.perf_counter()
+    base = float(run(state, 0))
+    overhead = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    total = float(run(state, depth))
+    dt = time.perf_counter() - t0
+    return max(dt - overhead, 1e-9), total, dt, overhead
+
+
+def bench_random(n, depth, precision, fuse, seed=11):
+    """Haar 1q layer + CZ ladder, fused by the native scheduler."""
+    import jax.numpy as jnp
+    from quest_tpu.circuit import _apply_one, random_circuit
+
+    dtype = jnp.float32 if precision == 1 else jnp.float64
+    circuit = random_circuit(n, depth=1, seed=seed)
+    if fuse:
+        circuit.optimize()
+    ops = circuit.key()
+
+    def layer(s):
+        for op in ops:
+            s = _apply_one(s, op)
+        return s
+
+    state = jnp.zeros((2, 1 << n), dtype=dtype).at[0, 0].set(1.0)
+    compute, total, dt, overhead = _run_layered(layer, state, depth)
+    assert abs(total - 1.0) < 1e-2, f"state not normalised: {total}"
+    value = (1 << n) * n * depth / compute
+    return value, {"qubits": n, "depth": depth, "precision": precision,
+                   "fused": fuse, "ops_per_layer": len(ops),
+                   "seconds": dt, "overhead_seconds": overhead}
+
+
+def bench_clifford_t(n=20, depth=50, precision=2, seed=5):
+    """Clifford+T layer: H/S/T per qubit + a CNOT ladder (BASELINE config 2)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from quest_tpu.circuit import Circuit, _apply_one
+
+    rng = np.random.default_rng(seed)
+    c = Circuit(n)
+    for q in range(n):
+        gate = rng.integers(0, 3)
+        (c.h if gate == 0 else c.s if gate == 1 else c.t)(q)
+    for q in range(0, n - 1, 2):
+        c.cnot(q, q + 1)
+    gates = len(c)
+    c.optimize()
+    ops = c.key()
+
+    def layer(s):
+        for op in ops:
+            s = _apply_one(s, op)
+        return s
+
+    dtype = jnp.float32 if precision == 1 else jnp.float64
+    state = jnp.zeros((2, 1 << n), dtype=dtype).at[0, 0].set(1.0)
+    compute, total, dt, overhead = _run_layered(layer, state, depth)
+    assert abs(total - 1.0) < 1e-2
+    value = (1 << n) * gates * depth / compute
+    return value, {"qubits": n, "depth": depth, "precision": precision,
+                   "gates_per_layer": gates, "fused_ops": len(ops),
+                   "seconds": dt}
+
+
+def bench_density(n=14, depth=5, precision=2, seed=7):
+    """Density-matrix layer on the Choi-flattened 2n-qubit vector: Haar 1q
+    gate + shadow, then mixDamping and mixDepolarising per qubit pair
+    (BASELINE config 4)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from quest_tpu.ops import apply as _ap
+    from quest_tpu.ops import decoherence as _deco
+
+    rng = np.random.default_rng(seed)
+    dtype = jnp.float32 if precision == 1 else jnp.float64
+
+    gates = []
+    for q in range(n):
+        g = rng.normal(size=(2, 2)) + 1j * rng.normal(size=(2, 2))
+        u, r = np.linalg.qr(g)
+        u = u * (np.diag(r) / np.abs(np.diag(r)))
+        gates.append((q, _ap.mat_pair(u), _ap.mat_pair(u.conj())))
+
+    import jax
+
+    def layer(s):
+        for q, up, upc in gates:
+            s = _ap.apply_matrix(s, jnp.asarray(up, dtype=s.dtype), (q,))
+            s = _ap.apply_matrix(s, jnp.asarray(upc, dtype=s.dtype), (q + n,))
+        for q in range(0, n, 2):
+            s = _deco.mix_damping(s, jnp.asarray(0.02, dtype=jnp.float64), q, n)
+        for q in range(1, n, 2):
+            s = _deco.mix_depolarising(s, jnp.asarray(0.02, dtype=jnp.float64), q, n)
+        return s
+
+    # rho = |0><0| flattened
+    state = jnp.zeros((2, 1 << (2 * n)), dtype=dtype).at[0, 0].set(1.0)
+
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=())
+    def run(s, iters):
+        def body(_, st):
+            return layer(st)
+        s = jax.lax.fori_loop(0, iters, body, s)
+        # trace of rho = sum of real diagonal
+        dim = 1 << n
+        diag = s[0].reshape(dim, dim).diagonal()
+        return jnp.sum(diag.astype(jnp.float64))
+
+    float(run(state, 1))
+    t0 = time.perf_counter()
+    base = float(run(state, 0))
+    overhead = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    trace = float(run(state, depth))
+    dt = time.perf_counter() - t0
+    assert abs(trace - 1.0) < 1e-2, f"trace not preserved: {trace}"
+    compute = max(dt - overhead, 1e-9)
+    num_ops = 2 * n + n  # gate+shadow per qubit, channel per qubit
+    value = (1 << (2 * n)) * num_ops * depth / compute
+    return value, {"qubits": n, "depth": depth, "precision": precision,
+                   "ops_per_layer": num_ops, "seconds": dt}
+
+
+def bench_qft(n, precision=1, devices=None):
+    """Full QFT pass: H + controlled-phase ladder + reversal swaps — the
+    diagonal-gate + swap routing path (BASELINE config 5).  With ``devices``
+    the state is sharded over a mesh and the same program exercises
+    cross-shard diagonals and all-to-all swap rerouting via GSPMD."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+    from quest_tpu.circuit import _apply_one, qft_circuit
+
+    dtype = jnp.float32 if precision == 1 else jnp.float64
+    c = qft_circuit(n)
+    gates = len(c)
+    c.optimize()
+    ops = c.key()
+
+    sharding = None
+    if devices is not None:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        mesh = Mesh(np.asarray(devices), ("amps",))
+        sharding = NamedSharding(mesh, P(None, "amps"))
+
+    @partial(jax.jit, static_argnames=("reps",))
+    def run(s, reps):
+        for _ in range(reps):
+            for op in ops:
+                s = _apply_one(s, op)
+        out = jnp.sum(s[0].astype(jnp.float64) ** 2
+                      + s[1].astype(jnp.float64) ** 2)
+        return out
+
+    state = jnp.zeros((2, 1 << n), dtype=dtype).at[0, 0].set(1.0)
+    if sharding is not None:
+        state = jax.device_put(state, sharding)
+
+    float(run(state, 1))  # compile + warm
+    float(run(state, 0))  # compile the overhead-probe variant too
+    t0 = time.perf_counter()
+    base = float(run(state, 0))
+    overhead = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    total = float(run(state, 1))
+    dt = time.perf_counter() - t0
+    assert abs(total - 1.0) < 1e-2, f"norm lost: {total}"
+    compute = max(dt - overhead, 1e-9)
+    value = (1 << n) * gates / compute
+    cfg = {"qubits": n, "precision": precision, "gates": gates,
+           "fused_ops": len(ops), "seconds": dt}
+    if devices is not None:
+        cfg["devices"] = len(devices)
+        cfg["platform"] = devices[0].platform
+    return value, cfg
 
 
 def main() -> None:
     import jax
-    import jax.numpy as jnp
-    from functools import partial
 
     platform = jax.devices()[0].platform
     n = int(os.environ.get("QUEST_BENCH_QUBITS", "24"))
     depth = int(os.environ.get("QUEST_BENCH_DEPTH", "50"))
     precision = int(os.environ.get("QUEST_BENCH_PRECISION", "1"))
     fuse = os.environ.get("QUEST_BENCH_FUSE", "1") == "1"
-    dtype = jnp.float32 if precision == 1 else jnp.float64
+    with_matrix = os.environ.get("QUEST_BENCH_MATRIX", "1") == "1"
 
-    from quest_tpu.circuit import _apply_one, random_circuit
+    headline, head_cfg = bench_random(n, depth, precision, fuse)
+    head_cfg["platform"] = platform
 
-    circuit = random_circuit(n, depth=1, seed=11)
-    if fuse:
-        circuit.optimize()  # native kron-packing: ~n/7 MXU matmuls per layer
-    ops = circuit.key()
+    matrix = []
 
-    @partial(jax.jit, static_argnames=())
-    def run(state, iters):
-        def body(_, s):
-            for op in ops:
-                s = _apply_one(s, op)
-            return s
-        s = jax.lax.fori_loop(0, iters, body, state)
-        return jnp.sum(s[0] * s[0] + s[1] * s[1])
+    def add(name, fn, *args, **kw):
+        try:
+            value, cfg = fn(*args, **kw)
+            matrix.append({"name": name, "value": value, "unit": "amps/s",
+                           "vs_baseline": value / BASELINE_AMPS_PER_SEC,
+                           "config": cfg})
+        except Exception as e:  # a failing config must not kill the headline
+            matrix.append({"name": name, "error": f"{type(e).__name__}: {e}"})
 
-    state = jnp.zeros((2, 1 << n), dtype=dtype).at[0, 0].set(1.0)
+    if with_matrix:
+        add("random24_f32_unfused", bench_random, n, 10, 1, False)
+        add("random24_f64_fused", bench_random, n, depth, 2, True)
+        add("random24_f64_unfused", bench_random, n, 10, 2, False)
+        add("clifford_t_20q_f64", bench_clifford_t)
+        # f64 density at 14q exceeds HBM under f64 emulation (measured:
+        # 18.05G needed of 15.75G) — the density config runs at f32
+        add("densmatr_14q_damping_depol_f32", bench_density, 14, 5, 1)
+        add("qft_28q_f32", bench_qft, 28, 1)
+        try:
+            cpu = jax.devices("cpu")[:_N_VIRT]
+        except RuntimeError:
+            cpu = []
+        if len(cpu) == _N_VIRT:
+            add("qft_20q_f32_cpu8shard", bench_qft, 20, 1, cpu)
 
-    # warmup: compiles the program; scalar read forces real completion
-    float(run(state, 1))
-
-    t0 = time.perf_counter()
-    base = float(run(state, 0))  # dispatch + readback overhead
-    t_overhead = time.perf_counter() - t0
-
-    t0 = time.perf_counter()
-    total = float(run(state, depth))
-    dt = time.perf_counter() - t0
-    assert abs(total - 1.0) < 1e-2, f"state not normalised: {total}"
-    assert abs(base - 1.0) < 1e-2
-
-    compute = max(dt - t_overhead, 1e-9)
-    amps_per_sec = (1 << n) * n * depth / compute
     result = {
         "metric": "statevec_1q_gate_amp_updates_per_sec_per_chip",
-        "value": amps_per_sec,
+        "value": headline,
         "unit": "amps/s",
-        "vs_baseline": amps_per_sec / BASELINE_AMPS_PER_SEC,
-        "config": {"qubits": n, "depth": depth, "precision": precision,
-                   "fused_ops_per_layer": len(ops), "platform": platform,
-                   "seconds": dt, "overhead_seconds": t_overhead},
+        "vs_baseline": headline / BASELINE_AMPS_PER_SEC,
+        "config": head_cfg,
+        "matrix": matrix,
     }
     print(json.dumps(result))
 

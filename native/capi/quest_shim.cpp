@@ -164,6 +164,7 @@ PyObject* dh(DiagonalOp op) {
 }
 
 PyObject* int_list(const int* xs, long long n) {
+    if (n < 0 || !xs) n = 0;  // invalid counts/arrays: runtime validation rejects
     PyObject* list = PyList_New(n);
     for (long long i = 0; i < n; i++)
         PyList_SET_ITEM(list, i, PyLong_FromLong(xs[i]));
@@ -171,6 +172,7 @@ PyObject* int_list(const int* xs, long long n) {
 }
 
 PyObject* pauli_list(const enum pauliOpType* xs, long long n) {
+    if (n < 0 || !xs) n = 0;
     PyObject* list = PyList_New(n);
     for (long long i = 0; i < n; i++)
         PyList_SET_ITEM(list, i, PyLong_FromLong(static_cast<long>(xs[i])));
@@ -178,6 +180,7 @@ PyObject* pauli_list(const enum pauliOpType* xs, long long n) {
 }
 
 PyObject* double_list(const qreal* xs, long long n) {
+    if (n < 0 || !xs) n = 0;
     PyObject* list = PyList_New(n);
     for (long long i = 0; i < n; i++)
         PyList_SET_ITEM(list, i, PyFloat_FromDouble(xs[i]));
@@ -461,6 +464,9 @@ ComplexMatrixN bindArraysToStackComplexMatrixN(
 }
 
 PauliHamil createPauliHamil(int numQubits, int numSumTerms) {
+    // route through the runtime purely for validation (throws via the hook
+    // on non-positive dims, ref: validateHamilParams)
+    drop(pycall("createPauliHamil", "(ii)", numQubits, numSumTerms));
     PauliHamil h;
     h.numQubits = numQubits;
     h.numSumTerms = numSumTerms;
@@ -513,6 +519,14 @@ PauliHamil createPauliHamilFromFile(char* fn) {
 }
 
 void initPauliHamil(PauliHamil h, qreal* coeffs, enum pauliOpType* codes) {
+    // runtime-side validation first (throws via the hook on invalid codes)
+    PyObject* ph = pycall("createPauliHamil", "(ii)", h.numQubits, h.numSumTerms);
+    if (ph) {
+        drop(pycall("initPauliHamil", "(ONN)", ph,
+                    double_list(coeffs, h.numSumTerms),
+                    pauli_list(codes, (long long)h.numSumTerms * h.numQubits)));
+        drop(ph);
+    }
     std::memcpy(h.termCoeffs, coeffs, sizeof(qreal) * h.numSumTerms);
     std::memcpy(h.pauliCodes, codes,
                 sizeof(enum pauliOpType) * (size_t)h.numSumTerms * h.numQubits);

@@ -1017,6 +1017,9 @@ def calcExpecPauliProd(qureg: Qureg, targets, codes, num_targets=None,
     targets = _ts(targets)
     V.validate_multi_targets(qureg, targets, "calcExpecPauliProd")
     V.validate_pauli_codes(codes, len(targets), "calcExpecPauliProd")
+    if workspace is not None:
+        V.validate_matching_qureg_types(qureg, workspace, "calcExpecPauliProd")
+        V.validate_matching_qureg_dims(qureg, workspace, "calcExpecPauliProd")
     prod_amps = _apply_pauli_prod(qureg.amps, targets, codes)
     if workspace is not None:
         workspace.amps = prod_amps
@@ -1050,10 +1053,16 @@ def calcExpecPauliSum(qureg: Qureg, all_codes, term_coeffs, num_sum_terms=None,
     codes = np.asarray(all_codes, dtype=np.int64).reshape(-1, n)
     coeffs = np.asarray(term_coeffs, dtype=np.float64).ravel()
     if num_sum_terms is not None:
+        V.validate_num_pauli_sum_terms(int(num_sum_terms), "calcExpecPauliSum")
         codes = codes[:int(num_sum_terms)]
         coeffs = coeffs[:int(num_sum_terms)]
     V.validate_num_pauli_sum_terms(len(codes), "calcExpecPauliSum")
     V.validate_pauli_codes(codes.ravel(), codes.size, "calcExpecPauliSum")
+    if workspace is not None:
+        # the fused kernel needs no workspace, but the reference's contract
+        # still validates it (ref: validateMatchingQuregTypes/Dims)
+        V.validate_matching_qureg_types(qureg, workspace, "calcExpecPauliSum")
+        V.validate_matching_qureg_dims(qureg, workspace, "calcExpecPauliSum")
     if workspace is not None:
         # parity with the reference: the workspace ends up holding the last
         # term's Pauli product (QuEST_common.c:488 leaves it so)
@@ -1208,6 +1217,7 @@ def applyPauliSum(in_qureg: Qureg, all_codes, term_coeffs, num_sum_terms,
     V.validate_matching_qureg_types(in_qureg, out_qureg, "applyPauliSum")
     V.validate_matching_qureg_dims(in_qureg, out_qureg, "applyPauliSum")
     n = in_qureg.num_qubits_represented
+    V.validate_num_pauli_sum_terms(int(num_sum_terms), "applyPauliSum")
     codes = np.asarray(all_codes, dtype=np.int64).reshape(-1, n)[:int(num_sum_terms)]
     coeffs = np.asarray(term_coeffs, dtype=np.float64).ravel()[:int(num_sum_terms)]
     V.validate_num_pauli_sum_terms(len(codes), "applyPauliSum")

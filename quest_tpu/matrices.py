@@ -125,10 +125,13 @@ def create_pauli_hamil(num_qubits: int, num_sum_terms: int) -> PauliHamil:
 def init_pauli_hamil(hamil: PauliHamil, coeffs, codes) -> None:
     """Ref analogue: initPauliHamil — codes is the flat
     [term0 qubit0..qubitN-1, term1 ...] layout of the reference."""
-    codes = np.asarray(codes, dtype=np.int32).reshape(hamil.num_sum_terms, hamil.num_qubits)
+    # validate BEFORE narrowing: invalid codes may be far outside int32
+    # (e.g. (enum)-1 arrives as 2^32-1 through the C shim's unsigned enum)
+    codes = np.asarray(codes, dtype=np.int64).reshape(hamil.num_sum_terms, hamil.num_qubits)
     for c in codes.ravel():
         if c not in (0, 1, 2, 3):
             _throw(ErrorCode.INVALID_PAULI_CODE, "initPauliHamil")
+    codes = codes.astype(np.int32)
     hamil.term_coeffs = np.asarray(coeffs, dtype=np.float64).reshape(hamil.num_sum_terms)
     hamil.pauli_codes = codes
 
@@ -163,7 +166,8 @@ def create_pauli_hamil_from_file(fn: str) -> PauliHamil:
             except ValueError:
                 _throw(ErrorCode.CANNOT_PARSE_PAULI_HAMIL_FILE_PAULI, "createPauliHamilFromFile", fn)
             if code not in (0, 1, 2, 3):
-                _throw(ErrorCode.INVALID_PAULI_HAMIL_FILE_PAULI_CODE, "createPauliHamilFromFile", fn)
+                _throw(ErrorCode.INVALID_PAULI_HAMIL_FILE_PAULI_CODE,
+                       "createPauliHamilFromFile", fn, code)
             codes[t, q] = code
     hamil = PauliHamil(num_qubits, num_terms)
     init_pauli_hamil(hamil, coeffs, codes)

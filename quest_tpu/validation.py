@@ -99,7 +99,7 @@ class ErrorCode:
 # Human-readable messages; tests substring-match these, mirroring the
 # reference test suite's REQUIRE_THROWS_WITH pattern.
 MESSAGES = {
-    ErrorCode.INVALID_NUM_RANKS: "Invalid number of devices. Distributed simulation requires a power-of-2 device count.",
+    ErrorCode.INVALID_NUM_RANKS: "Invalid number of nodes. Distributed simulation can only make use of a power-of-2 number of node.",
     ErrorCode.INVALID_NUM_CREATE_QUBITS: "Invalid number of qubits. Must create >0.",
     ErrorCode.INVALID_QUBIT_INDEX: "Invalid qubit index. Must be >=0 and <numQubits.",
     ErrorCode.INVALID_TARGET_QUBIT: "Invalid target qubit. Must be >=0 and <numQubits.",
@@ -141,29 +141,29 @@ MESSAGES = {
     ErrorCode.INVALID_ONE_QUBIT_PAULI_PROBS: "The probability of any X, Y or Z error cannot exceed the probability of no error.",
     ErrorCode.INVALID_CONTROLS_BIT_STATE: "The state of the control qubits must be a bit sequence (0s and 1s).",
     ErrorCode.MISMATCHING_NUM_CONTROL_STATES: "The number of control states must match the number of control qubits.",
-    ErrorCode.INVALID_PAULI_CODE: "Invalid Pauli code. Codes must be 0 (or PAULI_I), 1 (PAULI_X), 2 (PAULI_Y) or 3 (PAULI_Z).",
+    ErrorCode.INVALID_PAULI_CODE: "Invalid Pauli code. Codes must be 0 (or PAULI_I), 1 (PAULI_X), 2 (PAULI_Y) or 3 (PAULI_Z) to indicate the identity, X, Y and Z operators respectively.",
     ErrorCode.MISMATCHING_NUM_PAULI_CODES: "The number of Pauli codes must match the number of target qubits.",
     ErrorCode.INVALID_NUM_SUM_TERMS: "Invalid number of terms in the Pauli sum. The number of terms must be >0.",
-    ErrorCode.CANNOT_FIT_MULTI_QUBIT_MATRIX: "The specified matrix targets too many qubits; the amplitude batches cannot fit in a single device's shard.",
+    ErrorCode.CANNOT_FIT_MULTI_QUBIT_MATRIX: "The specified matrix targets too many qubits; the batches of amplitudes to modify cannot all fit in a single distributed node's memory.",
     ErrorCode.INVALID_UNITARY_SIZE: "The matrix size does not match the number of target qubits.",
-    ErrorCode.COMPLEX_MATRIX_NOT_INIT: "The ComplexMatrixN was not successfully created.",
+    ErrorCode.COMPLEX_MATRIX_NOT_INIT: "The ComplexMatrixN was not successfully created (possibly insufficient memory available).",
     ErrorCode.INVALID_NUM_ONE_QUBIT_KRAUS_OPS: "At least 1 and at most 4 single qubit Kraus operators may be specified.",
     ErrorCode.INVALID_NUM_TWO_QUBIT_KRAUS_OPS: "At least 1 and at most 16 two-qubit Kraus operators may be specified.",
     ErrorCode.INVALID_NUM_N_QUBIT_KRAUS_OPS: "At least 1 and at most 4*N^2 of N-qubit Kraus operators may be specified.",
     ErrorCode.INVALID_KRAUS_OPS: "The specified Kraus map is not a completely positive, trace preserving map.",
     ErrorCode.MISMATCHING_NUM_TARGS_KRAUS_SIZE: "Every Kraus operator must be of the same number of qubits as the number of targets.",
-    ErrorCode.DISTRIB_QUREG_TOO_SMALL: "Too few qubits. The created qureg must have at least one amplitude per device used in distributed simulation.",
-    ErrorCode.DISTRIB_DIAG_OP_TOO_SMALL: "Too few qubits. The created DiagonalOp must contain at least one element per device used in distributed simulation.",
+    ErrorCode.DISTRIB_QUREG_TOO_SMALL: "Too few qubits. The created qureg must have at least one amplitude per node used in distributed simulation.",
+    ErrorCode.DISTRIB_DIAG_OP_TOO_SMALL: "Too few qubits. The created DiagonalOp must contain at least one element per node used in distributed simulation.",
     ErrorCode.INVALID_PAULI_HAMIL_PARAMS: "The number of qubits and terms in the PauliHamil must be strictly positive.",
     ErrorCode.INVALID_PAULI_HAMIL_FILE_PARAMS: "The number of qubits and terms in the PauliHamil file ({}) must be strictly positive.",
     ErrorCode.CANNOT_PARSE_PAULI_HAMIL_FILE_COEFF: "Failed to parse the next expected term coefficient in PauliHamil file ({}).",
     ErrorCode.CANNOT_PARSE_PAULI_HAMIL_FILE_PAULI: "Failed to parse the next expected Pauli code in PauliHamil file ({}).",
-    ErrorCode.INVALID_PAULI_HAMIL_FILE_PAULI_CODE: "The PauliHamil file ({}) contained an invalid pauli code.",
+    ErrorCode.INVALID_PAULI_HAMIL_FILE_PAULI_CODE: "The PauliHamil file ({}) contained an invalid pauli code ({}). Codes must be 0 (or PAULI_I), 1 (PAULI_X), 2 (PAULI_Y) or 3 (PAULI_Z) to indicate the identity, X, Y and Z operators respectively.",
     ErrorCode.MISMATCHING_PAULI_HAMIL_QUREG_NUM_QUBITS: "The PauliHamil must act on the same number of qubits as exist in the Qureg.",
-    ErrorCode.INVALID_TROTTER_ORDER: "The Trotterisation order must be 1, or an even number.",
+    ErrorCode.INVALID_TROTTER_ORDER: "The Trotterisation order must be 1, or an even number (for higher-order Suzuki symmetrized expansions).",
     ErrorCode.INVALID_TROTTER_REPS: "The number of Trotter repetitions must be >=1.",
     ErrorCode.MISMATCHING_QUREG_DIAGONAL_OP_SIZE: "The qureg must represent an equal number of qubits as that in the applied diagonal operator.",
-    ErrorCode.DIAGONAL_OP_NOT_INITIALISED: "The diagonal operator has not been initialised.",
+    ErrorCode.DIAGONAL_OP_NOT_INITIALISED: "The diagonal operator has not been initialised through createDiagonalOperator().",
 }
 
 
