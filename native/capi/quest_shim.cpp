@@ -355,11 +355,15 @@ static Qureg make_qureg(PyObject* q, int numQubits, int isDensity) {
 }
 
 Qureg createQureg(int numQubits, QuESTEnv env) {
+    // validate against the C struct's rank count first: user programs (and
+    // the reference tests) may have modified env.numRanks directly
+    drop(pycall("_validate_create_qureg", "(iii)", numQubits, env.numRanks, 0));
     PyObject* q = pycall("createQureg", "(iN)", numQubits, eh(env));
     return make_qureg(q, numQubits, 0);
 }
 
 Qureg createDensityQureg(int numQubits, QuESTEnv env) {
+    drop(pycall("_validate_create_qureg", "(iii)", numQubits, env.numRanks, 1));
     PyObject* q = pycall("createDensityQureg", "(iN)", numQubits, eh(env));
     return make_qureg(q, numQubits, 1);
 }
@@ -416,7 +420,9 @@ void copyStateFromGPU(Qureg q) {
 /* ---- matrices & operator structs --------------------------------------- */
 
 ComplexMatrixN createComplexMatrixN(int numQubits) {
-    int dim = 1 << numQubits;
+    // runtime-side validation (throws via the hook on numQubits < 1)
+    drop(pycall("createComplexMatrixN", "(i)", numQubits));
+    int dim = numQubits >= 1 ? 1 << numQubits : 1;
     ComplexMatrixN m;
     m.numQubits = numQubits;
     m.real = static_cast<qreal**>(std::calloc(dim, sizeof(qreal*)));
@@ -537,6 +543,7 @@ void reportPauliHamil(PauliHamil h) {
 }
 
 DiagonalOp createDiagonalOp(int numQubits, QuESTEnv env) {
+    drop(pycall("_validate_create_diag", "(ii)", numQubits, env.numRanks));
     DiagonalOp op;
     op.numQubits = numQubits;
     op.numElemsPerChunk = 1LL << numQubits;

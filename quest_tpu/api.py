@@ -1395,6 +1395,32 @@ def _amps_buffer(qureg: Qureg) -> np.ndarray:
     return np.ascontiguousarray(np.asarray(qureg.amps, dtype=np.float64))
 
 
+def _validate_create_qureg(num_qubits: int, num_ranks: int, is_density: int) -> None:
+    """C-shim helper: validate createQureg params against the C-side env
+    struct's rank count (C programs may modify env.numRanks directly — the
+    reference's own tests do exactly that)."""
+    # mirror the reference's unsigned comparison: a negative C int rank
+    # count (e.g. an overflowed (int)pow(2, 2n) in user code) converts to a
+    # huge unsigned value and must fail the amps-per-rank check
+    env = QuESTEnv(mesh=None, num_ranks=int(num_ranks) % (1 << 64))
+    V.validate_create_num_qubits(
+        int(num_qubits), env,
+        "createDensityQureg" if is_density else "createQureg",
+        factor=2 if is_density else 1)
+
+
+def _validate_create_diag(num_qubits: int, num_ranks: int) -> None:
+    """C-shim helper: createDiagonalOp validation against the C env struct's
+    rank count (see _validate_create_qureg)."""
+    env = QuESTEnv(mesh=None, num_ranks=int(num_ranks) % (1 << 64))
+    if num_qubits < 1:
+        V._throw(V.ErrorCode.INVALID_NUM_CREATE_QUBITS, "createDiagonalOp")
+    if num_qubits > 63:
+        V._throw(V.ErrorCode.NUM_AMPS_EXCEED_TYPE, "createDiagonalOp")
+    if 2 ** num_qubits < env.num_ranks:
+        V._throw(V.ErrorCode.DISTRIB_DIAG_OP_TOO_SMALL, "createDiagonalOp")
+
+
 def _hamil_buffers(hamil: PauliHamil):
     """C-shim helper: (flat int32 codes, float64 coeffs) contiguous arrays."""
     codes = np.ascontiguousarray(np.asarray(hamil.pauli_codes, dtype=np.int32).ravel())

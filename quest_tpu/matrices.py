@@ -203,6 +203,8 @@ class DiagonalOp:
 def create_diagonal_op(num_qubits: int, env) -> DiagonalOp:
     if num_qubits < 1:
         _throw(ErrorCode.INVALID_NUM_CREATE_QUBITS, "createDiagonalOp")
+    if num_qubits > 63:  # calcLog2(SIZE_MAX): elements must index in size_t
+        _throw(ErrorCode.NUM_AMPS_EXCEED_TYPE, "createDiagonalOp")
     if 2 ** num_qubits < env.num_ranks:
         _throw(ErrorCode.DISTRIB_DIAG_OP_TOO_SMALL, "createDiagonalOp")
     from .precision import CONFIG

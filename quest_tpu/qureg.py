@@ -82,7 +82,7 @@ def create_qureg(num_qubits: int, env: QuESTEnv, dtype=None) -> Qureg:
 
 def create_density_qureg(num_qubits: int, env: QuESTEnv, dtype=None) -> Qureg:
     """Ref analogue: createDensityQureg (QuEST.c:50-62) — ρ = |0..0><0..0|."""
-    validate_create_num_qubits(num_qubits, env, "createDensityQureg")
+    validate_create_num_qubits(num_qubits, env, "createDensityQureg", factor=2)
     from .ops import init as init_ops
     q = Qureg(num_qubits, env, is_density_matrix=True, dtype=dtype)
     q.set_amps_array(init_ops.zero_state(q.num_amps_total, q.dtype))

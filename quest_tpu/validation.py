@@ -84,6 +84,7 @@ class ErrorCode:
     MISMATCHING_NUM_TARGS_KRAUS_SIZE = "E_MISMATCHING_NUM_TARGS_KRAUS_SIZE"
     DISTRIB_QUREG_TOO_SMALL = "E_DISTRIB_QUREG_TOO_SMALL"
     DISTRIB_DIAG_OP_TOO_SMALL = "E_DISTRIB_DIAG_OP_TOO_SMALL"
+    NUM_AMPS_EXCEED_TYPE = "E_NUM_AMPS_EXCEED_TYPE"
     INVALID_PAULI_HAMIL_PARAMS = "E_INVALID_PAULI_HAMIL_PARAMS"
     INVALID_PAULI_HAMIL_FILE_PARAMS = "E_INVALID_PAULI_HAMIL_FILE_PARAMS"
     CANNOT_PARSE_PAULI_HAMIL_FILE_COEFF = "E_CANNOT_PARSE_PAULI_HAMIL_FILE_COEFF"
@@ -154,6 +155,7 @@ MESSAGES = {
     ErrorCode.MISMATCHING_NUM_TARGS_KRAUS_SIZE: "Every Kraus operator must be of the same number of qubits as the number of targets.",
     ErrorCode.DISTRIB_QUREG_TOO_SMALL: "Too few qubits. The created qureg must have at least one amplitude per node used in distributed simulation.",
     ErrorCode.DISTRIB_DIAG_OP_TOO_SMALL: "Too few qubits. The created DiagonalOp must contain at least one element per node used in distributed simulation.",
+    ErrorCode.NUM_AMPS_EXCEED_TYPE: "Too many qubits (max of log2(SIZE_MAX)). Cannot store the number of amplitudes per-node in the size_t type.",
     ErrorCode.INVALID_PAULI_HAMIL_PARAMS: "The number of qubits and terms in the PauliHamil must be strictly positive.",
     ErrorCode.INVALID_PAULI_HAMIL_FILE_PARAMS: "The number of qubits and terms in the PauliHamil file ({}) must be strictly positive.",
     ErrorCode.CANNOT_PARSE_PAULI_HAMIL_FILE_COEFF: "Failed to parse the next expected term coefficient in PauliHamil file ({}).",
@@ -183,10 +185,15 @@ def validate_num_ranks(num_ranks: int, func=None):
         _throw(ErrorCode.INVALID_NUM_RANKS, func)
 
 
-def validate_create_num_qubits(num_qubits: int, env, func=None):
+def validate_create_num_qubits(num_qubits: int, env, func=None, factor: int = 1):
+    """``factor=2`` for density quregs: the flattened state has 2n qubits
+    (ref: validateNumQubitsInQureg, QuEST_validation.c — called with the
+    state-vector qubit count)."""
     if num_qubits < 1:
         _throw(ErrorCode.INVALID_NUM_CREATE_QUBITS, func)
-    if 2 ** num_qubits < env.num_ranks:
+    if factor * num_qubits > 63:  # calcLog2(SIZE_MAX) on 64-bit (2^64-1 -> 63)
+        _throw(ErrorCode.NUM_AMPS_EXCEED_TYPE, func)
+    if 2 ** (factor * num_qubits) < env.num_ranks:
         _throw(ErrorCode.DISTRIB_QUREG_TOO_SMALL, func)
 
 

@@ -90,7 +90,7 @@ def test_createQureg(env):
     with pytest.raises(qt.QuESTError, match="Invalid number of qubits"):
         qt.createQureg(0, env)
     if env.num_ranks > 1:
-        with pytest.raises(qt.QuESTError, match="one amplitude per device"):
+        with pytest.raises(qt.QuESTError, match="one amplitude per node"):
             qt.createQureg(1, env)
 
 

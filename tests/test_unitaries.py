@@ -362,7 +362,7 @@ def test_multiQubitUnitary(env):
     with pytest.raises(qt.QuESTError, match="unique"):
         qt.multiQubitUnitary(psi, [0, 0], 2, random_unitary(2))
     if kmax < N:
-        with pytest.raises(qt.QuESTError, match="cannot fit"):
+        with pytest.raises(qt.QuESTError, match="cannot all fit"):
             qt.multiQubitUnitary(psi, list(range(kmax + 1)), kmax + 1,
                                  random_unitary(kmax + 1))
 
@@ -407,5 +407,5 @@ def test_wide_minor_gate_refuses_oversized_expansion(env_local):
     k = 11  # slots = 7 lane + 3 sublane + 1 prefix = 11 > _EXPAND_CAP
     state = jnp.zeros((2, 1 << n), dtype=jnp.float32).at[0, 0].set(1.0)
     mat = jnp.zeros((2, 1 << k, 1 << k), dtype=jnp.float32)
-    with pytest.raises(qt.QuESTError, match="cannot fit"):
+    with pytest.raises(qt.QuESTError, match="cannot all fit"):
         apply_matrix(state, mat, tuple(range(k)))
