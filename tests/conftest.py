@@ -1,4 +1,5 @@
-"""Test configuration: force a deterministic CPU backend with 8 virtual devices.
+"""Test configuration: deterministic CPU backend with 8 virtual devices, or
+the real TPU chip when QUEST_TEST_PLATFORM=tpu.
 
 The reference runs ONE test suite against whichever backend was compiled in
 (serial / OpenMP / MPI / GPU — ref: tests/CMakeLists.txt:6-17).  Here the same
@@ -7,16 +8,26 @@ twice, once on a single (unsharded) device and once sharded over an 8-device
 mesh, exercising the GSPMD collective paths the reference exercised with real
 MPI under SLURM (ref: examples/submissionScripts/mpi_SLURM_unit_tests.sh).
 
+Platforms:
+- default: CPU with 8 virtual devices at float64 (reference PRECISION=2) —
+  deterministic, runs anywhere.
+- QUEST_TEST_PLATFORM=tpu: the real chip at float32 (TPU-native precision 1,
+  reference PRECISION=1 tolerances) — the accelerator numerics validation.
+  The dist8 parametrisation skips (one physical chip); precision-2 anchors
+  still run (f64 is emulated on TPU).
+
 The container may boot JAX with a TPU platform plugin pre-registered from
-sitecustomize; tests must nevertheless run on CPU with 8 virtual devices, so
-before any backend is initialised we inject the XLA host-device-count flag and
-switch the platform config to cpu (this works even after plugin registration,
-as long as no backend has been *used* yet).
+sitecustomize; unless the TPU run is requested, tests must run on CPU with 8
+virtual devices, so before any backend is initialised we inject the XLA
+host-device-count flag and switch the platform config to cpu (this works even
+after plugin registration, as long as no backend has been *used* yet).
 """
 
 from __future__ import annotations
 
 import os
+
+TEST_PLATFORM = os.environ.get("QUEST_TEST_PLATFORM", "cpu").lower()
 
 # Must happen before the first jax backend initialisation.
 _FLAGS = os.environ.get("XLA_FLAGS", "")
@@ -25,17 +36,24 @@ if "xla_force_host_platform_device_count" not in _FLAGS:
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if TEST_PLATFORM == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+# else: leave whatever accelerator platform the container provides (axon/tpu)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 import quest_tpu as qt  # noqa: E402
 
+ON_ACCELERATOR = TEST_PLATFORM != "cpu"
+
 
 @pytest.fixture(scope="session", autouse=True)
 def _precision():
-    qt.set_precision(2)  # float64: matches the reference's default PRECISION=2
+    # CPU: float64, matching the reference's default PRECISION=2.
+    # TPU: float32 (precision 1) — the chip's native width; f64 is emulated
+    # and reserved for the precision-2 anchor tests that ask for it.
+    qt.set_precision(1 if ON_ACCELERATOR else 2)
 
 
 @pytest.fixture(scope="session")
@@ -45,6 +63,8 @@ def env_local():
 
 @pytest.fixture(scope="session")
 def env_dist():
+    if ON_ACCELERATOR:
+        pytest.skip("single physical chip: dist8 runs on the CPU platform")
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 virtual devices")
     return qt.createQuESTEnv(8)

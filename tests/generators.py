@@ -1,0 +1,55 @@
+"""Exhaustive test-case generators, mirroring the reference suite's Catch2
+generators (ref: tests/utilities.hpp:864-1016 — sublists / bitsets /
+sequences / pauliseqs, implemented in utilities.cpp via combination masks +
+std::next_permutation).
+
+The reference GENERATEs every target/control arrangement for every gate at
+NUM_QUBITS=5; these helpers give the pytest suite the same coverage.  Order
+matters for targets (a k-qubit matrix is not symmetric in its targets), so
+``sublists`` yields all *ordered* arrangements; control sets are
+order-insensitive so ``subsets`` yields combinations.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+
+def sublists(pool, length, exclude=()):
+    """All ordered length-``length`` arrangements of distinct elements of
+    ``pool``, minus any in ``exclude`` (ref: SubListGenerator — every
+    combination in every permutation)."""
+    items = [x for x in pool if x not in exclude]
+    return list(itertools.permutations(items, length))
+
+
+def subsets(pool, length, exclude=()):
+    """All unordered length-``length`` subsets (for control groups)."""
+    items = [x for x in pool if x not in exclude]
+    return list(itertools.combinations(items, length))
+
+
+def bitsets(num_bits):
+    """All bit sequences of the given length (ref: bitsets), LSB-first."""
+    return [tuple(reversed(bits))
+            for bits in itertools.product((0, 1), repeat=num_bits)]
+
+
+def pauliseqs(num_paulis):
+    """All Pauli-code sequences (ref: pauliseqs): codes 0..3 per slot."""
+    return list(itertools.product((0, 1, 2, 3), repeat=num_paulis))
+
+
+def target_control_cases(n, num_targs, max_ctrls=2):
+    """Every ordered target arrangement of size ``num_targs``, each paired
+    (cyclically) with a varying control subset of the remaining qubits of
+    size 0..``max_ctrls`` — covers every target ordering AND every control
+    subset without the full cross-product."""
+    cases = []
+    for i, targs in enumerate(sublists(range(n), num_targs)):
+        ctrl_pool = [q for q in range(n) if q not in targs]
+        ctrl_sets = [()]
+        for k in range(1, min(max_ctrls, len(ctrl_pool)) + 1):
+            ctrl_sets.extend(subsets(ctrl_pool, k))
+        cases.append((targs, ctrl_sets[i % len(ctrl_sets)]))
+    return cases

@@ -19,9 +19,18 @@ import numpy as np
 
 NUM_QUBITS = 5  # matches the reference suite (tests/utilities.hpp:36)
 
-# tolerance: tests accept <=10x REAL_EPS like the reference (test_unitaries.cpp:70)
-SV_TOL = 1e-12
-DM_TOL = 1e-11
+# tolerance: tests accept <=10x REAL_EPS like the reference
+# (test_unitaries.cpp:70); REAL_EPS is 1e-13 at precision 2 and 1e-5 at
+# precision 1 (ref: QuEST_precision.h:35,49), so the f32 TPU run
+# (QUEST_TEST_PLATFORM=tpu) uses the looser pair.
+import os as _os
+
+if _os.environ.get("QUEST_TEST_PLATFORM", "cpu").lower() == "cpu":
+    SV_TOL = 1e-12
+    DM_TOL = 1e-11
+else:
+    SV_TOL = 1e-4
+    DM_TOL = 1e-3
 
 
 # ---------------------------------------------------------------------------

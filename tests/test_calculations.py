@@ -7,7 +7,7 @@ import numpy as np
 import pytest
 
 import quest_tpu as qt
-from oracle import (NUM_QUBITS, pauli_string_matrix, pauli_sum_matrix,
+from oracle import (NUM_QUBITS, pauli_string_matrix, pauli_sum_matrix, SV_TOL,
                     random_density_matrix, random_statevector, set_dm, set_sv)
 
 N = NUM_QUBITS
@@ -27,10 +27,10 @@ def loaded(env):
 
 def test_calcTotalProb(env, loaded):
     psi, dq, vec, rho = loaded
-    assert qt.calcTotalProb(psi) == pytest.approx(1.0, abs=1e-12)
-    assert qt.calcTotalProb(dq) == pytest.approx(1.0, abs=1e-12)
+    assert qt.calcTotalProb(psi) == pytest.approx(1.0, abs=SV_TOL)
+    assert qt.calcTotalProb(dq) == pytest.approx(1.0, abs=SV_TOL)
     qt.initBlankState(psi)
-    assert qt.calcTotalProb(psi) == pytest.approx(0.0, abs=1e-12)
+    assert qt.calcTotalProb(psi) == pytest.approx(0.0, abs=SV_TOL)
 
 
 def test_calcProbOfOutcome(env, loaded):
@@ -38,11 +38,11 @@ def test_calcProbOfOutcome(env, loaded):
     for t in range(N):
         mask = np.array([((i >> t) & 1) for i in range(DIM)])
         p1 = float(np.sum(np.abs(vec) ** 2 * mask))
-        assert qt.calcProbOfOutcome(psi, t, 1) == pytest.approx(p1, abs=1e-12)
-        assert qt.calcProbOfOutcome(psi, t, 0) == pytest.approx(1 - p1, abs=1e-12)
+        assert qt.calcProbOfOutcome(psi, t, 1) == pytest.approx(p1, abs=SV_TOL)
+        assert qt.calcProbOfOutcome(psi, t, 0) == pytest.approx(1 - p1, abs=SV_TOL)
         p1d = float(np.real(np.sum(np.diag(rho) * mask)))
-        assert qt.calcProbOfOutcome(dq, t, 1) == pytest.approx(p1d, abs=1e-12)
-        assert qt.calcProbOfOutcome(dq, t, 0) == pytest.approx(1 - p1d, abs=1e-12)
+        assert qt.calcProbOfOutcome(dq, t, 1) == pytest.approx(p1d, abs=SV_TOL)
+        assert qt.calcProbOfOutcome(dq, t, 0) == pytest.approx(1 - p1d, abs=SV_TOL)
     with pytest.raises(qt.QuESTError, match="Invalid measurement outcome"):
         qt.calcProbOfOutcome(psi, 0, 3)
 
@@ -54,7 +54,7 @@ def test_calcInnerProduct(env):
     set_sv(q2, v2)
     expected = np.vdot(v1, v2)  # <q1|q2>
     got = qt.calcInnerProduct(q1, q2)
-    assert got == pytest.approx(expected, abs=1e-12)
+    assert got == pytest.approx(expected, abs=SV_TOL)
     rho = qt.createDensityQureg(N, env)
     with pytest.raises(qt.QuESTError, match="state-vector"):
         qt.calcInnerProduct(q1, rho)
@@ -66,13 +66,13 @@ def test_calcDensityInnerProduct(env):
     set_dm(d1, r1)
     set_dm(d2, r2)
     expected = float(np.real(np.trace(r1.conj().T @ r2)))
-    assert qt.calcDensityInnerProduct(d1, d2) == pytest.approx(expected, abs=1e-12)
+    assert qt.calcDensityInnerProduct(d1, d2) == pytest.approx(expected, abs=SV_TOL)
 
 
 def test_calcPurity(env, loaded):
     psi, dq, vec, rho = loaded
     expected = float(np.real(np.trace(rho @ rho)))
-    assert qt.calcPurity(dq) == pytest.approx(expected, abs=1e-12)
+    assert qt.calcPurity(dq) == pytest.approx(expected, abs=SV_TOL)
     with pytest.raises(qt.QuESTError, match="density matrices"):
         qt.calcPurity(psi)
 
@@ -84,10 +84,10 @@ def test_calcFidelity(env, loaded):
     set_sv(pure, pure_vec)
     # statevector fidelity |<pure|psi>|^2
     expected_sv = float(np.abs(np.vdot(pure_vec, vec)) ** 2)
-    assert qt.calcFidelity(psi, pure) == pytest.approx(expected_sv, abs=1e-12)
+    assert qt.calcFidelity(psi, pure) == pytest.approx(expected_sv, abs=SV_TOL)
     # density fidelity <pure|rho|pure>
     expected_dm = float(np.real(np.vdot(pure_vec, rho @ pure_vec)))
-    assert qt.calcFidelity(dq, pure) == pytest.approx(expected_dm, abs=1e-12)
+    assert qt.calcFidelity(dq, pure) == pytest.approx(expected_dm, abs=SV_TOL)
     with pytest.raises(qt.QuESTError, match="state-vector"):
         qt.calcFidelity(psi, dq)
 
@@ -98,7 +98,7 @@ def test_calcHilbertSchmidtDistance(env):
     set_dm(d1, r1)
     set_dm(d2, r2)
     expected = float(np.sqrt(np.sum(np.abs(r1 - r2) ** 2)))
-    assert qt.calcHilbertSchmidtDistance(d1, d2) == pytest.approx(expected, abs=1e-10)
+    assert qt.calcHilbertSchmidtDistance(d1, d2) == pytest.approx(expected, abs=SV_TOL)
 
 
 def test_calcExpecPauliProd(env, loaded):
@@ -109,10 +109,10 @@ def test_calcExpecPauliProd(env, loaded):
         op = pauli_string_matrix(N, targets, codes)
         expected = float(np.real(np.vdot(vec, op @ vec)))
         got = qt.calcExpecPauliProd(psi, list(targets), list(codes), len(targets), work)
-        assert got == pytest.approx(expected, abs=1e-10)
+        assert got == pytest.approx(expected, abs=SV_TOL)
         expected_d = float(np.real(np.trace(op @ rho)))
         got_d = qt.calcExpecPauliProd(dq, list(targets), list(codes), len(targets), workd)
-        assert got_d == pytest.approx(expected_d, abs=1e-10)
+        assert got_d == pytest.approx(expected_d, abs=SV_TOL)
     with pytest.raises(qt.QuESTError, match="Invalid Pauli code"):
         qt.calcExpecPauliProd(psi, [0], [4], 1, work)
 
@@ -127,11 +127,11 @@ def test_calcExpecPauliSum(env, loaded):
     op = pauli_sum_matrix(N, codes, coeffs)
     expected = float(np.real(np.vdot(vec, op @ vec)))
     got = qt.calcExpecPauliSum(psi, codes.ravel(), coeffs, num_terms, work)
-    assert got == pytest.approx(expected, abs=1e-10)
+    assert got == pytest.approx(expected, abs=SV_TOL)
     workd = qt.createDensityQureg(N, env)
     expected_d = float(np.real(np.trace(op @ rho)))
     got_d = qt.calcExpecPauliSum(dq, codes.ravel(), coeffs, num_terms, workd)
-    assert got_d == pytest.approx(expected_d, abs=1e-10)
+    assert got_d == pytest.approx(expected_d, abs=SV_TOL)
 
 
 def test_calcExpecPauliHamil(env, loaded):
@@ -145,7 +145,7 @@ def test_calcExpecPauliHamil(env, loaded):
     op = pauli_sum_matrix(N, codes, coeffs)
     work = qt.createQureg(N, env)
     expected = float(np.real(np.vdot(vec, op @ vec)))
-    assert qt.calcExpecPauliHamil(psi, hamil, work) == pytest.approx(expected, abs=1e-10)
+    assert qt.calcExpecPauliHamil(psi, hamil, work) == pytest.approx(expected, abs=SV_TOL)
 
 
 def test_calcExpecDiagonalOp(env, loaded):
@@ -155,10 +155,10 @@ def test_calcExpecDiagonalOp(env, loaded):
     qt.initDiagonalOp(op, np.real(elems).copy(), np.imag(elems).copy())
     expected = complex(np.sum(np.abs(vec) ** 2 * elems))
     got = qt.calcExpecDiagonalOp(psi, op)
-    assert got == pytest.approx(expected, abs=1e-10)
+    assert got == pytest.approx(expected, abs=SV_TOL)
     expected_d = complex(np.sum(np.diag(rho) * elems))
     got_d = qt.calcExpecDiagonalOp(dq, op)
-    assert got_d == pytest.approx(expected_d, abs=1e-10)
+    assert got_d == pytest.approx(expected_d, abs=SV_TOL)
 
 
 def test_getNumQubits(env):
@@ -174,7 +174,7 @@ def test_getNumAmps(env):
 def test_getAmp(env, loaded):
     psi, dq, vec, rho = loaded
     for i in (0, 1, DIM - 1):
-        assert qt.getAmp(psi, i) == pytest.approx(vec[i], abs=1e-13)
+        assert qt.getAmp(psi, i) == pytest.approx(vec[i], abs=SV_TOL)
     with pytest.raises(qt.QuESTError, match="Invalid amplitude index"):
         qt.getAmp(psi, DIM)
     with pytest.raises(qt.QuESTError, match="state-vector"):
@@ -184,25 +184,25 @@ def test_getAmp(env, loaded):
 def test_getRealAmp(env, loaded):
     psi, _, vec, _ = loaded
     for i in (0, 7):
-        assert qt.getRealAmp(psi, i) == pytest.approx(np.real(vec[i]), abs=1e-13)
+        assert qt.getRealAmp(psi, i) == pytest.approx(np.real(vec[i]), abs=SV_TOL)
 
 
 def test_getImagAmp(env, loaded):
     psi, _, vec, _ = loaded
     for i in (0, 7):
-        assert qt.getImagAmp(psi, i) == pytest.approx(np.imag(vec[i]), abs=1e-13)
+        assert qt.getImagAmp(psi, i) == pytest.approx(np.imag(vec[i]), abs=SV_TOL)
 
 
 def test_getProbAmp(env, loaded):
     psi, _, vec, _ = loaded
     for i in (0, 7):
-        assert qt.getProbAmp(psi, i) == pytest.approx(abs(vec[i]) ** 2, abs=1e-13)
+        assert qt.getProbAmp(psi, i) == pytest.approx(abs(vec[i]) ** 2, abs=SV_TOL)
 
 
 def test_getDensityAmp(env, loaded):
     _, dq, _, rho = loaded
     for r, c in [(0, 0), (1, 3), (DIM - 1, DIM - 1), (4, 0)]:
-        assert qt.getDensityAmp(dq, r, c) == pytest.approx(rho[r, c], abs=1e-13)
+        assert qt.getDensityAmp(dq, r, c) == pytest.approx(rho[r, c], abs=SV_TOL)
     psi = qt.createQureg(N, env)
     with pytest.raises(qt.QuESTError, match="density matrices"):
         qt.getDensityAmp(psi, 0, 0)

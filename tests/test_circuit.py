@@ -8,7 +8,7 @@ import numpy as np
 import pytest
 
 import quest_tpu as qt
-from oracle import NUM_QUBITS, assert_dm, assert_sv, dm, random_statevector, set_sv, sv
+from oracle import NUM_QUBITS, assert_dm, assert_sv, dm, random_statevector, set_sv, sv, SV_TOL
 
 N = NUM_QUBITS
 
@@ -42,7 +42,7 @@ def test_compiled_random_circuit_matches_eager(env):
                 qt.pauliX(ref, op.targets[0])
         elif op.kind == "swap":
             qt.swapGate(ref, op.targets[0], op.targets[1])
-    np.testing.assert_allclose(sv(psi), sv(ref), atol=1e-12)
+    np.testing.assert_allclose(sv(psi), sv(ref), atol=SV_TOL)
 
 
 def test_compiled_circuit_on_density_matrix(env):
@@ -56,8 +56,8 @@ def test_compiled_circuit_on_density_matrix(env):
     qt.rotateY(ref, 2, -0.7)
     qt.pauliY(ref, 3)
     qt.controlledNot(ref, 3, 4)
-    np.testing.assert_allclose(sv(rho), sv(ref), atol=1e-12)
-    assert qt.calcTotalProb(rho) == pytest.approx(1.0, abs=1e-12)
+    np.testing.assert_allclose(sv(rho), sv(ref), atol=SV_TOL)
+    assert qt.calcTotalProb(rho) == pytest.approx(1.0, abs=SV_TOL)
 
 
 def test_qft_matches_dft_matrix(env):
@@ -70,7 +70,7 @@ def test_qft_matches_dft_matrix(env):
     # DFT with positive phase convention: F[y, x] = w^(xy)/sqrt(dim)
     w = np.exp(2j * np.pi / dim)
     f = np.array([[w ** (x * y) for x in range(dim)] for y in range(dim)]) / np.sqrt(dim)
-    np.testing.assert_allclose(sv(psi), f @ vec, atol=1e-12)
+    np.testing.assert_allclose(sv(psi), f @ vec, atol=SV_TOL)
 
 
 def test_compile_circuit_pure_function(env_local):
@@ -81,7 +81,7 @@ def test_compile_circuit_pure_function(env_local):
     out = run(psi.amps)
     assert out.shape == (2, 16)
     norm = float(np.sum(np.asarray(out) ** 2))
-    assert norm == pytest.approx(1.0, abs=1e-12)
+    assert norm == pytest.approx(1.0, abs=SV_TOL)
 
 
 def test_density_shadow_cache_invalidated_on_append(env):
@@ -90,7 +90,7 @@ def test_density_shadow_cache_invalidated_on_append(env):
     c = qt.Circuit(3).h(0)
     rho = qt.createDensityQureg(3, env)
     qt.apply_circuit(rho, c)          # primes the shadow cache
-    np.testing.assert_allclose(np.diag(dm(rho))[:2], [0.5, 0.5], atol=1e-12)
+    np.testing.assert_allclose(np.diag(dm(rho))[:2], [0.5, 0.5], atol=SV_TOL)
 
     c.x(0)                            # append AFTER the cache was built
     qt.initZeroState(rho)
@@ -98,9 +98,9 @@ def test_density_shadow_cache_invalidated_on_append(env):
     ref = qt.createDensityQureg(3, env)
     qt.hadamard(ref, 0)
     qt.pauliX(ref, 0)
-    np.testing.assert_allclose(dm(rho), dm(ref), atol=1e-12)
+    np.testing.assert_allclose(dm(rho), dm(ref), atol=SV_TOL)
 
     # same circuit object re-applied unchanged: cache hit must still be right
     qt.initZeroState(rho)
     qt.apply_circuit(rho, c)
-    np.testing.assert_allclose(dm(rho), dm(ref), atol=1e-12)
+    np.testing.assert_allclose(dm(rho), dm(ref), atol=SV_TOL)

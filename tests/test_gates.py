@@ -7,7 +7,7 @@ import numpy as np
 import pytest
 
 import quest_tpu as qt
-from oracle import (DM_TOL, NUM_QUBITS, assert_dm, assert_sv, dm,
+from oracle import (DM_TOL, NUM_QUBITS, SV_TOL, assert_dm, assert_sv, dm,
                     random_density_matrix, random_statevector, set_dm, set_sv, sv)
 
 N = NUM_QUBITS
@@ -24,7 +24,7 @@ def test_collapseToOutcome(env):
             mask = np.array([(i >> t) & 1 == outcome for i in range(1 << N)])
             prob = float(np.sum(np.abs(vec[mask]) ** 2))
             got = qt.collapseToOutcome(psi, t, outcome)
-            assert got == pytest.approx(prob, abs=1e-12)
+            assert got == pytest.approx(prob, abs=SV_TOL)
             expected = np.where(mask, vec, 0.0) / np.sqrt(prob)
             assert_sv(psi, expected)
             # density matrix
@@ -33,7 +33,7 @@ def test_collapseToOutcome(env):
             probd = float(np.real(sum(rho[i, i] for i in range(1 << N)
                                       if ((i >> t) & 1) == outcome)))
             gotd = qt.collapseToOutcome(dq, t, outcome)
-            assert gotd == pytest.approx(probd, abs=1e-12)
+            assert gotd == pytest.approx(probd, abs=SV_TOL)
             keep = np.array([((i >> t) & 1) == outcome for i in range(1 << N)])
             expected_rho = np.where(np.outer(keep, keep), rho, 0.0) / probd
             assert_dm(dq, expected_rho)
@@ -58,7 +58,7 @@ def test_measure(env):
             out = qt.measure(psi, t)
             counts[out] += 1
             # post-measurement state is normalised and consistent
-            assert qt.calcProbOfOutcome(psi, t, out) == pytest.approx(1.0, abs=1e-10)
+            assert qt.calcProbOfOutcome(psi, t, out) == pytest.approx(1.0, abs=SV_TOL)
         assert counts[0] + counts[1] == 10
     # deterministic on a classical state
     psi = qt.createQureg(N, env)
@@ -79,15 +79,15 @@ def test_measureWithStats(env):
     qt.initPlusState(psi)
     out, prob = qt.measureWithStats(psi, 2)
     assert out in (0, 1)
-    assert prob == pytest.approx(0.5, abs=1e-10)
+    assert prob == pytest.approx(0.5, abs=SV_TOL)
     # repeated measurement of the same qubit is deterministic with prob 1
     out2, prob2 = qt.measureWithStats(psi, 2)
     assert out2 == out
-    assert prob2 == pytest.approx(1.0, abs=1e-10)
+    assert prob2 == pytest.approx(1.0, abs=SV_TOL)
     # density matrix
     rho = qt.createDensityQureg(N, env)
     qt.initPlusState(rho)
     out, prob = qt.measureWithStats(rho, 0)
     assert out in (0, 1)
-    assert prob == pytest.approx(0.5, abs=1e-10)
-    assert qt.calcTotalProb(rho) == pytest.approx(1.0, abs=1e-10)
+    assert prob == pytest.approx(0.5, abs=SV_TOL)
+    assert qt.calcTotalProb(rho) == pytest.approx(1.0, abs=SV_TOL)

@@ -7,7 +7,7 @@ import pytest
 
 import quest_tpu as qt
 from quest_tpu import native
-from oracle import NUM_QUBITS, random_statevector, set_sv, sv
+from oracle import NUM_QUBITS, random_statevector, set_sv, sv, SV_TOL
 
 N = NUM_QUBITS
 
@@ -28,7 +28,7 @@ def _equiv(env, circuit, max_pack=1):
     import copy
     opt = copy.deepcopy(circuit).optimize(max_pack=max_pack)
     qt.apply_circuit(q2, opt)
-    np.testing.assert_allclose(sv(q2), sv(q1), atol=1e-12)
+    np.testing.assert_allclose(sv(q2), sv(q1), atol=SV_TOL)
     return opt
 
 

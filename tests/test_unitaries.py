@@ -13,6 +13,8 @@ import numpy as np
 import pytest
 
 import quest_tpu as qt
+from generators import (bitsets, pauliseqs, sublists, subsets,
+                        target_control_cases)
 from oracle import (DM_TOL, NUM_QUBITS, H, I2, X, Y, Z, apply_to_dm,
                     apply_to_sv, assert_dm, assert_sv, dm, full_operator,
                     phase_shift, random_unitary, rot, sv)
@@ -28,21 +30,32 @@ def _prepared(env):
     return psi, rho, sv(psi), dm(rho)
 
 
-def _check(env, apply_quest, targets, u, controls=(), control_states=None):
-    """Apply through quest_tpu and the oracle on both register kinds."""
-    psi, rho, ref_psi, ref_rho = _prepared(env)
-    apply_quest(psi)
-    apply_quest(rho)
-    assert_sv(psi, apply_to_sv(ref_psi, N, targets, u, controls, control_states))
-    assert_dm(rho, apply_to_dm(ref_rho, N, targets, u, controls, control_states))
+def _check(env, apply_quest, targets, u, controls=(), control_states=None,
+           kind="both"):
+    """Apply through quest_tpu and the oracle on both register kinds (or one,
+    for exhaustive sweeps that alternate kinds to halve runtime)."""
+    if kind in ("both", "sv"):
+        psi = qt.createQureg(N, env)
+        qt.initDebugState(psi)
+        ref_psi = sv(psi)
+        apply_quest(psi)
+        assert_sv(psi, apply_to_sv(ref_psi, N, targets, u, controls, control_states))
+    if kind in ("both", "dm"):
+        rho = qt.createDensityQureg(N, env)
+        qt.initDebugState(rho)
+        ref_rho = dm(rho)
+        apply_quest(rho)
+        assert_dm(rho, apply_to_dm(ref_rho, N, targets, u, controls, control_states))
 
 
 def _all_pairs():
     return [(a, b) for a in range(N) for b in range(N) if a != b]
 
 
-_SOME_PAIRS = [(0, 1), (1, 0), (0, N - 1), (N - 1, 2), (3, 4)]
-_SOME_TRIPLES = [(0, 1, 2), (4, 1, 3), (2, 4, 0)]
+# exhaustive generator-driven arrangements (ref: utilities.hpp sublists —
+# every ordered arrangement at 5 qubits); replaces the old hand-picked tuples
+_ALL_PAIRS = sublists(range(N), 2)            # all 20 ordered (a, b)
+_ALL_TRIPLES = sublists(range(N), 3)          # all 60 ordered (a, b, c)
 
 
 # ---------------------------------------------------------------------------
@@ -144,7 +157,7 @@ def test_phaseShift(env):
 # ---------------------------------------------------------------------------
 
 def test_controlledNot(env):
-    for c, t in _SOME_PAIRS:
+    for c, t in _ALL_PAIRS:
         _check(env, lambda q, c=c, t=t: qt.controlledNot(q, c, t), [t], X, [c])
     psi = qt.createQureg(N, env)
     with pytest.raises(qt.QuESTError, match="equal target"):
@@ -154,18 +167,18 @@ def test_controlledNot(env):
 
 
 def test_controlledPauliY(env):
-    for c, t in _SOME_PAIRS:
+    for c, t in _ALL_PAIRS:
         _check(env, lambda q, c=c, t=t: qt.controlledPauliY(q, c, t), [t], Y, [c])
 
 
 def test_controlledPhaseFlip(env):
-    for c, t in _SOME_PAIRS:
+    for c, t in _ALL_PAIRS:
         _check(env, lambda q, c=c, t=t: qt.controlledPhaseFlip(q, c, t), [t], Z, [c])
 
 
 def test_controlledPhaseShift(env):
     theta = 1.7
-    for c, t in _SOME_PAIRS:
+    for c, t in _ALL_PAIRS:
         _check(env, lambda q, c=c, t=t: qt.controlledPhaseShift(q, c, t, theta),
                [t], phase_shift(theta), [c])
 
@@ -175,41 +188,41 @@ def test_controlledCompactUnitary(env):
     norm = np.sqrt(abs(alpha) ** 2 + abs(beta) ** 2)
     alpha, beta = alpha / norm, beta / norm
     u = np.array([[alpha, -np.conj(beta)], [beta, np.conj(alpha)]])
-    for c, t in _SOME_PAIRS:
+    for c, t in _ALL_PAIRS:
         _check(env, lambda q, c=c, t=t: qt.controlledCompactUnitary(q, c, t, alpha, beta),
                [t], u, [c])
 
 
 def test_controlledUnitary(env):
     u = random_unitary(1)
-    for c, t in _SOME_PAIRS:
+    for c, t in _ALL_PAIRS:
         _check(env, lambda q, c=c, t=t: qt.controlledUnitary(q, c, t, u), [t], u, [c])
 
 
 def test_controlledRotateX(env):
     theta = 0.4
-    for c, t in _SOME_PAIRS:
+    for c, t in _ALL_PAIRS:
         _check(env, lambda q, c=c, t=t: qt.controlledRotateX(q, c, t, theta),
                [t], rot([1, 0, 0], theta), [c])
 
 
 def test_controlledRotateY(env):
     theta = 1.1
-    for c, t in _SOME_PAIRS:
+    for c, t in _ALL_PAIRS:
         _check(env, lambda q, c=c, t=t: qt.controlledRotateY(q, c, t, theta),
                [t], rot([0, 1, 0], theta), [c])
 
 
 def test_controlledRotateZ(env):
     theta = -0.9
-    for c, t in _SOME_PAIRS:
+    for c, t in _ALL_PAIRS:
         _check(env, lambda q, c=c, t=t: qt.controlledRotateZ(q, c, t, theta),
                [t], rot([0, 0, 1], theta), [c])
 
 
 def test_controlledRotateAroundAxis(env):
     theta, axis = -2.0, (0.5, 1.0, -1.5)
-    for c, t in _SOME_PAIRS:
+    for c, t in _ALL_PAIRS:
         _check(env,
                lambda q, c=c, t=t: qt.controlledRotateAroundAxis(q, c, t, theta, axis),
                [t], rot(axis, theta), [c])
@@ -217,10 +230,12 @@ def test_controlledRotateAroundAxis(env):
 
 def test_multiControlledUnitary(env):
     u = random_unitary(1)
-    for ctrls, t in [((1,), 0), ((0, 1), 2), ((0, 1, 2, 3), 4), ((4, 2), 0)]:
+    cases = [(cs, t) for t in range(N)
+             for k in range(1, N) for cs in subsets(range(N), k, exclude=(t,))]
+    for i, (ctrls, t) in enumerate(cases):
         _check(env,
                lambda q, cs=ctrls, t=t: qt.multiControlledUnitary(q, list(cs), len(cs), t, u),
-               [t], u, list(ctrls))
+               [t], u, list(ctrls), kind="sv" if i % 2 else "dm")
     psi = qt.createQureg(N, env)
     with pytest.raises(qt.QuESTError, match="unique"):
         qt.multiControlledUnitary(psi, [0, 0], 2, 1, u)
@@ -230,12 +245,19 @@ def test_multiControlledUnitary(env):
 
 def test_multiStateControlledUnitary(env):
     u = random_unitary(1)
-    for ctrls, states, t in [((1,), (0,), 0), ((0, 2), (1, 0), 3),
-                             ((0, 1, 4), (0, 0, 1), 2)]:
+    cases = []
+    for c, t in sublists(range(N), 2):
+        for states in bitsets(1):
+            cases.append(((c,), states, t))
+    for i, (targs, _) in enumerate(target_control_cases(N, 1, max_ctrls=0)):
+        pats = bitsets(2)
+        cs = sublists(range(N), 2, exclude=targs)
+        cases.append((cs[i % len(cs)], pats[i % len(pats)], targs[0]))
+    for i, (ctrls, states, t) in enumerate(cases):
         _check(env,
                lambda q, cs=ctrls, ss=states, t=t:
                    qt.multiStateControlledUnitary(q, list(cs), list(ss), len(cs), t, u),
-               [t], u, list(ctrls), list(states))
+               [t], u, list(ctrls), list(states), kind="sv" if i % 2 else "dm")
 
 
 # ---------------------------------------------------------------------------
@@ -251,7 +273,7 @@ _SQRT_SWAP = np.array([[1, 0, 0, 0],
 
 
 def test_swapGate(env):
-    for a, b in _SOME_PAIRS:
+    for a, b in _ALL_PAIRS:
         _check(env, lambda q, a=a, b=b: qt.swapGate(q, a, b), [a, b], _SWAP)
     psi = qt.createQureg(N, env)
     with pytest.raises(qt.QuESTError, match="unique"):
@@ -259,7 +281,7 @@ def test_swapGate(env):
 
 
 def test_sqrtSwapGate(env):
-    for a, b in _SOME_PAIRS:
+    for a, b in _ALL_PAIRS:
         _check(env, lambda q, a=a, b=b: qt.sqrtSwapGate(q, a, b), [a, b], _SQRT_SWAP)
 
 
@@ -268,7 +290,7 @@ def test_sqrtSwapGate(env):
 # ---------------------------------------------------------------------------
 
 def test_multiControlledPhaseFlip(env):
-    for qs in [(0, 1), (2, 4, 0), (0, 1, 2, 3, 4)]:
+    for qs in [qs for k in range(2, N + 1) for qs in subsets(range(N), k)]:
         # a phase flip on all-1s of the group: diag with -1 at the last entry
         u = np.eye(1 << len(qs), dtype=complex)
         u[-1, -1] = -1
@@ -278,7 +300,7 @@ def test_multiControlledPhaseFlip(env):
 
 def test_multiControlledPhaseShift(env):
     theta = 0.77
-    for qs in [(0, 1), (1, 3, 4), (0, 1, 2, 3, 4)]:
+    for qs in [qs for k in range(2, N + 1) for qs in subsets(range(N), k)]:
         u = np.eye(1 << len(qs), dtype=complex)
         u[-1, -1] = np.exp(1j * theta)
         _check(env,
@@ -288,7 +310,7 @@ def test_multiControlledPhaseShift(env):
 
 def test_multiRotateZ(env):
     theta = 1.3
-    for qs in [(0,), (0, 1), (1, 3, 4), (0, 1, 2, 3, 4)]:
+    for qs in [qs for k in range(1, N + 1) for qs in subsets(range(N), k)]:
         # exp(-i theta/2 Z x..x Z): diagonal phase by parity of the group bits
         dim = 1 << len(qs)
         diag = np.array([np.exp(-1j * theta / 2 * (1 - 2 * (bin(i).count("1") % 2)))
@@ -300,8 +322,10 @@ def test_multiRotateZ(env):
 def test_multiRotatePauli(env):
     theta = 0.67
     paulis = [I2, X, Y, Z]
-    for qs, codes in [((0,), (1,)), ((0, 2), (2, 3)), ((1, 3, 4), (1, 2, 3)),
-                      ((0, 1, 2), (3, 3, 1))]:
+    cases = [(qs, (1, 3)) for qs in sublists(range(N), 2)]
+    cases += [((1, 3), codes) for codes in pauliseqs(2)]
+    cases += [((1, 3, 4), (1, 2, 3)), ((0, 1, 2), (3, 3, 1)), ((0,), (2,))]
+    for qs, codes in cases:
         # exp(-i theta/2 sigma_1 x .. x sigma_k), with codes[j] acting on qs[j]
         op = np.array([[1.0]], dtype=complex)
         for c in reversed(codes):  # qs[0] = least significant row bit
@@ -320,7 +344,7 @@ def test_multiRotatePauli(env):
 
 def test_twoQubitUnitary(env):
     u = random_unitary(2)
-    for t1, t2 in _SOME_PAIRS:
+    for t1, t2 in _ALL_PAIRS:
         _check(env, lambda q, a=t1, b=t2: qt.twoQubitUnitary(q, a, b, u), [t1, t2], u)
     psi = qt.createQureg(N, env)
     with pytest.raises(qt.QuESTError, match="not unitary"):
@@ -329,14 +353,20 @@ def test_twoQubitUnitary(env):
 
 def test_controlledTwoQubitUnitary(env):
     u = random_unitary(2)
-    for c, (t1, t2) in [(4, (0, 1)), (0, (1, 2)), (2, (3, 0))]:
+    cases = []
+    for i, (t1, t2) in enumerate(sublists(range(N), 2)):
+        rest = [q for q in range(N) if q not in (t1, t2)]
+        cases.append((rest[i % len(rest)], (t1, t2)))
+    for c, (t1, t2) in cases:
         _check(env, lambda q, c=c, a=t1, b=t2: qt.controlledTwoQubitUnitary(q, c, a, b, u),
                [t1, t2], u, [c])
 
 
 def test_multiControlledTwoQubitUnitary(env):
     u = random_unitary(2)
-    for cs, (t1, t2) in [((4,), (0, 1)), ((0, 1), (2, 3)), ((2, 3, 4), (0, 1))]:
+    for (t1, t2), cs in target_control_cases(N, 2, max_ctrls=3):
+        if not cs:
+            continue
         _check(env,
                lambda q, cs=cs, a=t1, b=t2:
                    qt.multiControlledTwoQubitUnitary(q, list(cs), len(cs), a, b, u),
@@ -352,12 +382,14 @@ def _max_dense_targets(env):
 
 def test_multiQubitUnitary(env):
     kmax = _max_dense_targets(env)
-    for targs in [(0,), (0, 1), (2, 0, 4), (1, 3, 4, 0)]:
+    all_targs = [t for k in range(1, 4) for t in sublists(range(N), k)]
+    all_targs.append((1, 3, 4, 0))
+    for i, targs in enumerate(all_targs):
         if len(targs) > kmax:
             continue
         u = random_unitary(len(targs))
         _check(env, lambda q, ts=targs, u=u: qt.multiQubitUnitary(q, list(ts), len(ts), u),
-               list(targs), u)
+               list(targs), u, kind="sv" if i % 2 else "dm")
     psi = qt.createQureg(N, env)
     with pytest.raises(qt.QuESTError, match="unique"):
         qt.multiQubitUnitary(psi, [0, 0], 2, random_unitary(2))
@@ -369,7 +401,13 @@ def test_multiQubitUnitary(env):
 
 def test_controlledMultiQubitUnitary(env):
     kmax = _max_dense_targets(env)
-    for c, targs in [(4, (0, 1)), (0, (2, 3, 4)), (1, (0,))]:
+    cases = []
+    for k in (1, 2):
+        for i, targs in enumerate(sublists(range(N), k)):
+            rest = [q for q in range(N) if q not in targs]
+            cases.append((rest[i % len(rest)], targs))
+    cases.append((0, (2, 3, 4)))
+    for c, targs in cases:
         if len(targs) > kmax:
             continue
         u = random_unitary(len(targs))
@@ -381,8 +419,10 @@ def test_controlledMultiQubitUnitary(env):
 
 def test_multiControlledMultiQubitUnitary(env):
     kmax = _max_dense_targets(env)
-    for cs, targs in [((4,), (0, 1)), ((0, 1), (2, 3)), ((1, 2, 4), (0, 3)),
-                      ((0,), (1, 2, 3))]:
+    cases = [(cs, ts) for k in (1, 2)
+             for ts, cs in target_control_cases(N, k, max_ctrls=3) if cs]
+    cases.append(((0,), (1, 2, 3)))
+    for cs, targs in cases:
         if len(targs) > kmax:
             continue
         u = random_unitary(len(targs))
