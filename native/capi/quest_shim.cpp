@@ -968,6 +968,12 @@ void initStateOfSingleQubit(Qureg* q, int qubitId, int outcome) {
     drop(pycall("initStateOfSingleQubit", "(Nii)", qh(*q), qubitId, outcome));
 }
 
+int initStateFromSingleFile(Qureg* q, char filename[200], QuESTEnv env) {
+    (void)env;
+    return static_cast<int>(to_ll(
+        pycall("initStateFromSingleFile", "(Ns)", qh(*q), filename)));
+}
+
 void setDensityAmps(Qureg q, qreal* reals, qreal* imags) {
     drop(pycall("setDensityAmps", "(NNN)", qh(q),
                 double_list(reals, q.numAmpsTotal),

@@ -269,6 +269,7 @@ Complex calcExpecDiagonalOp(Qureg qureg, DiagonalOp op);
 /* debug API (ref: QuEST_debug.h) */
 void initStateDebug(Qureg qureg);
 void initStateOfSingleQubit(Qureg *qureg, int qubitId, int outcome);
+int initStateFromSingleFile(Qureg *qureg, char filename[200], QuESTEnv env);
 void setDensityAmps(Qureg qureg, qreal* reals, qreal* imags);
 int compareStates(Qureg mq1, Qureg mq2, qreal precision);
 int QuESTPrecision(void);

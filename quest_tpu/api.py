@@ -95,7 +95,7 @@ __all__ = [
     # reporting / debug
     "reportState", "reportStateToScreen", "copyStateToGPU", "copyStateFromGPU",
     "initStateDebug", "compareStates", "initStateOfSingleQubit",
-    "QuESTPrecision",
+    "initStateFromSingleFile", "QuESTPrecision",
     # types
     "Qureg", "QuESTEnv", "Complex", "ComplexMatrix2", "ComplexMatrix4",
     "Vector", "PauliHamil", "DiagonalOp", "PauliOpType", "QuESTError",
@@ -1396,6 +1396,37 @@ def _amps_buffer(qureg: Qureg) -> np.ndarray:
     array (the shim memcpys this into the C Qureg's host stateVec mirror for
     copyStateFromGPU, ref: QuEST_gpu.cu:451-473)."""
     return np.ascontiguousarray(np.asarray(qureg.amps, dtype=np.float64))
+
+
+def initStateFromSingleFile(qureg: Qureg, filename: str, env: QuESTEnv = None) -> int:
+    """Load amplitudes from a single text file of ``re, im`` lines with
+    ``#`` comments — the debug-API loader (ref: statevec_initStateFromSingleFile,
+    QuEST_cpu.c:1625-1673).  Returns 1 on success, 0 if the file cannot be
+    opened, like the reference.  Unparseable non-comment lines count toward
+    the index but leave zeros (the reference's sscanf leaves the slot as-is)."""
+    V.validate_state_vec_qureg(qureg, "initStateFromSingleFile")
+    try:
+        f = open(filename)
+    except OSError:
+        return 0
+    total = qureg.num_amps_total
+    re = np.zeros(total)
+    im = np.zeros(total)
+    idx = 0
+    with f:
+        for line in f:
+            if line.startswith("#") or idx >= total:
+                continue
+            parts = line.split(",")
+            try:
+                re[idx] = float(parts[0])
+                im[idx] = float(parts[1])
+            except (ValueError, IndexError):
+                pass
+            idx += 1
+    amps = jnp.asarray(np.stack([re, im]), dtype=qureg.dtype)
+    qureg.set_amps_array(amps)
+    return 1
 
 
 def _validate_create_qureg(num_qubits: int, num_ranks: int, is_density: int) -> None:

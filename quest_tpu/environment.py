@@ -39,6 +39,16 @@ class QuESTEnv:
     num_ranks: int
     rank: int = 0  # single-controller SPMD: the host drives all shards
 
+    def __post_init__(self):
+        # weak registry of Quregs created under this env, so syncQuESTEnv
+        # blocks exactly this env's pending work (not every live array in
+        # the process)
+        import weakref
+        object.__setattr__(self, "_quregs", weakref.WeakSet())
+
+    def _register(self, qureg) -> None:
+        self._quregs.add(qureg)
+
     @property
     def sharding(self) -> NamedSharding | None:
         """Sharding for a (2, 2^n) SoA amplitude pair: re/im replicated on
@@ -83,9 +93,16 @@ def destroy_quest_env(env: QuESTEnv) -> None:
 
 
 def sync_quest_env(env: QuESTEnv) -> None:
-    """Ref analogue: syncQuESTEnv (MPI_Barrier) — block until device work drains."""
-    for d in jax.live_arrays():
-        d.block_until_ready()
+    """Ref analogue: syncQuESTEnv (MPI_Barrier).
+
+    Blocks until every Qureg created under this env has drained its pending
+    device work.  Per-device execution is in-order, so blocking on the env's
+    quregs (a weak registry, not a scan of every live array in the process)
+    is a complete barrier for this env's work."""
+    for q in list(getattr(env, "_quregs", ())):
+        amps = getattr(q, "amps", None)
+        if amps is not None:
+            amps.block_until_ready()
 
 
 def sync_quest_success(env: QuESTEnv, success_code: int) -> int:

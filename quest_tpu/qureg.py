@@ -37,6 +37,8 @@ class Qureg:
         self.dtype = storage_dtype(dtype if dtype is not None else CONFIG.real_dtype)
         self.amps: jax.Array | None = None
         self.qasm = QASMLogger(num_qubits)
+        if env is not None and hasattr(env, "_register"):
+            env._register(self)  # weak: lets syncQuESTEnv barrier this env
 
     # --- ref-compatible aliases -------------------------------------------
     @property

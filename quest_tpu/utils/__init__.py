@@ -6,4 +6,4 @@ QuEST_common.c:216-232).  They are first-class here because long distributed
 simulations on pods need them."""
 
 from .checkpoint import save_qureg, load_qureg  # noqa: F401
-from .profiling import trace, annotate  # noqa: F401
+from .profiling import trace, annotate, circuit_stats, timed  # noqa: F401

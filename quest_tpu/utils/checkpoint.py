@@ -52,7 +52,14 @@ def save_qureg(qureg, directory: str) -> None:
 
 
 def load_qureg(directory: str, env):
-    """Recreate a Qureg from a checkpoint directory onto ``env``'s mesh."""
+    """Recreate a Qureg from a checkpoint directory onto ``env``'s mesh.
+
+    Restores shard-by-shard: each target device's slice is assembled from
+    the (memory-mapped) checkpoint files covering its index range and
+    device_put directly, then the global array is built with
+    ``jax.make_array_from_single_device_arrays`` — peak host memory is one
+    device shard, never the full state, so restore scales to states larger
+    than host RAM."""
     import quest_tpu as qt
 
     with open(os.path.join(directory, "manifest.json")) as f:
@@ -64,10 +71,31 @@ def load_qureg(directory: str, env):
     else:
         q = qt.createQureg(n, env, dtype=dtype)
     total = q.num_amps_total
-    full = np.zeros((2, total), dtype=np.dtype(meta["dtype"]))
-    for rec in meta["shards"]:
-        data = np.load(os.path.join(directory, rec["file"]))
-        full[:, rec["start"]:rec["start"] + data.shape[1]] = data
-    arr = jax.numpy.asarray(full)
+    shape = (2, total)
+
+    # memory-mapped views of the checkpoint files (reads only touched ranges)
+    files = [(rec["start"],
+              np.load(os.path.join(directory, rec["file"]), mmap_mode="r"))
+             for rec in meta["shards"]]
+    files.sort(key=lambda t: t[0])
+
+    def read_range(lo: int, hi: int) -> np.ndarray:
+        part = np.empty((2, hi - lo), dtype=dtype)
+        for start, data in files:
+            end = start + data.shape[1]
+            if end <= lo or start >= hi:
+                continue
+            a, b = max(lo, start), min(hi, end)
+            part[:, a - lo:b - lo] = data[:, a - start:b - start]
+        return part
+
+    sharding = q.amps.sharding
+    buffers = []
+    for device, index in sharding.addressable_devices_indices_map(shape).items():
+        sl = index[1]
+        lo = sl.start or 0
+        hi = sl.stop if sl.stop is not None else total
+        buffers.append(jax.device_put(read_range(lo, hi), device))
+    arr = jax.make_array_from_single_device_arrays(shape, sharding, buffers)
     q.set_amps_array(arr)
     return q

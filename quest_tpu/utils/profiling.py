@@ -1,10 +1,23 @@
-"""Tracing/profiling hooks — a new capability over the reference, which has
-no observability beyond reportState (SURVEY §5).  Thin wrappers over the JAX
-profiler so simulations can be inspected in XProf/TensorBoard."""
+"""Tracing/profiling — a new capability over the reference, which has no
+observability beyond reportState (SURVEY §5: "The TPU build should add real
+tracing as a new capability, not a port").
+
+Three layers:
+- device traces: :func:`trace` / :func:`annotate` wrap the JAX profiler so a
+  simulation shows up in XProf/TensorBoard with named regions;
+- circuit cost model: :func:`circuit_stats` reports, before compiling, how
+  many HBM passes / MXU contractions / collective ops a circuit will cost on
+  an ``n``-qubit state over ``num_ranks`` shards — the static analogue of the
+  reference's per-gate comm decision (QuEST_cpu_distributed.c:356-361);
+- wall-clock: :func:`timed` measures a jitted program with dispatch overhead
+  subtracted, the methodology bench.py uses.
+"""
 
 from __future__ import annotations
 
 import contextlib
+import dataclasses
+import time
 
 import jax
 
@@ -22,3 +35,65 @@ def trace(log_dir: str):
 def annotate(name: str):
     """Named region that shows up on the trace timeline."""
     return jax.profiler.TraceAnnotation(name)
+
+
+@dataclasses.dataclass
+class CircuitStats:
+    """Static cost report for one circuit application."""
+    num_ops: int                 # ops after any fusion
+    hbm_passes: int              # full-state read+write sweeps
+    mxu_contractions: int        # dense matmul ops (MXU work)
+    diagonal_ops: int            # broadcast multiplies (VPU only)
+    cross_shard_ops: int         # ops touching the sharded prefix qubits
+    bytes_per_pass: int          # state size in bytes (one direction)
+
+    def __str__(self):
+        gb = self.bytes_per_pass / 1e9
+        return (f"{self.num_ops} ops: {self.mxu_contractions} dense (MXU), "
+                f"{self.diagonal_ops} diagonal (VPU), "
+                f"{self.cross_shard_ops} cross-shard; "
+                f"~{self.hbm_passes} HBM passes x {gb:.3g} GB")
+
+
+def circuit_stats(circuit, num_qubits: int | None = None,
+                  num_ranks: int = 1, bytes_per_real: int = 4) -> CircuitStats:
+    """Analyse a :class:`~quest_tpu.circuit.Circuit` without compiling it.
+
+    An op is "cross-shard" when it targets (or is controlled on) one of the
+    top ``log2(num_ranks)`` qubits — the ops whose GSPMD partitioning inserts
+    collectives, the reference's pairwise-exchange case
+    (ref: QuEST_cpu_distributed.c:303-312)."""
+    n = num_qubits if num_qubits is not None else circuit.num_qubits
+    shard_qubits = max(num_ranks.bit_length() - 1, 0)
+    lo = n - shard_qubits  # qubits >= lo live on the sharded axis prefix
+    dense = diag = cross = 0
+    for op in circuit.ops:
+        wires = tuple(op.targets) + tuple(op.controls)
+        if op.kind == "diagonal":
+            diag += 1
+        else:
+            dense += 1
+        if any(q >= lo for q in wires):
+            cross += 1
+    num_ops = len(circuit.ops)
+    return CircuitStats(
+        num_ops=num_ops,
+        hbm_passes=num_ops,  # one read+write sweep per un-fused op
+        mxu_contractions=dense,
+        diagonal_ops=diag,
+        cross_shard_ops=cross,
+        bytes_per_pass=2 * (1 << n) * bytes_per_real,
+    )
+
+
+def timed(fn, *args, reps: int = 1):
+    """Wall-clock a jitted ``fn(*args)`` with compile + dispatch overhead
+    excluded: warm call first, then ``reps`` timed calls bounded by
+    ``block_until_ready``.  Returns (seconds_per_call, last_result)."""
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / max(reps, 1), out

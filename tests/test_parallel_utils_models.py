@@ -153,3 +153,49 @@ def test_trotter_circuit_matches_api(env):
     qt.applyTrotterCircuit(q1, hamil, 0.3, 2, 3)
     qt.apply_circuit(q2, trotter_circuit(hamil, 0.3, 2, 3))
     np.testing.assert_allclose(sv(q2), sv(q1), atol=1e-10)
+
+
+def test_checkpoint_cross_mesh_restore(env_local, env_dist, tmp_path):
+    """A checkpoint written under one sharding restores onto a different mesh
+    (dist8 -> local and local -> dist8), shard-by-shard with no full-state
+    host buffer (load_qureg assembles per-device slices from memory-mapped
+    shard files)."""
+    vec = random_statevector(8)
+    q = qt.createQureg(8, env_dist)
+    set_sv(q, vec)
+    save_qureg(q, str(tmp_path / "a"))
+    q2 = load_qureg(str(tmp_path / "a"), env_local)      # 8 shards -> 1
+    np.testing.assert_allclose(sv(q2), vec, atol=1e-12)
+
+    q3 = qt.createQureg(8, env_local)
+    set_sv(q3, vec)
+    save_qureg(q3, str(tmp_path / "b"))
+    q4 = load_qureg(str(tmp_path / "b"), env_dist)       # 1 shard -> 8
+    np.testing.assert_allclose(sv(q4), vec, atol=1e-12)
+    assert len(q4.amps.sharding.device_set) == 8
+
+
+def test_init_state_from_single_file(env, tmp_path):
+    fn = tmp_path / "state.txt"
+    fn.write_text("# comment line\n0.6, 0.0\n0.0, 0.8\n" + "0.0, 0.0\n" * 30)
+    q = qt.createQureg(5, env)
+    assert qt.initStateFromSingleFile(q, str(fn)) == 1
+    np.testing.assert_allclose(sv(q)[:2], [0.6, 0.8j], atol=1e-12)
+    assert qt.initStateFromSingleFile(q, str(tmp_path / "missing.txt")) == 0
+
+
+def test_sync_quest_env_blocks_env_quregs(env):
+    q = qt.createQureg(5, env)
+    qt.hadamard(q, 0)
+    qt.syncQuESTEnv(env)  # must not raise; blocks this env's quregs only
+    assert qt.calcTotalProb(q) == pytest.approx(1.0, abs=1e-10)
+
+
+def test_circuit_stats():
+    from quest_tpu.utils.profiling import circuit_stats
+    c = qt.Circuit(6).h(0).cz(0, 1).s(5).x(4)
+    st = circuit_stats(c, num_ranks=4)  # qubits 4,5 sharded
+    assert st.num_ops == 4
+    assert st.diagonal_ops == 2          # cz records as controlled diagonal, s
+    assert st.mxu_contractions == 2      # h, x
+    assert st.cross_shard_ops == 2       # s(5), x(4)
