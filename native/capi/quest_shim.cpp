@@ -117,6 +117,18 @@ PyObject* pycall(const char* name, const char* fmt, ...) {
 
 void drop(PyObject* o) { Py_XDECREF(o); }
 
+const char* kMatrixNotInit =
+    "The ComplexMatrixN was not successfully created (possibly insufficient "
+    "memory available).";
+
+// ref analogue: validateMatrixInit — an un-created ComplexMatrixN (NULL
+// arrays) must raise rather than be dereferenced
+bool matrixN_ok(ComplexMatrixN u, const char* func) {
+    if (u.real && u.imag) return true;
+    invalidQuESTInputError(kMatrixNotInit, func);
+    return false;  // hook returned: skip the operation
+}
+
 double to_double(PyObject* o) {
     if (!o) return 0.0;
     double v = PyFloat_AsDouble(o);
@@ -435,6 +447,7 @@ ComplexMatrixN createComplexMatrixN(int numQubits) {
 }
 
 void destroyComplexMatrixN(ComplexMatrixN m) {
+    if (!matrixN_ok(m, "destroyComplexMatrixN")) return;
     int dim = 1 << m.numQubits;
     for (int r = 0; r < dim; r++) {
         std::free(m.real[r]);
@@ -446,6 +459,7 @@ void destroyComplexMatrixN(ComplexMatrixN m) {
 
 // C declaration uses VLA types (see header); ABI-compatible flat definition
 void initComplexMatrixN(ComplexMatrixN m, qreal* real, qreal* imag) {
+    if (!matrixN_ok(m, "initComplexMatrixN")) return;
     int dim = 1 << m.numQubits;
     for (int r = 0; r < dim; r++)
         for (int c = 0; c < dim; c++) {
@@ -581,13 +595,18 @@ void initDiagonalOp(DiagonalOp op, qreal* real, qreal* imag) {
 
 void setDiagonalOpElems(DiagonalOp op, long long int startInd,
                         qreal* real, qreal* imag, long long int numElems) {
-    if (startInd >= 0 && startInd + numElems <= op.numElemsPerChunk) {
+    // user arrays may be garbage when the indices are invalid (the
+    // reference's own validation tests do this) — touch them only after the
+    // bounds check; invalid calls still forward so validation raises
+    bool ok = startInd >= 0 && numElems >= 0 && real && imag &&
+              startInd + numElems <= op.numElemsPerChunk;
+    if (ok) {
         std::memcpy(op.real + startInd, real, sizeof(qreal) * numElems);
         std::memcpy(op.imag + startInd, imag, sizeof(qreal) * numElems);
     }
     drop(pycall("setDiagonalOpElems", "(NLNNL)", dh(op), startInd,
-                double_list(real, numElems), double_list(imag, numElems),
-                numElems));
+                double_list(ok ? real : nullptr, numElems),
+                double_list(ok ? imag : nullptr, numElems), numElems));
 }
 
 /* ---- state initialisation ---------------------------------------------- */
@@ -614,9 +633,11 @@ void initStateFromAmps(Qureg q, qreal* reals, qreal* imags) {
 
 void setAmps(Qureg q, long long int startInd, qreal* reals, qreal* imags,
              long long int numAmps) {
+    bool ok = startInd >= 0 && numAmps >= 0 && reals && imags &&
+              startInd + numAmps <= q.numAmpsTotal;
     drop(pycall("setAmps", "(NLNNL)", qh(q), startInd,
-                double_list(reals, numAmps), double_list(imags, numAmps),
-                numAmps));
+                double_list(ok ? reals : nullptr, numAmps),
+                double_list(ok ? imags : nullptr, numAmps), numAmps));
 }
 
 void setWeightedQureg(Complex fac1, Qureg q1, Complex fac2, Qureg q2,
@@ -761,16 +782,19 @@ void multiControlledTwoQubitUnitary(Qureg q, int* cs, int n, int t1, int t2,
 }
 
 void multiQubitUnitary(Qureg q, int* ts, int n, ComplexMatrixN u) {
+    if (!matrixN_ok(u, "multiQubitUnitary")) return;
     drop(pycall("multiQubitUnitary", "(NNiN)", qh(q), int_list(ts, n), n, mN(u)));
 }
 
 void controlledMultiQubitUnitary(Qureg q, int c, int* ts, int n, ComplexMatrixN u) {
+    if (!matrixN_ok(u, "controlledMultiQubitUnitary")) return;
     drop(pycall("controlledMultiQubitUnitary", "(NiNiN)", qh(q), c,
                 int_list(ts, n), n, mN(u)));
 }
 
 void multiControlledMultiQubitUnitary(Qureg q, int* cs, int nc, int* ts, int nt,
                                       ComplexMatrixN u) {
+    if (!matrixN_ok(u, "multiControlledMultiQubitUnitary")) return;
     drop(pycall("multiControlledMultiQubitUnitary", "(NNiNiN)", qh(q),
                 int_list(cs, nc), nc, int_list(ts, nt), nt, mN(u)));
 }
@@ -786,11 +810,13 @@ void applyMatrix4(Qureg q, int t1, int t2, ComplexMatrix4 u) {
 }
 
 void applyMatrixN(Qureg q, int* ts, int n, ComplexMatrixN u) {
+    if (!matrixN_ok(u, "applyMatrixN")) return;
     drop(pycall("applyMatrixN", "(NNiN)", qh(q), int_list(ts, n), n, mN(u)));
 }
 
 void applyMultiControlledMatrixN(Qureg q, int* cs, int nc, int* ts, int nt,
                                  ComplexMatrixN u) {
+    if (!matrixN_ok(u, "applyMultiControlledMatrixN")) return;
     drop(pycall("applyMultiControlledMatrixN", "(NNiNiN)", qh(q),
                 int_list(cs, nc), nc, int_list(ts, nt), nt, mN(u)));
 }
