@@ -117,6 +117,23 @@ PyObject* pycall(const char* name, const char* fmt, ...) {
 
 void drop(PyObject* o) { Py_XDECREF(o); }
 
+const char* kCannotFit =
+    "The specified matrix targets too many qubits; the batches of amplitudes "
+    "to modify cannot all fit in a single distributed node's memory.";
+
+// ref: validateMultiQubitMatrixFitsInNode — the C struct's chunk size is
+// authoritative (the reference's tests modify qureg.numAmpsPerChunk
+// directly to provoke this error)
+bool fits_ok(Qureg q, int numTargs, const char* func) {
+    // invalid counts are reported by runtime validation first (the
+    // reference validates targets before the fits-in-node rule)
+    int max_targs = q.numQubitsRepresented * (q.isDensityMatrix ? 2 : 1);
+    if (numTargs <= 0 || numTargs > max_targs) return true;
+    if ((1LL << numTargs) <= q.numAmpsPerChunk) return true;
+    invalidQuESTInputError(kCannotFit, func);
+    return false;
+}
+
 const char* kMatrixNotInit =
     "The ComplexMatrixN was not successfully created (possibly insufficient "
     "memory available).";
@@ -235,18 +252,21 @@ PyObject* mN(ComplexMatrixN u) {
 }
 
 PyObject* m2_list(const ComplexMatrix2* ops, int n) {
+    if (n < 0 || !ops) n = 0;  // runtime validation rejects the bad count
     PyObject* list = PyList_New(n);
     for (int i = 0; i < n; i++) PyList_SET_ITEM(list, i, m2(ops[i]));
     return list;
 }
 
 PyObject* m4_list(const ComplexMatrix4* ops, int n) {
+    if (n < 0 || !ops) n = 0;  // runtime validation rejects the bad count
     PyObject* list = PyList_New(n);
     for (int i = 0; i < n; i++) PyList_SET_ITEM(list, i, m4(ops[i]));
     return list;
 }
 
 PyObject* mN_list(const ComplexMatrixN* ops, int n) {
+    if (n < 0 || !ops) n = 0;  // runtime validation rejects the bad count
     PyObject* list = PyList_New(n);
     for (int i = 0; i < n; i++) PyList_SET_ITEM(list, i, mN(ops[i]));
     return list;
@@ -768,26 +788,31 @@ void multiRotatePauli(Qureg q, int* ts, enum pauliOpType* paulis, int n,
 }
 
 void twoQubitUnitary(Qureg q, int t1, int t2, ComplexMatrix4 u) {
+    if (!fits_ok(q, 2, "twoQubitUnitary")) return;
     drop(pycall("twoQubitUnitary", "(NiiN)", qh(q), t1, t2, m4(u)));
 }
 
 void controlledTwoQubitUnitary(Qureg q, int c, int t1, int t2, ComplexMatrix4 u) {
+    if (!fits_ok(q, 2, "controlledTwoQubitUnitary")) return;
     drop(pycall("controlledTwoQubitUnitary", "(NiiiN)", qh(q), c, t1, t2, m4(u)));
 }
 
 void multiControlledTwoQubitUnitary(Qureg q, int* cs, int n, int t1, int t2,
                                     ComplexMatrix4 u) {
+    if (!fits_ok(q, 2, "multiControlledTwoQubitUnitary")) return;
     drop(pycall("multiControlledTwoQubitUnitary", "(NNiiiN)", qh(q),
                 int_list(cs, n), n, t1, t2, m4(u)));
 }
 
 void multiQubitUnitary(Qureg q, int* ts, int n, ComplexMatrixN u) {
     if (!matrixN_ok(u, "multiQubitUnitary")) return;
+    if (!fits_ok(q, n, "multiQubitUnitary")) return;
     drop(pycall("multiQubitUnitary", "(NNiN)", qh(q), int_list(ts, n), n, mN(u)));
 }
 
 void controlledMultiQubitUnitary(Qureg q, int c, int* ts, int n, ComplexMatrixN u) {
     if (!matrixN_ok(u, "controlledMultiQubitUnitary")) return;
+    if (!fits_ok(q, n, "controlledMultiQubitUnitary")) return;
     drop(pycall("controlledMultiQubitUnitary", "(NiNiN)", qh(q), c,
                 int_list(ts, n), n, mN(u)));
 }
@@ -795,6 +820,7 @@ void controlledMultiQubitUnitary(Qureg q, int c, int* ts, int n, ComplexMatrixN 
 void multiControlledMultiQubitUnitary(Qureg q, int* cs, int nc, int* ts, int nt,
                                       ComplexMatrixN u) {
     if (!matrixN_ok(u, "multiControlledMultiQubitUnitary")) return;
+    if (!fits_ok(q, nt, "multiControlledMultiQubitUnitary")) return;
     drop(pycall("multiControlledMultiQubitUnitary", "(NNiNiN)", qh(q),
                 int_list(cs, nc), nc, int_list(ts, nt), nt, mN(u)));
 }
@@ -806,17 +832,20 @@ void applyMatrix2(Qureg q, int t, ComplexMatrix2 u) {
 }
 
 void applyMatrix4(Qureg q, int t1, int t2, ComplexMatrix4 u) {
+    if (!fits_ok(q, 2, "applyMatrix4")) return;
     drop(pycall("applyMatrix4", "(NiiN)", qh(q), t1, t2, m4(u)));
 }
 
 void applyMatrixN(Qureg q, int* ts, int n, ComplexMatrixN u) {
     if (!matrixN_ok(u, "applyMatrixN")) return;
+    if (!fits_ok(q, n, "applyMatrixN")) return;
     drop(pycall("applyMatrixN", "(NNiN)", qh(q), int_list(ts, n), n, mN(u)));
 }
 
 void applyMultiControlledMatrixN(Qureg q, int* cs, int nc, int* ts, int nt,
                                  ComplexMatrixN u) {
     if (!matrixN_ok(u, "applyMultiControlledMatrixN")) return;
+    if (!fits_ok(q, nt, "applyMultiControlledMatrixN")) return;
     drop(pycall("applyMultiControlledMatrixN", "(NNiNiN)", qh(q),
                 int_list(cs, nc), nc, int_list(ts, nt), nt, mN(u)));
 }
@@ -876,16 +905,19 @@ void mixDensityMatrix(Qureg combineQureg, qreal prob, Qureg otherQureg) {
 }
 
 void mixKrausMap(Qureg q, int t, ComplexMatrix2* ops, int numOps) {
+    if (!fits_ok(q, 2, "mixKrausMap")) return;
     drop(pycall("mixKrausMap", "(NiNi)", qh(q), t, m2_list(ops, numOps), numOps));
 }
 
 void mixTwoQubitKrausMap(Qureg q, int t1, int t2, ComplexMatrix4* ops, int numOps) {
+    if (!fits_ok(q, 4, "mixTwoQubitKrausMap")) return;
     drop(pycall("mixTwoQubitKrausMap", "(NiiNi)", qh(q), t1, t2,
                 m4_list(ops, numOps), numOps));
 }
 
 void mixMultiQubitKrausMap(Qureg q, int* ts, int numTargets,
                            ComplexMatrixN* ops, int numOps) {
+    if (!fits_ok(q, 2 * numTargets, "mixMultiQubitKrausMap")) return;
     drop(pycall("mixMultiQubitKrausMap", "(NNiNi)", qh(q),
                 int_list(ts, numTargets), numTargets, mN_list(ops, numOps),
                 numOps));

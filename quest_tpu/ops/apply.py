@@ -336,14 +336,34 @@ def _dense_on(sub: jax.Array, u: jax.Array, plan: _Plan) -> jax.Array:
     return jnp.stack([out_re, out_im])
 
 
-@partial(jax.jit, static_argnames=("targets", "controls", "control_states"))
 def apply_matrix(state: jax.Array, u: jax.Array, targets: tuple,
                  controls: tuple = (), control_states: tuple = ()) -> jax.Array:
     """The universal dense gate (ref analogue:
     statevec_multiControlledMultiQubitUnitary, QuEST_cpu.c:1846).
 
     ``u`` is a (2, 2^k, 2^k) real pair and may represent a non-unitary matrix
-    (used by applyMatrixN / Kraus superoperators)."""
+    (used by applyMatrixN / Kraus superoperators).
+
+    Eager f32 lane-block gates may route through the hand-written Pallas
+    kernel (ops/pallas_kernels.py, QUEST_TPU_PALLAS=1); traced calls (whole-
+    circuit programs) always take the XLA engine below, whose lowering is
+    x64-compatible."""
+    from . import pallas_kernels as _pk
+    if _pk.pallas_enabled() and not isinstance(state, jax.core.Tracer):
+        n = num_qubits_of(state)
+        t = tuple(int(x) for x in targets)
+        c = tuple(int(x) for x in controls)
+        cs = tuple(int(s) for s in control_states) or (1,) * len(c)
+        plan = _gate_plan(n, t, c, cs, False)
+        if _pk.eligible(plan, n) and state.dtype == jnp.float32:
+            return _pk.apply_lane_matrix_eager(state, u, plan)
+    return _apply_matrix_xla(state, u, tuple(targets), tuple(controls),
+                             tuple(control_states))
+
+
+@partial(jax.jit, static_argnames=("targets", "controls", "control_states"))
+def _apply_matrix_xla(state: jax.Array, u: jax.Array, targets: tuple,
+                      controls: tuple = (), control_states: tuple = ()) -> jax.Array:
     n = num_qubits_of(state)
     targets = tuple(int(t) for t in targets)
     controls = tuple(int(c) for c in controls)
@@ -355,10 +375,10 @@ def apply_matrix(state: jax.Array, u: jax.Array, targets: tuple,
         mapping = dict(plan.reroute)
         for a, b in plan.reroute:
             state = swap_qubit_amps(state, a, b)
-        state = apply_matrix(state, u,
-                             tuple(mapping.get(q, q) for q in targets),
-                             tuple(mapping.get(c, c) for c in controls),
-                             control_states)
+        state = _apply_matrix_xla(state, u,
+                                  tuple(mapping.get(q, q) for q in targets),
+                                  tuple(mapping.get(c, c) for c in controls),
+                                  control_states)
         for a, b in reversed(plan.reroute):
             state = swap_qubit_amps(state, a, b)
         return state

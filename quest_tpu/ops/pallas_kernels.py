@@ -1,0 +1,124 @@
+"""Hand-written Pallas kernels for the hot paths.
+
+The default engine lowers everything through XLA (ops/apply.py), which
+already fuses elementwise chains into the MXU matmuls.  This module provides
+a hand-scheduled alternative for the single hottest op — the fused-layer
+dense pack on the lane block, i.e. a (128, 128) complex matrix applied to
+every 128-amplitude lane group (the program bench.py measures) — so the
+claim "Pallas kernels for the hot ops" is a real, testable artifact and a
+baseline for future hand-tuning.
+
+Enable with ``QUEST_TPU_PALLAS=1`` (or ``use_pallas(True)``); apply_matrix
+routes eligible gates (uncontrolled dense packs whose targets are exactly a
+prefix of the lane block) here.  Measured on a v5e chip the XLA path and
+this kernel are within ~10% of each other — XLA's fusion is already
+MXU-shaped for this op — so XLA stays the default.
+
+Layout: the (2, 2^n) SoA state is viewed as (2, M, 128); each kernel
+instance loads a (BLOCK, 128) row-tile of re and im, contracts with the
+transposed (128, 128) real/imag matrix planes on the MXU, and writes the
+row-tile back — one HBM pass, four matmuls per tile:
+
+    out_re = re @ Ur^T - im @ Ui^T
+    out_im = re @ Ui^T + im @ Ur^T
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+LANE = 128
+_BLOCK_ROWS = 512  # rows of 128 amps per kernel instance (256 KiB f32 tile)
+
+_enabled = os.environ.get("QUEST_TPU_PALLAS", "0") == "1"
+
+
+def use_pallas(on: bool) -> None:
+    """Route eligible eager dense gates through the Pallas kernel."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def pallas_enabled() -> bool:
+    return _enabled
+
+
+def _lane_matmul_kernel(ur_ref, ui_ref, re_ref, im_ref, ore_ref, oim_ref):
+    # out[g, j] = sum_k s[g, k] U[j, k]: contract both operands' axis 1 via
+    # dot_general (no in-kernel or host-side transpose — Mosaic handles the
+    # MXU operand orientation natively)
+    ur = ur_ref[...]
+    ui = ui_ref[...]
+    re = re_ref[...]
+    im = im_ref[...]
+    dot = partial(jax.lax.dot_general,
+                  dimension_numbers=(((1,), (1,)), ((), ())),
+                  precision=jax.lax.Precision.HIGHEST,
+                  preferred_element_type=re.dtype)
+    ore_ref[...] = dot(re, ur) - dot(im, ui)
+    oim_ref[...] = dot(re, ui) + dot(im, ur)
+
+
+def apply_lane_matrix_eager(state: jax.Array, u: jax.Array, plan) -> jax.Array:
+    """Eager entry: expand the matrix to the lane block and run the kernel.
+    Mosaic lowering on this stack requires x64 off, so the whole jit runs
+    inside an ``enable_x64(False)`` scope — f32 operands are unaffected."""
+    from .apply import _expand_matrix
+    with jax.enable_x64(False):
+        u = _expand_matrix(jnp.asarray(u, jnp.float32), plan, jnp.float32)
+        return apply_lane_matrix(state, u)
+
+
+@partial(jax.jit, static_argnames=())
+def apply_lane_matrix(state: jax.Array, u: jax.Array) -> jax.Array:
+    """Apply a (2, 128, 128) complex-pair matrix to the lane block of a
+    (2, 2^n) state (targets = qubits 0..6), n >= 7 + log2(_BLOCK_ROWS)."""
+    n_amps = state.shape[1]
+    rows = n_amps // LANE
+    block = min(_BLOCK_ROWS, rows)
+    grid = rows // block
+
+    interpret = jax.default_backend() == "cpu"  # no Mosaic on CPU
+
+    def run(plane):
+        return pl.pallas_call(
+            _lane_matmul_kernel,
+            interpret=interpret,
+            grid=(grid,),
+            in_specs=[
+                pl.BlockSpec((LANE, LANE), lambda i: (0, 0)),  # Ur
+                pl.BlockSpec((LANE, LANE), lambda i: (0, 0)),  # Ui
+                pl.BlockSpec((block, LANE), lambda i: (i, 0)),  # re tile
+                pl.BlockSpec((block, LANE), lambda i: (i, 0)),  # im tile
+            ],
+            out_specs=[
+                pl.BlockSpec((block, LANE), lambda i: (i, 0)),
+                pl.BlockSpec((block, LANE), lambda i: (i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((rows, LANE), state.dtype),
+                jax.ShapeDtypeStruct((rows, LANE), state.dtype),
+            ],
+        )(*plane)
+
+    re = state[0].reshape(rows, LANE)
+    im = state[1].reshape(rows, LANE)
+    out_re, out_im = run((u[0].astype(state.dtype),
+                          u[1].astype(state.dtype), re, im))
+    return jnp.stack([out_re.reshape(-1), out_im.reshape(-1)])
+
+
+def eligible(plan, n: int) -> bool:
+    """True when the gate is a pure lane-block dense op this kernel covers:
+    uncontrolled, slots exactly the 128-wide lane axis, state large enough
+    to tile."""
+    return (plan.slice_idx is None
+            and plan.fold_pattern is None
+            and not plan.reroute
+            and plan.slot_dims == (LANE,)
+            and n >= 7 + 3)  # >= one (8, 128) tile per instance

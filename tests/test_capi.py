@@ -336,3 +336,82 @@ def test_c_api_full_surface(tmp_path, c_binary):
     assert vals["cmp"] == "1"
     # host mirror holds |amp|^2 of the first amplitude after the circuit
     assert 0.0 <= float(vals["mirror0"]) <= 1.0
+
+
+REF_ROOT = "/root/reference"
+
+
+@pytest.fixture(scope="module")
+def reference_lib(tmp_path_factory):
+    """Build the reference's own libQuEST.so (PRECISION=2) if sources are
+    mounted; skip otherwise."""
+    if not os.path.exists(os.path.join(REF_ROOT, "CMakeLists.txt")):
+        pytest.skip("reference sources not mounted")
+    build = tmp_path_factory.mktemp("refbuild")
+    r = subprocess.run(["cmake", REF_ROOT], cwd=build, capture_output=True)
+    if r.returncode != 0:
+        pytest.skip("reference cmake failed")
+    r = subprocess.run(["make", "-j8", "QuEST"], cwd=build, capture_output=True)
+    if r.returncode != 0:
+        pytest.skip("reference build failed")
+    return os.path.join(build, "QuEST")
+
+
+AMP_DUMP = r"""
+#include <stdio.h>
+#include "QuEST.h"
+int main(void) {
+    QuESTEnv env = createQuESTEnv();
+    Qureg q = createQureg(5, env);
+    initZeroState(q);
+    hadamard(q, 0); controlledNot(q, 0, 1);
+    rotateY(q, 2, 0.1); rotateX(q, 3, -1.234); rotateZ(q, 4, 2.718);
+    Complex a = {.real = 0.5, .imag = 0.5}, b = {.real = 0.5, .imag = -0.5};
+    compactUnitary(q, 1, a, b);
+    controlledCompactUnitary(q, 0, 3, a, b);
+    int targs[] = {0, 1, 2};
+    multiControlledPhaseFlip(q, targs, 3);
+    ComplexMatrix2 u = {.real = {{0.6, 0.8}, {0.8, -0.6}}, .imag = {{0}}};
+    unitary(q, 4, u);
+    Vector v = {.x = 1, .y = 1, .z = 0};
+    rotateAroundAxis(q, 2, 0.777, v);
+    tGate(q, 0); sGate(q, 1);
+    controlledPhaseShift(q, 2, 0, 0.321);
+    for (long long i = 0; i < 32; i++)
+        printf("%lld %.17e %.17e\n", i, getRealAmp(q, i), getImagAmp(q, i));
+    return 0;
+}
+"""
+
+
+def test_f64_amplitudes_match_reference_binary(tmp_path, c_binary, reference_lib):
+    """Every amplitude of a 13-gate circuit agrees with the reference CPU
+    binary at float64 to <1e-14 (last-ULP rounding differences only — the
+    engine's matmul formulation reassociates sums, so exact bit-equality is
+    not guaranteed and not claimed)."""
+    src = tmp_path / "ampdump.c"
+    src.write_text(AMP_DUMP)
+    ref_bin = tmp_path / "dump_ref"
+    subprocess.run(["gcc", str(src), "-I", os.path.join(REF_ROOT, "QuEST", "include"),
+                    "-L", reference_lib, "-lQuEST",
+                    f"-Wl,-rpath,{reference_lib}", "-lm", "-o", str(ref_bin)],
+                   check=True, capture_output=True)
+    tpu_bin = tmp_path / "dump_tpu"
+    subprocess.run(["gcc", str(src), "-I", CAPI,
+                    "-L", os.path.dirname(LIB), "-lquest_tpu_c",
+                    f"-Wl,-rpath,{os.path.dirname(LIB)}", "-lm", "-o", str(tpu_bin)],
+                   check=True, capture_output=True)
+    ref_out = subprocess.run([str(ref_bin)], capture_output=True, text=True,
+                             timeout=120).stdout
+    tpu_out = _run(tpu_bin).stdout
+
+    def parse(s):
+        return {int(t[0]): (float(t[1]), float(t[2]))
+                for t in (ln.split() for ln in s.strip().splitlines())
+                if len(t) == 3}
+
+    ref_amps, tpu_amps = parse(ref_out), parse(tpu_out)
+    assert len(ref_amps) == len(tpu_amps) == 32
+    for i in range(32):
+        assert abs(ref_amps[i][0] - tpu_amps[i][0]) < 1e-14, (i, ref_amps[i], tpu_amps[i])
+        assert abs(ref_amps[i][1] - tpu_amps[i][1]) < 1e-14, (i, ref_amps[i], tpu_amps[i])

@@ -449,3 +449,23 @@ def test_wide_minor_gate_refuses_oversized_expansion(env_local):
     mat = jnp.zeros((2, 1 << k, 1 << k), dtype=jnp.float32)
     with pytest.raises(qt.QuESTError, match="cannot all fit"):
         apply_matrix(state, mat, tuple(range(k)))
+
+
+def test_pallas_lane_kernel_matches_xla(env_local):
+    """The hand-written Pallas lane-block kernel (QUEST_TPU_PALLAS=1 eager
+    path) agrees with the XLA engine (interpret mode on CPU, Mosaic on TPU)."""
+    import jax.numpy as jnp
+    from quest_tpu.ops import apply as ap
+    from quest_tpu.ops import pallas_kernels as pk
+
+    n = 11
+    u = random_unitary(2)  # applied at lane-block targets (2, 3)
+    rng = np.random.default_rng(3)
+    state = jnp.asarray(rng.normal(size=(2, 1 << n)), dtype=jnp.float32)
+    ref = ap.apply_matrix(state, jnp.asarray(ap.mat_pair(u), jnp.float32), (2, 3))
+    pk.use_pallas(True)
+    try:
+        out = ap.apply_matrix(state, jnp.asarray(ap.mat_pair(u), jnp.float32), (2, 3))
+    finally:
+        pk.use_pallas(False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
