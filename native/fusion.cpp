@@ -89,13 +89,17 @@ std::vector<Gate> parse(const uint8_t* buf, int64_t len) {
         std::memcpy(&nc, p, 4); p += 4;
         std::memcpy(&pl, p, 8); p += 8;
         g.targets.resize(nt);
-        std::memcpy(g.targets.data(), p, 4 * nt); p += 4 * nt;
+        if (nt) std::memcpy(g.targets.data(), p, 4 * nt);
+        p += 4 * nt;
         g.controls.resize(nc);
-        std::memcpy(g.controls.data(), p, 4 * nc); p += 4 * nc;
+        if (nc) std::memcpy(g.controls.data(), p, 4 * nc);
+        p += 4 * nc;
         g.control_states.resize(nc);
-        std::memcpy(g.control_states.data(), p, 4 * nc); p += 4 * nc;
+        if (nc) std::memcpy(g.control_states.data(), p, 4 * nc);
+        p += 4 * nc;
         g.payload.resize(pl);
-        std::memcpy(g.payload.data(), p, 8 * pl); p += 8 * pl;
+        if (pl) std::memcpy(g.payload.data(), p, 8 * pl);
+        p += 8 * pl;
         gates.push_back(std::move(g));
     }
     return gates;
@@ -118,10 +122,14 @@ std::vector<uint8_t> serialise(const std::vector<Gate>& gates) {
         std::memcpy(p, &nt, 4); p += 4;
         std::memcpy(p, &nc, 4); p += 4;
         std::memcpy(p, &pl, 8); p += 8;
-        std::memcpy(p, g.targets.data(), 4 * nt); p += 4 * nt;
-        std::memcpy(p, g.controls.data(), 4 * nc); p += 4 * nc;
-        std::memcpy(p, g.control_states.data(), 4 * nc); p += 4 * nc;
-        std::memcpy(p, g.payload.data(), 8 * pl); p += 8 * pl;
+        if (nt) std::memcpy(p, g.targets.data(), 4 * nt);
+        p += 4 * nt;
+        if (nc) std::memcpy(p, g.controls.data(), 4 * nc);
+        p += 4 * nc;
+        if (nc) std::memcpy(p, g.control_states.data(), 4 * nc);
+        p += 4 * nc;
+        if (pl) std::memcpy(p, g.payload.data(), 8 * pl);
+        p += 8 * pl;
     }
     return out;
 }

@@ -1,0 +1,135 @@
+// Standalone self-test for the gate-fusion engine, built with
+// -fsanitize=address,undefined in CI (the native analogue of the
+// reference's QUEST_MEMCHECK clang-ASan build, ref:
+// QuEST/CMakeLists.txt:347-360, .github/workflows/llvm-asan.yml).
+//
+// Exercises the full C ABI surface — parse, peephole passes, kron packing,
+// serialise, free — on handcrafted streams including adversarial ones
+// (truncated buffers, zero gates, wide diagonals), so leaks and
+// out-of-bounds accesses in the optimizer surface here rather than under
+// the Python runtime.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+uint8_t* quest_fuse_circuit(const uint8_t* buf, int64_t len, int64_t* out_len,
+                            int32_t max_pack);
+void quest_free_buffer(uint8_t* buf);
+int64_t quest_fusion_abi_version();
+}
+
+namespace {
+
+struct GateSpec {
+    int32_t kind;
+    std::vector<int32_t> targets;
+    std::vector<int32_t> controls;
+    std::vector<double> payload;
+};
+
+std::vector<uint8_t> pack(const std::vector<GateSpec>& gates) {
+    std::vector<uint8_t> out;
+    auto put = [&](const void* p, size_t n) {
+        const uint8_t* b = static_cast<const uint8_t*>(p);
+        out.insert(out.end(), b, b + n);
+    };
+    int64_t n = static_cast<int64_t>(gates.size());
+    put(&n, 8);
+    for (const GateSpec& g : gates) {
+        int32_t nt = static_cast<int32_t>(g.targets.size());
+        int32_t nc = static_cast<int32_t>(g.controls.size());
+        int64_t pl = static_cast<int64_t>(g.payload.size());
+        put(&g.kind, 4);
+        put(&nt, 4);
+        put(&nc, 4);
+        put(&pl, 8);
+        put(g.targets.data(), 4 * nt);
+        put(g.controls.data(), 4 * nc);
+        std::vector<int32_t> states(nc, 1);
+        put(states.data(), 4 * nc);
+        put(g.payload.data(), 8 * pl);
+    }
+    return out;
+}
+
+int64_t count_gates(const uint8_t* buf) {
+    int64_t n;
+    std::memcpy(&n, buf, 8);
+    return n;
+}
+
+GateSpec h(int q) {
+    double s = 0.70710678118654752;
+    return {0, {q}, {}, {s, s, s, -s, 0, 0, 0, 0}};
+}
+
+GateSpec x(int q) { return {2, {q}, {}, {}}; }
+GateSpec z(int q) { return {1, {q}, {}, {1, -1, 0, 0}}; }
+GateSpec cz(int c, int q) { return {1, {q}, {c}, {1, -1, 0, 0}}; }
+GateSpec swap_g(int a, int b) { return {5, {a, b}, {}, {}}; }
+
+int check(const char* name, const std::vector<GateSpec>& in, int32_t max_pack,
+          int64_t want_gates) {
+    std::vector<uint8_t> buf = pack(in);
+    int64_t out_len = 0;
+    uint8_t* out = quest_fuse_circuit(buf.data(),
+                                      static_cast<int64_t>(buf.size()),
+                                      &out_len, max_pack);
+    int64_t got = count_gates(out);
+    quest_free_buffer(out);
+    if (want_gates >= 0 && got != want_gates) {
+        std::printf("FAIL %s: %lld gates, want %lld\n", name,
+                    static_cast<long long>(got),
+                    static_cast<long long>(want_gates));
+        return 1;
+    }
+    std::printf("ok %s (%lld gates)\n", name, static_cast<long long>(got));
+    return 0;
+}
+
+}  // namespace
+
+int main() {
+    int fails = 0;
+    if (quest_fusion_abi_version() != 3) {
+        std::printf("FAIL abi version\n");
+        return 1;
+    }
+    fails += check("empty", {}, 7, 0);
+    fails += check("hh-cancel", {h(0), h(0)}, 1, 0);
+    fails += check("xx-cancel", {x(0), x(0)}, 1, 0);
+    fails += check("swap-swap-cancel", {swap_g(0, 1), swap_g(0, 1)}, 1, 0);
+    fails += check("zz-merge", {z(0), z(0)}, 1, 0);  // z*z = identity
+    fails += check("pack-layer", {h(0), h(1), h(2), h(3)}, 7, 1);
+    fails += check("pack-with-diag", {x(0), h(1), z(2), z(3)}, 7, 1);
+    fails += check("cz-absorb", {h(0), h(1), cz(0, 1)}, 7, 1);
+    fails += check("ctrl-blocks-pack", {h(0), cz(1, 2), h(3)}, 2, 2);
+    // wide diagonal: 16-qubit controlled phase absorbs controls (kDiagCap)
+    {
+        std::vector<GateSpec> wide;
+        GateSpec g = z(0);
+        for (int c = 1; c < 16; c++) g.controls.push_back(c);
+        wide.push_back(g);
+        fails += check("wide-ctrl-diag", wide, 7, 1);
+    }
+    // 200-gate random-ish stream: stresses repeated passes + reallocation
+    {
+        std::vector<GateSpec> big;
+        for (int i = 0; i < 200; i++) {
+            int q = (i * 7) % 10;
+            if (i % 3 == 0) big.push_back(h(q));
+            else if (i % 3 == 1) big.push_back(z(q));
+            else big.push_back(cz(q, (q + 1) % 10));
+        }
+        fails += check("large-stream", big, 7, -1);
+    }
+    if (fails) {
+        std::printf("%d failures\n", fails);
+        return 1;
+    }
+    std::printf("all fusion self-tests passed\n");
+    return 0;
+}

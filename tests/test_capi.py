@@ -415,3 +415,54 @@ def test_f64_amplitudes_match_reference_binary(tmp_path, c_binary, reference_lib
     for i in range(32):
         assert abs(ref_amps[i][0] - tpu_amps[i][0]) < 1e-14, (i, ref_amps[i], tpu_amps[i])
         assert abs(ref_amps[i][1] - tpu_amps[i][1]) < 1e-14, (i, ref_amps[i], tpu_amps[i])
+
+
+REF_TESTS = "/root/reference/tests"
+
+
+@pytest.fixture(scope="module")
+def catch2_binary(tmp_path_factory, c_binary):
+    """Compile the reference's own Catch2 test suite UNCHANGED against the
+    shim (the SURVEY §7 north star)."""
+    if not os.path.exists(os.path.join(REF_TESTS, "main.cpp")):
+        pytest.skip("reference tests not mounted")
+    d = tmp_path_factory.mktemp("catch2")
+    objs = []
+    for f in ["main", "utilities", "test_gates", "test_state_initialisations"]:
+        obj = d / f"{f}.o"
+        r = subprocess.run(
+            ["g++", "-std=c++14", "-DCATCH_CONFIG_NO_POSIX_SIGNALS", "-c",
+             os.path.join(REF_TESTS, f"{f}.cpp"), "-I", CAPI,
+             "-I", REF_TESTS, "-I", os.path.join(REF_TESTS, "catch"),
+             "-o", str(obj)], capture_output=True, text=True)
+        assert r.returncode == 0, (f, r.stderr[-400:])
+        objs.append(str(obj))
+    binary = d / "quest_tests"
+    subprocess.run(["g++"] + objs + ["-L", os.path.dirname(LIB),
+                    "-lquest_tpu_c", f"-Wl,-rpath,{os.path.dirname(LIB)}",
+                    "-o", str(binary)], check=True, capture_output=True)
+    return binary
+
+
+def test_reference_catch2_gates_tag(catch2_binary):
+    """The reference's [gates] Catch2 cases (measure, measureWithStats,
+    collapseToOutcome — 1000+ assertions) pass against the TPU runtime."""
+    env = dict(os.environ)
+    env.update(RUN_ENV)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([str(catch2_binary), "[gates]"], capture_output=True,
+                       text=True, env=env, timeout=580)
+    assert r.returncode == 0, r.stdout[-800:]
+    assert "All tests passed" in r.stdout
+
+
+def test_reference_catch2_state_init_tag(catch2_binary):
+    """The reference's [state_initialisations] Catch2 cases pass against the
+    TPU runtime."""
+    env = dict(os.environ)
+    env.update(RUN_ENV)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([str(catch2_binary), "[state_initialisations]"],
+                       capture_output=True, text=True, env=env, timeout=580)
+    assert r.returncode == 0, r.stdout[-800:]
+    assert "All tests passed" in r.stdout

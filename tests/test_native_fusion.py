@@ -2,6 +2,8 @@
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 import pytest
 
@@ -145,3 +147,21 @@ def test_pack_diag_densify_after_break(env_local):
     # densify into the h(0) pack it commuted past -> cnot + dense{0,2}
     opt = _equiv(env_local, c, max_pack=2)
     assert len(opt) == 2
+
+
+def test_fusion_selftest_binary(tmp_path):
+    """Build and run the native fusion self-test (CI additionally runs it
+    under ASan/UBSan — the reference's QUEST_MEMCHECK analogue)."""
+    import shutil
+    import subprocess
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ compiler")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    binary = tmp_path / "fusion_selftest"
+    subprocess.run(["g++", "-O2", "-std=c++17",
+                    os.path.join(root, "native", "fusion.cpp"),
+                    os.path.join(root, "native", "fusion_selftest.cpp"),
+                    "-o", str(binary)], check=True, capture_output=True)
+    r = subprocess.run([str(binary)], capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout[-500:]
+    assert "all fusion self-tests passed" in r.stdout
