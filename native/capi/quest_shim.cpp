@@ -918,6 +918,16 @@ void mixTwoQubitKrausMap(Qureg q, int t1, int t2, ComplexMatrix4* ops, int numOp
 void mixMultiQubitKrausMap(Qureg q, int* ts, int numTargets,
                            ComplexMatrixN* ops, int numOps) {
     if (!fits_ok(q, 2 * numTargets, "mixMultiQubitKrausMap")) return;
+    // every operator must be a created matrix BEFORE any is converted: the
+    // reference's validation tests pass arrays where one op has NULL arrays
+    // and the rest hold uninitialized garbage pointers
+    if (ops && numOps > 0) {
+        for (int i = 0; i < numOps; i++)
+            if (!ops[i].real || !ops[i].imag) {
+                invalidQuESTInputError(kMatrixNotInit, "mixMultiQubitKrausMap");
+                return;
+            }
+    }
     drop(pycall("mixMultiQubitKrausMap", "(NNiNi)", qh(q),
                 int_list(ts, numTargets), numTargets, mN_list(ops, numOps),
                 numOps));
