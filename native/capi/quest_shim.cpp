@@ -921,13 +921,9 @@ void mixMultiQubitKrausMap(Qureg q, int* ts, int numTargets,
     // every operator must be a created matrix BEFORE any is converted: the
     // reference's validation tests pass arrays where one op has NULL arrays
     // and the rest hold uninitialized garbage pointers
-    if (ops && numOps > 0) {
+    if (ops)
         for (int i = 0; i < numOps; i++)
-            if (!ops[i].real || !ops[i].imag) {
-                invalidQuESTInputError(kMatrixNotInit, "mixMultiQubitKrausMap");
-                return;
-            }
-    }
+            if (!matrixN_ok(ops[i], "mixMultiQubitKrausMap")) return;
     drop(pycall("mixMultiQubitKrausMap", "(NNiNi)", qh(q),
                 int_list(ts, numTargets), numTargets, mN_list(ops, numOps),
                 numOps));
