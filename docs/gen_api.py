@@ -1,0 +1,98 @@
+"""Regenerate docs/API.md from the live quest_tpu module surface.
+
+Usage: python docs/gen_api.py  (from the repo root)
+"""
+import inspect
+import os
+
+import jax
+
+jax.config.update('jax_platforms', 'cpu')
+import quest_tpu as qt  # noqa: E402
+
+GROUPS = [
+    ("Environment", ["createQuESTEnv", "destroyQuESTEnv", "syncQuESTEnv",
+                     "syncQuESTSuccess", "reportQuESTEnv", "getEnvironmentString",
+                     "seedQuEST", "seedQuESTDefault"]),
+    ("Registers", ["createQureg", "createDensityQureg", "createCloneQureg",
+                   "destroyQureg", "cloneQureg", "getNumQubits", "getNumAmps",
+                   "reportQuregParams", "reportState", "reportStateToScreen",
+                   "copyStateToGPU", "copyStateFromGPU"]),
+    ("Data structures", ["createComplexMatrixN", "destroyComplexMatrixN",
+                         "createPauliHamil", "destroyPauliHamil",
+                         "createPauliHamilFromFile", "initPauliHamil",
+                         "reportPauliHamil", "createDiagonalOp",
+                         "destroyDiagonalOp", "syncDiagonalOp", "initDiagonalOp",
+                         "setDiagonalOpElems", "fromComplex", "toComplex",
+                         "getStaticComplexMatrixN"]),
+    ("State initialisation", ["initBlankState", "initZeroState", "initPlusState",
+                              "initClassicalState", "initPureState",
+                              "initDebugState", "initStateFromAmps", "setAmps",
+                              "setWeightedQureg"]),
+    ("Unitaries", ["phaseShift", "controlledPhaseShift", "multiControlledPhaseShift",
+                   "controlledPhaseFlip", "multiControlledPhaseFlip", "sGate", "tGate",
+                   "unitary", "compactUnitary", "rotateX", "rotateY", "rotateZ",
+                   "rotateAroundAxis", "controlledRotateX", "controlledRotateY",
+                   "controlledRotateZ", "controlledRotateAroundAxis",
+                   "controlledCompactUnitary", "controlledUnitary",
+                   "multiControlledUnitary", "multiStateControlledUnitary",
+                   "pauliX", "pauliY", "pauliZ", "hadamard", "controlledNot",
+                   "controlledPauliY", "swapGate", "sqrtSwapGate", "multiRotateZ",
+                   "multiRotatePauli", "twoQubitUnitary", "controlledTwoQubitUnitary",
+                   "multiControlledTwoQubitUnitary", "multiQubitUnitary",
+                   "controlledMultiQubitUnitary", "multiControlledMultiQubitUnitary"]),
+    ("Operators", ["applyMatrix2", "applyMatrix4", "applyMatrixN",
+                   "applyMultiControlledMatrixN", "applyPauliSum", "applyPauliHamil",
+                   "applyTrotterCircuit", "applyDiagonalOp"]),
+    ("Decoherence", ["mixDephasing", "mixTwoQubitDephasing", "mixDepolarising",
+                     "mixTwoQubitDepolarising", "mixDamping", "mixPauli",
+                     "mixDensityMatrix", "mixKrausMap", "mixTwoQubitKrausMap",
+                     "mixMultiQubitKrausMap"]),
+    ("Measurement & calculations", ["measure", "measureWithStats", "collapseToOutcome",
+                   "calcProbOfOutcome", "calcTotalProb", "getAmp", "getRealAmp",
+                   "getImagAmp", "getProbAmp", "getDensityAmp", "calcInnerProduct",
+                   "calcDensityInnerProduct", "calcPurity", "calcFidelity",
+                   "calcHilbertSchmidtDistance", "calcExpecPauliProd",
+                   "calcExpecPauliSum", "calcExpecPauliHamil", "calcExpecDiagonalOp"]),
+    ("QASM logging", ["startRecordingQASM", "stopRecordingQASM", "clearRecordedQASM",
+                      "printRecordedQASM", "writeRecordedQASMToFile"]),
+    ("Debug API", ["initStateDebug", "initStateOfSingleQubit",
+                   "initStateFromSingleFile", "setDensityAmps", "compareStates",
+                   "QuESTPrecision"]),
+    ("TPU-native extensions", ["set_precision", "get_precision", "Circuit",
+                               "compile_circuit", "apply_circuit", "random_circuit",
+                               "qft_circuit"]),
+]
+
+
+def main() -> None:
+    lines = ["# quest-tpu API reference",
+             "",
+             "The complete public surface, mirroring QuEST v3.2's nine documentation",
+             "groups (ref: QuEST.h) plus the TPU-native extensions. Generated from the",
+             "live module (`python docs/gen_api.py`); every function is importable as",
+             "`quest_tpu.<name>` and, for the QuEST groups, callable from C through",
+             "`native/capi/quest_tpu_c.h` with the reference's exact signatures.", ""]
+    count = 0
+    for title, names in GROUPS:
+        lines.append(f"## {title}")
+        lines.append("")
+        for n in names:
+            fn = getattr(qt, n)
+            try:
+                sig = str(inspect.signature(fn))
+            except (TypeError, ValueError):
+                sig = ""
+            doc = (inspect.getdoc(fn) or "").split("\n")[0]
+            lines.append(f"- **`{n}{sig}`**" + (f" — {doc}" if doc else ""))
+            count += 1
+        lines.append("")
+    lines.append(f"*{count} public functions/classes documented.*")
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "API.md")
+    with open(out, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"wrote {out} with {count} entries")
+
+
+if __name__ == "__main__":
+    main()
