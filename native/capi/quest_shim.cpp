@@ -239,16 +239,19 @@ PyObject* m2(ComplexMatrix2 u) { return mat_obj_dim(u, 2); }
 PyObject* m4(ComplexMatrix4 u) { return mat_obj_dim(u, 4); }
 
 PyObject* mN(ComplexMatrixN u) {
-    int dim = 1 << u.numQubits;
-    PyObject* rows = PyList_New(dim);
-    for (int r = 0; r < dim; r++) {
-        PyObject* row = PyList_New(dim);
-        for (int c = 0; c < dim; c++)
-            PyList_SET_ITEM(row, c, PyComplex_FromDoubles(u.real[r][c],
-                                                          u.imag[r][c]));
-        PyList_SET_ITEM(rows, r, row);
-    }
-    return rows;
+    // pack both planes into one bytes object and rebuild numpy-side:
+    // O(1) Python objects per matrix (a 2^10-wide Kraus superoperator would
+    // otherwise cost ~2M element objects)
+    int64_t dim = 1LL << u.numQubits;
+    PyObject* bytes = PyBytes_FromStringAndSize(
+        nullptr, (Py_ssize_t)(2 * dim * dim * 8));
+    if (!bytes) return nullptr;
+    char* p = PyBytes_AS_STRING(bytes);
+    for (int64_t r = 0; r < dim; r++)
+        std::memcpy(p + r * dim * 8, u.real[r], dim * 8);
+    for (int64_t r = 0; r < dim; r++)
+        std::memcpy(p + (dim + r) * dim * 8, u.imag[r], dim * 8);
+    return pycall("_matrix_from_buffer", "(iN)", u.numQubits, bytes);
 }
 
 PyObject* m2_list(const ComplexMatrix2* ops, int n) {

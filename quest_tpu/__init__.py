@@ -16,7 +16,8 @@ from .precision import set_precision, get_precision, real_eps  # noqa: F401  (co
 from .api import *  # noqa: F401,F403
 from .api import __all__ as _api_all
 from .api import (_amps_buffer, _hamil_buffers,  # C-shim helpers  # noqa: F401
-                  _validate_create_qureg, _validate_create_diag)
+                  _validate_create_qureg, _validate_create_diag,
+                  _matrix_from_buffer)
 from .circuit import (Circuit, compile_circuit, apply_circuit,  # noqa: F401
                       random_circuit, qft_circuit)
 

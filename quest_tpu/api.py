@@ -1455,6 +1455,15 @@ def _validate_create_diag(num_qubits: int, num_ranks: int) -> None:
         V._throw(V.ErrorCode.DISTRIB_DIAG_OP_TOO_SMALL, "createDiagonalOp")
 
 
+def _matrix_from_buffer(num_qubits: int, buf: bytes) -> np.ndarray:
+    """C-shim helper: rebuild a complex matrix from the shim's packed
+    (re-plane, im-plane) float64 buffer — O(1) Python objects per matrix
+    instead of one per element."""
+    dim = 1 << int(num_qubits)
+    arr = np.frombuffer(buf, dtype=np.float64).reshape(2, dim, dim)
+    return arr[0] + 1j * arr[1]
+
+
 def _hamil_buffers(hamil: PauliHamil):
     """C-shim helper: (flat int32 codes, float64 coeffs) contiguous arrays."""
     codes = np.ascontiguousarray(np.asarray(hamil.pauli_codes, dtype=np.int32).ravel())
