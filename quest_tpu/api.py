@@ -595,7 +595,7 @@ def multiControlledPhaseShift(qureg: Qureg, qubits, num_qubits=None, angle=None)
     else:
         qubits = _ts(qubits)[:int(num_qubits)]
     qubits = _ts(qubits)
-    V.validate_multi_targets(qureg, qubits, "multiControlledPhaseShift")
+    V.validate_multi_qubits(qureg, qubits, "multiControlledPhaseShift")
     _apply_diag(qureg, np.array([1, np.exp(1j * float(angle))], dtype=np.complex128),
                 (qubits[-1],), tuple(qubits[:-1]))
     qureg.qasm.record_gate("phase_shift", tuple(qubits[:-1]), int(qubits[-1]),
@@ -612,7 +612,7 @@ def multiControlledPhaseFlip(qureg: Qureg, qubits, num_qubits=None) -> None:
     if num_qubits is not None:
         qubits = _ts(qubits)[:int(num_qubits)]
     qubits = _ts(qubits)
-    V.validate_multi_targets(qureg, qubits, "multiControlledPhaseFlip")
+    V.validate_multi_qubits(qureg, qubits, "multiControlledPhaseFlip")
     _apply_diag(qureg, np.array([1, -1], dtype=np.complex128),
                 (qubits[-1],), tuple(qubits[:-1]))
     qureg.qasm.record_gate("sigma_z", tuple(qubits[:-1]), int(qubits[-1]))

@@ -235,6 +235,19 @@ def validate_multi_targets(qureg, targets, func=None):
         _throw(ErrorCode.TARGETS_NOT_UNIQUE, func)
 
 
+def validate_multi_qubits(qureg, qubits, func=None):
+    """Plain qubit-group guard (ref: validateMultiQubits — used by the
+    multi-controlled phase gates, whose wires are all peers): plain-qubit
+    error texts, not the target-flavoured ones."""
+    if len(qubits) < 1 or len(qubits) > qureg.num_qubits_represented:
+        _throw(ErrorCode.INVALID_NUM_QUBITS, func)
+    for q in qubits:
+        if not (0 <= int(q) < qureg.num_qubits_represented):
+            _throw(ErrorCode.INVALID_QUBIT_INDEX, func)
+    if len(set(int(q) for q in qubits)) != len(qubits):
+        _throw(ErrorCode.QUBITS_NOT_UNIQUE, func)
+
+
 def validate_multi_controls(qureg, controls, func=None):
     validate_num_controls(qureg, len(controls), func)
     for c in controls:
