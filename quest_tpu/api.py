@@ -697,12 +697,13 @@ def _multi_rotate_pauli_statevec(amps, targets, paulis, angle, apply_conj: bool)
             amps = _ap.apply_matrix(amps, ry, (t,))
         elif p == PauliOpType.PAULI_Y:
             amps = _ap.apply_matrix(amps, rx, (t,))
-    # always applied, even with an empty mask: an all-identity Pauli string
-    # still imparts the global phase e^{-i angle/2} (the reference calls
-    # multiRotateZ with mask 0, QuEST_common.c:444 — every amplitude has even
-    # parity and gets the same factor)
-    a = -angle if apply_conj else angle
-    amps = _ap.apply_multi_rotate_z(amps, jnp.float64(a), tuple(mask_targets))
+    # all-identity Pauli strings apply NOTHING — the reference explicitly
+    # skips the rotation when the mask is empty ("does nothing if there are
+    # no qubits to 'rotate'", QuEST_common.c:436-437), deliberately omitting
+    # the e^{-i angle/2} global phase, and its test suite requires that
+    if mask_targets:
+        a = -angle if apply_conj else angle
+        amps = _ap.apply_multi_rotate_z(amps, jnp.float64(a), tuple(mask_targets))
     ry_inv = _ap.mat_pair(_compact_matrix(fac, fac))
     rx_inv = _ap.mat_pair(_compact_matrix(fac, (-1j * fac) if apply_conj else (1j * fac)))
     for t, p in zip(targets, paulis):

@@ -326,12 +326,18 @@ def test_multiRotatePauli(env):
     cases += [((1, 3), codes) for codes in pauliseqs(2)]
     cases += [((1, 3, 4), (1, 2, 3)), ((0, 1, 2), (3, 3, 1)), ((0,), (2,))]
     for qs, codes in cases:
-        # exp(-i theta/2 sigma_1 x .. x sigma_k), with codes[j] acting on qs[j]
-        op = np.array([[1.0]], dtype=complex)
-        for c in reversed(codes):  # qs[0] = least significant row bit
-            op = np.kron(op, paulis[c])
-        u = (np.cos(theta / 2) * np.eye(1 << len(qs))
-             - 1j * np.sin(theta / 2) * op)
+        # exp(-i theta/2 sigma_1 x .. x sigma_k), with codes[j] acting on
+        # qs[j]; an ALL-identity string applies nothing (the reference skips
+        # the empty rotation mask, omitting the global phase —
+        # QuEST_common.c:436-437)
+        if all(c == 0 for c in codes):
+            u = np.eye(1 << len(qs), dtype=complex)
+        else:
+            op = np.array([[1.0]], dtype=complex)
+            for c in reversed(codes):  # qs[0] = least significant row bit
+                op = np.kron(op, paulis[c])
+            u = (np.cos(theta / 2) * np.eye(1 << len(qs))
+                 - 1j * np.sin(theta / 2) * op)
         _check(env,
                lambda q, qs=qs, cs=codes: qt.multiRotatePauli(q, list(qs), list(cs),
                                                               len(qs), theta),
