@@ -14,6 +14,7 @@ pure jitted jnp programs over (possibly sharded) amplitude arrays.
 from __future__ import annotations
 
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -118,7 +119,24 @@ def _shift(ts: tuple, n: int) -> tuple:
     return tuple(t + n for t in ts)
 
 
+# Opt-in cache-pressure valve for workloads that compile an unbounded stream
+# of distinct gate arrangements (e.g. the reference's generator-driven Catch2
+# suite: thousands of unique (targets, controls, states) programs exhaust the
+# process mmap budget long before RAM). Every N dispatched ops, drop all
+# compiled programs; they recompile on demand.
+_CLEAR_EVERY = int(os.environ.get("QUEST_TPU_CLEAR_CACHES_EVERY", "0"))
+_op_count = [0]
+
+
+def _maybe_clear_caches() -> None:
+    if _CLEAR_EVERY:
+        _op_count[0] += 1
+        if _op_count[0] % _CLEAR_EVERY == 0:
+            jax.clear_caches()
+
+
 def _apply_unitary(qureg: Qureg, u, targets, controls=(), control_states=()):
+    _maybe_clear_caches()
     """Gate + conjugated shadow on the column side for density matrices
     (ref: QuEST.c:8-10).  ``u`` is a complex host matrix; the op layer takes
     (2, d, d) real pairs."""
@@ -138,6 +156,7 @@ def _diag_pair(diag) -> np.ndarray:
 
 
 def _apply_diag(qureg: Qureg, diag, targets, controls=(), control_states=()):
+    _maybe_clear_caches()
     dp = _diag_pair(diag)
     amps = _ap.apply_diagonal(qureg.amps, dp, targets, controls, control_states)
     if qureg.is_density_matrix:
