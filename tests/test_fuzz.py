@@ -1,0 +1,190 @@
+"""Randomized differential fuzzing: long random API-call sequences checked
+against the dense-numpy oracle after every step.
+
+Neither the reference suite nor the per-op tests exercise cross-op
+interactions (a Kraus channel after a collapse after a packed unitary…);
+seeded random walks over the full op set do.  Any divergence >tolerance
+fails with the seed and step for exact reproduction.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from oracle import (DM_TOL, SV_TOL, apply_channel, apply_to_dm, apply_to_sv,
+                    dm, phase_shift, random_kraus_map, random_unitary, rot, sv)
+
+N = 5
+STEPS = 40
+SEEDS = range(4)
+
+
+def _random_op(rng, kmax=3):
+    """Draw one op as (apply_fn, (targets, matrix, controls)) or a collapse
+    marker.  ``kmax`` caps dense-gate width to the per-shard limit (the
+    reference's fits-in-node rule)."""
+    kinds = ["h", "x", "y", "z", "s", "t", "rx", "ry", "rz", "rot_axis",
+             "phase", "cnot", "cz", "cphase", "swap", "sqrt_swap", "unitary1",
+             "mcu", "multi_rotate_z", "collapse"]
+    if kmax >= 2:
+        kinds += ["unitary2"]
+    if kmax >= 3:
+        kinds += ["multi3"]
+    kind = rng.choice(kinds)
+    q = int(rng.integers(N))
+    q2 = int(rng.choice([x for x in range(N) if x != q]))
+    angle = float(rng.uniform(-math.pi, math.pi))
+
+    X = np.array([[0, 1], [1, 0]], dtype=complex)
+    Y = np.array([[0, -1j], [1j, 0]])
+    Z = np.diag([1, -1]).astype(complex)
+    H = np.array([[1, 1], [1, -1]]) / math.sqrt(2)
+
+    if kind == "h":
+        return lambda p: qt.hadamard(p, q), ([q], H, [])
+    if kind == "x":
+        return lambda p: qt.pauliX(p, q), ([q], X, [])
+    if kind == "y":
+        return lambda p: qt.pauliY(p, q), ([q], Y, [])
+    if kind == "z":
+        return lambda p: qt.pauliZ(p, q), ([q], Z, [])
+    if kind == "s":
+        return lambda p: qt.sGate(p, q), ([q], np.diag([1, 1j]), [])
+    if kind == "t":
+        return lambda p: qt.tGate(p, q), ([q], phase_shift(math.pi / 4), [])
+    if kind == "rx":
+        return lambda p: qt.rotateX(p, q, angle), ([q], rot([1, 0, 0], angle), [])
+    if kind == "ry":
+        return lambda p: qt.rotateY(p, q, angle), ([q], rot([0, 1, 0], angle), [])
+    if kind == "rz":
+        return lambda p: qt.rotateZ(p, q, angle), ([q], rot([0, 0, 1], angle), [])
+    if kind == "rot_axis":
+        ax = rng.normal(size=3)
+        return (lambda p: qt.rotateAroundAxis(p, q, angle, tuple(ax)),
+                ([q], rot(ax, angle), []))
+    if kind == "phase":
+        return (lambda p: qt.phaseShift(p, q, angle),
+                ([q], phase_shift(angle), []))
+    if kind == "cnot":
+        return lambda p: qt.controlledNot(p, q2, q), ([q], X, [q2])
+    if kind == "cz":
+        return lambda p: qt.controlledPhaseFlip(p, q2, q), ([q], Z, [q2])
+    if kind == "cphase":
+        return (lambda p: qt.controlledPhaseShift(p, q2, q, angle),
+                ([q], phase_shift(angle), [q2]))
+    if kind == "swap":
+        SW = np.eye(4)[[0, 2, 1, 3]].astype(complex)
+        return lambda p: qt.swapGate(p, q, q2), ([q, q2], SW, [])
+    if kind == "sqrt_swap":
+        SS = np.array([[1, 0, 0, 0],
+                       [0, (1 + 1j) / 2, (1 - 1j) / 2, 0],
+                       [0, (1 - 1j) / 2, (1 + 1j) / 2, 0],
+                       [0, 0, 0, 1]])
+        return lambda p: qt.sqrtSwapGate(p, q, q2), ([q, q2], SS, [])
+    if kind == "unitary1":
+        u = random_unitary(1)
+        return lambda p: qt.unitary(p, q, u), ([q], u, [])
+    if kind == "unitary2":
+        u = random_unitary(2)
+        return (lambda p: qt.twoQubitUnitary(p, q, q2, u), ([q, q2], u, []))
+    if kind == "multi3":
+        ts = list(rng.permutation(N)[:3])
+        ts = [int(t) for t in ts]
+        u = random_unitary(3)
+        return (lambda p: qt.multiQubitUnitary(p, ts, 3, u), (ts, u, []))
+    if kind == "mcu":
+        cs = [q2]
+        u = random_unitary(1)
+        return (lambda p: qt.multiControlledUnitary(p, cs, 1, q, u),
+                ([q], u, cs))
+    if kind == "multi_rotate_z":
+        ts = sorted(int(t) for t in rng.permutation(N)[:2])
+        d = np.array([np.exp(-1j * angle / 2 * (1 - 2 * (bin(i).count("1") % 2)))
+                      for i in range(4)])
+        return (lambda p: qt.multiRotateZ(p, ts, 2, angle),
+                (ts, np.diag(d), []))
+    if kind == "collapse":
+        return ("collapse", q), None
+    raise AssertionError(kind)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_fuzz_statevector(env, seed):
+    rng = np.random.default_rng(1000 + seed)
+    kmax = ((1 << N) // env.num_ranks).bit_length() - 1
+    psi = qt.createQureg(N, env)
+    qt.initPlusState(psi)
+    ref = np.full(1 << N, 1 / math.sqrt(1 << N), dtype=complex)
+    for step in range(STEPS):
+        op, oracle = _random_op(rng, kmax)
+        if oracle is None:  # collapse to the likelier outcome (never prob 0)
+            _, q = op
+            p1 = qt.calcProbOfOutcome(psi, q, 1)
+            outcome = 1 if p1 >= 0.5 else 0
+            qt.collapseToOutcome(psi, q, outcome)
+            mask = np.array([(i >> q) & 1 == outcome for i in range(1 << N)])
+            ref = np.where(mask, ref, 0)
+            ref = ref / np.linalg.norm(ref)
+        else:
+            op(psi)
+            ts, u, cs = oracle
+            ref = apply_to_sv(ref, N, ts, u, cs)
+        got = sv(psi)
+        assert np.abs(got - ref).max() < 10 * SV_TOL, \
+            f"seed {seed} diverged at step {step}"
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_fuzz_density_with_channels(env, seed):
+    rng = np.random.default_rng(2000 + seed)
+    kmax = ((1 << (2 * N)) // env.num_ranks).bit_length() - 1
+    rho_q = qt.createDensityQureg(N, env)
+    qt.initPlusState(rho_q)
+    ref = np.full((1 << N, 1 << N), 1.0 / (1 << N), dtype=complex)
+    for step in range(STEPS):
+        roll = rng.uniform()
+        if roll < 0.25:  # decoherence channel
+            q = int(rng.integers(N))
+            p = float(rng.uniform(0, 0.3))
+            which = rng.choice(["damp", "dephase", "depol", "kraus"])
+            if which == "damp":
+                qt.mixDamping(rho_q, q, p)
+                ks = [np.diag([1, math.sqrt(1 - p)]),
+                      np.sqrt(p) * np.array([[0, 1], [0, 0]])]
+            elif which == "dephase":
+                qt.mixDephasing(rho_q, q, p)
+                ks = [math.sqrt(1 - p) * np.eye(2),
+                      math.sqrt(p) * np.diag([1, -1])]
+            elif which == "depol":
+                qt.mixDepolarising(rho_q, q, p)
+                X = np.array([[0, 1], [1, 0]], dtype=complex)
+                Y = np.array([[0, -1j], [1j, 0]])
+                Z = np.diag([1, -1]).astype(complex)
+                ks = [math.sqrt(1 - p) * np.eye(2)] + \
+                     [math.sqrt(p / 3) * m for m in (X, Y, Z)]
+            else:
+                ks = random_kraus_map(1, 3)
+                qt.mixKrausMap(rho_q, q, ks, 3)
+            ref = apply_channel(ref, N, [q], ks)
+        else:
+            op, oracle = _random_op(rng, min(kmax, 3))
+            if oracle is None:
+                _, q = op
+                p1 = qt.calcProbOfOutcome(rho_q, q, 1)
+                outcome = 1 if p1 >= 0.5 else 0
+                prob = qt.collapseToOutcome(rho_q, q, outcome)
+                proj = np.diag([(1.0 if ((i >> q) & 1) == outcome else 0.0)
+                                for i in range(1 << N)])
+                ref = proj @ ref @ proj / prob
+            else:
+                op(rho_q)
+                ts, u, cs = oracle
+                ref = apply_to_dm(ref, N, ts, u, cs)
+        got = dm(rho_q)
+        assert np.abs(got - ref).max() < 10 * DM_TOL, \
+            f"seed {seed} diverged at step {step}"
+    assert qt.calcTotalProb(rho_q) == pytest.approx(1.0, abs=1e-6)
