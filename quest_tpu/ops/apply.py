@@ -405,6 +405,25 @@ def apply_diagonal(state: jax.Array, diag: jax.Array, targets: tuple,
     if not control_states:
         control_states = (1,) * len(controls)
     control_states = tuple(int(s) for s in control_states)
+    if controls and len(targets) + len(controls) <= 16:
+        # absorb ALL controls into the factor (entries are 1 where a control
+        # bit mismatches): the gate becomes a pure broadcast multiply with no
+        # control slice — in particular a control on a SHARDED qubit stays
+        # comm-free, where a slice-update would make GSPMD communicate
+        dr, di = diag[0], diag[1]
+        for st in control_states:  # each control becomes the next-higher bit
+            one = jnp.ones_like(dr)
+            zero = jnp.zeros_like(di)
+            if st:
+                dr = jnp.concatenate([one, dr])
+                di = jnp.concatenate([zero, di])
+            else:
+                dr = jnp.concatenate([dr, one])
+                di = jnp.concatenate([di, zero])
+        diag = jnp.stack([dr, di])
+        targets = targets + controls
+        controls = ()
+        control_states = ()
     plan = _gate_plan(n, targets, controls, control_states, True)
     d = _expand_diag(diag, plan, state.dtype)
     t = state.reshape((2,) + plan.dims)

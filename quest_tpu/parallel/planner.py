@@ -40,10 +40,13 @@ def comm_plan(circuit, num_devices: int, bytes_per_amp: int = 8) -> list:
     plans = []
     for i, op in enumerate(circuit.ops):
         if op.kind == "diagonal":
-            # diagonal gates never move data (ref: QuEST_cpu.c:2978-3109)
+            # diagonal gates never move data, controls included — the engine
+            # absorbs controls into the broadcast factor
+            # (ref: QuEST_cpu.c:2978-3109; ops/apply.py apply_diagonal)
             plans.append(GatePlan(i, op.kind, op.targets, True, "none", 0))
             continue
-        cross = [t for t in op.targets if not is_shard_local(t, n, num_devices)]
+        wires = tuple(op.targets) + tuple(op.controls)
+        cross = [t for t in wires if not is_shard_local(t, n, num_devices)]
         if not cross:
             plans.append(GatePlan(i, op.kind, op.targets, True, "none", 0))
         elif len(op.targets) == 1:

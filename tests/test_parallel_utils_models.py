@@ -11,6 +11,7 @@ from quest_tpu.models import (bernstein_vazirani_circuit, ghz_circuit,
                               trotter_circuit)
 from quest_tpu.parallel import (comm_plan, gather_full_state, global_sum,
                                 is_shard_local, pairwise_exchange)
+from oracle import SV_TOL  # noqa: E402
 from quest_tpu.utils import load_qureg, save_qureg
 from oracle import NUM_QUBITS, assert_sv, random_statevector, set_sv, sv
 
@@ -188,7 +189,7 @@ def test_sync_quest_env_blocks_env_quregs(env):
     q = qt.createQureg(5, env)
     qt.hadamard(q, 0)
     qt.syncQuESTEnv(env)  # must not raise; blocks this env's quregs only
-    assert qt.calcTotalProb(q) == pytest.approx(1.0, abs=1e-10)
+    assert qt.calcTotalProb(q) == pytest.approx(1.0, abs=10 * SV_TOL)
 
 
 def test_circuit_stats():
