@@ -51,6 +51,7 @@ the hot MXU matmuls never communicate.
 from __future__ import annotations
 
 import dataclasses
+import os
 from functools import lru_cache, partial
 
 import jax
@@ -310,11 +311,58 @@ def _cmul(ar, ai, br, bi):
     return ar * br - ai * bi, ar * bi + ai * br
 
 
+def _gauss_mode() -> str:
+    """Complex-product strategy: '3m' (Gauss 3-multiplication) or '4m'.
+
+    QUEST_TPU_GAUSS=1 forces 3m everywhere, =0 forces 4m; default 'auto'
+    uses 3m for f64 and 4m for f32, from on-chip A/B measurement (v5e,
+    24q random circuit): f64 is MXU-emulation-FLOP-bound, so dropping the
+    4th matmul wins 20-23% fused AND unfused; f32 fused packs are
+    HBM-bound and the 4m form fuses better (6.1e10 vs 5.0e10 amps/s —
+    3m's (re+im) temp costs an extra materialisation).  3m's ~2 extra
+    ulps of cancellation error still clears the measured <1e-14 f64
+    agreement with the reference library (tests/test_capi.py).
+
+    Read once at import (the value participates in traced programs, so a
+    mid-process change would silently not retrace already-compiled
+    signatures — set the variable before importing quest_tpu)."""
+    return _GAUSS_MODE
+
+
+_GAUSS_MODE = os.environ.get("QUEST_TPU_GAUSS", "auto")
+
+
+def _control_style() -> str:
+    """How prefix-qubit controls are applied: 'slice' (default) or 'select'.
+
+    'slice' updates the controlled half-slab through a static slice —
+    half the memory traffic per control, the right choice on a single
+    chip (measured f64 3-control: 9.2 ms vs 98 ms at 24q).  But when the
+    control axis is SHARDED, GSPMD lowers the slice-update as an exchange
+    (collective-permute + all-reduce — the reference, by contrast, just
+    skips non-matching amps locally, ref QuEST_cpu.c:2173).  'select'
+    applies the gate to the whole state and keeps it where every control
+    matches — an elementwise mask with ZERO collectives regardless of
+    sharding, at the cost of the full-state gate.  Set
+    QUEST_TPU_CONTROL_STYLE=select for multi-chip runs whose circuits
+    put controls on sharded qubits.  Read once at import (participates
+    in traced programs)."""
+    return _CONTROL_STYLE
+
+
+_CONTROL_STYLE = os.environ.get("QUEST_TPU_CONTROL_STYLE", "slice")
+
+
 def _dense_on(sub: jax.Array, u: jax.Array, plan: _Plan) -> jax.Array:
     """Contract the (2, D, D) expanded matrix against the slot axes of
     ``sub`` (leading re/im axis).  One integer-label einsum per real product
     — a single dot_general whose flattened contraction is up to 128 wide
-    (the MXU's native tile) with the lane axis minor."""
+    (the MXU's native tile) with the lane axis minor.
+
+    The complex product uses Gauss's 3-multiplication form at f64
+    (m1 = Ur·x, m2 = Ui·y, m3 = (Ur+Ui)·(x+y); out = (m1-m2, m3-m1-m2)),
+    where the emulated-f64 matmuls dominate; see :func:`_gauss_mode` for
+    the measured policy."""
     dims = plan.slot_dims
     ur = u[0].reshape(dims + dims)
     ui = u[1].reshape(dims + dims)
@@ -331,6 +379,12 @@ def _dense_on(sub: jax.Array, u: jax.Array, plan: _Plan) -> jax.Array:
         return jnp.einsum(mat, u_lab, s, s_lab, r_lab, precision=_PRECISION)
 
     re, im = sub[0], sub[1]
+    mode = _gauss_mode()
+    if mode == "1" or (mode != "0" and sub.dtype == jnp.float64):
+        m1 = mm(ur, re)
+        m2 = mm(ui, im)
+        m3 = mm(ur + ui, re + im)
+        return jnp.stack([m1 - m2, m3 - m1 - m2])
     out_re = mm(ur, re) - mm(ui, im)
     out_im = mm(ur, im) + mm(ui, re)
     return jnp.stack([out_re, out_im])
@@ -382,6 +436,24 @@ def _apply_matrix_xla(state: jax.Array, u: jax.Array, targets: tuple,
         for a, b in reversed(plan.reroute):
             state = swap_qubit_amps(state, a, b)
         return state
+    if plan.slice_idx is not None and _control_style() == "select":
+        # comm-free controlled form: gate the whole state, keep it only
+        # where every prefix control matches (see _control_style)
+        lo = sum(_blocks(n))
+        minor = [(c, st) for c, st in zip(controls, control_states) if c < lo]
+        gated = _apply_matrix_xla(state, u, targets,
+                                  tuple(c for c, _ in minor),
+                                  tuple(st for _, st in minor))
+        t = state.reshape((2,) + plan.dims)
+        g = gated.reshape((2,) + plan.dims)
+        cond = None
+        for axis, idx in enumerate(plan.slice_idx):
+            if isinstance(idx, int):
+                shape = [1] * t.ndim
+                shape[axis] = t.shape[axis]
+                bit = (jnp.arange(t.shape[axis]) == idx).reshape(shape)
+                cond = bit if cond is None else cond & bit
+        return jnp.where(cond, g, t).reshape(2, -1)
     u = _expand_matrix(u, plan, state.dtype)
     t = state.reshape((2,) + plan.dims)
     if plan.slice_idx is not None:
@@ -464,7 +536,8 @@ def apply_pauli_x(state: jax.Array, target: int,
         control_states = (1,) * len(controls)
     l, s = _blocks(n)
     lo = l + s
-    if target >= lo and all(c >= lo for c in controls):
+    if (target >= lo and all(c >= lo for c in controls)
+            and (not controls or _control_style() == "slice")):
         groups = tuple(sorted((q, 1) for q in {target, *controls}))
         dims, axis_of, _, _ = grouped_shape(n, groups)
         t = state.reshape((2,) + dims)

@@ -45,9 +45,22 @@ def comm_plan(circuit, num_devices: int, bytes_per_amp: int = 8) -> list:
             # (ref: QuEST_cpu.c:2978-3109; ops/apply.py apply_diagonal)
             plans.append(GatePlan(i, op.kind, op.targets, True, "none", 0))
             continue
-        wires = tuple(op.targets) + tuple(op.controls)
-        cross = [t for t in wires if not is_shard_local(t, n, num_devices)]
-        if not cross:
+        cross = [t for t in op.targets
+                 if not is_shard_local(t, n, num_devices)]
+        cross_c = [c for c in op.controls
+                   if not is_shard_local(c, n, num_devices)]
+        if not cross and cross_c:
+            # a prefix-control on a SHARDED axis: under the default slice
+            # style the slice-update makes GSPMD exchange (measured:
+            # collective-permute + all-reduce); the select style masks
+            # elementwise instead — zero collectives
+            from ..ops.apply import _control_style
+            if _control_style() == "select":
+                plans.append(GatePlan(i, op.kind, op.targets, True, "none", 0))
+            else:
+                plans.append(GatePlan(i, op.kind, op.targets, False, "permute",
+                                      shard_amps * bytes_per_amp))
+        elif not cross:
             plans.append(GatePlan(i, op.kind, op.targets, True, "none", 0))
         elif len(op.targets) == 1:
             plans.append(GatePlan(i, op.kind, op.targets, False, "permute",

@@ -11,9 +11,9 @@ from quest_tpu.models import (bernstein_vazirani_circuit, ghz_circuit,
                               trotter_circuit)
 from quest_tpu.parallel import (comm_plan, gather_full_state, global_sum,
                                 is_shard_local, pairwise_exchange)
-from oracle import SV_TOL  # noqa: E402
 from quest_tpu.utils import load_qureg, save_qureg
-from oracle import NUM_QUBITS, assert_sv, random_statevector, set_sv, sv
+from oracle import (NUM_QUBITS, SV_TOL, assert_sv, random_statevector,
+                    set_sv, sv)
 
 N = NUM_QUBITS
 
