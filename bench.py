@@ -132,7 +132,12 @@ def bench_clifford_t(n=20, depth=50, precision=2, seed=5):
 def bench_density(n=14, depth=5, precision=2, seed=7):
     """Density-matrix layer on the Choi-flattened 2n-qubit vector: Haar 1q
     gate + shadow, then mixDamping and mixDepolarising per qubit pair
-    (BASELINE config 4)."""
+    (BASELINE config 4).
+
+    f32 runs the whole layer as one fused fori_loop program; f64 runs
+    per-qubit jitted steps with buffer donation — a 42-op f64 program at
+    2^28 amps exceeds HBM from scheduler liveness even with the engine's
+    chunked matmuls, while the per-step chain peaks at ~10 GiB."""
     import numpy as np
     import jax.numpy as jnp
     from quest_tpu.ops import apply as _ap
@@ -160,31 +165,77 @@ def bench_density(n=14, depth=5, precision=2, seed=7):
             s = _deco.mix_depolarising(s, jnp.asarray(0.02, dtype=jnp.float64), q, n)
         return s
 
-    # rho = |0><0| flattened
-    state = jnp.zeros((2, 1 << (2 * n)), dtype=dtype).at[0, 0].set(1.0)
+    # rho = |0><0| flattened; donation consumes the buffer, so each timed
+    # call gets a fresh state
+    def fresh():
+        return jnp.zeros((2, 1 << (2 * n)), dtype=dtype).at[0, 0].set(1.0)
 
     from functools import partial
 
-    @partial(jax.jit, static_argnames=())
-    def run(s, iters):
-        def body(_, st):
-            return layer(st)
-        s = jax.lax.fori_loop(0, iters, body, s)
-        # trace of rho = sum of real diagonal
+    # trace of rho = sum of real diagonal, via strided slice (elements
+    # k*(2^n+1)) — no (2^n, 2^n) square view materialised
+    @jax.jit
+    def trace_of(s):
         dim = 1 << n
-        diag = s[0].reshape(dim, dim).diagonal()
+        diag = jax.lax.slice(s[0], (0,), (dim * dim,), (dim + 1,))
         return jnp.sum(diag.astype(jnp.float64))
 
-    float(run(state, 1))
-    t0 = time.perf_counter()
-    base = float(run(state, 0))
-    overhead = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    trace = float(run(state, depth))
-    dt = time.perf_counter() - t0
-    assert abs(trace - 1.0) < 1e-2, f"trace not preserved: {trace}"
-    compute = max(dt - overhead, 1e-9)
     num_ops = 2 * n + n  # gate+shadow per qubit, channel per qubit
+
+    if precision == 1:
+        @partial(jax.jit, donate_argnums=(0,))
+        def run(s, iters):
+            def body(_, st):
+                return layer(st)
+            return trace_of(jax.lax.fori_loop(0, iters, body, s))
+
+        float(run(fresh(), 1))
+        t0 = time.perf_counter()
+        base = float(run(fresh(), 0))
+        overhead = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        trace = float(run(fresh(), depth))
+        dt = time.perf_counter() - t0
+        compute = max(dt - overhead, 1e-9)
+    else:
+        # one DONATING program per op: at 4 GiB state even a 3-op f64
+        # program exceeds HBM from inter-op liveness; donation reuses the
+        # state allocation in place, keeping each single-op program at
+        # ~10 GiB peak (state + output alias + the engine's chunked-matmul
+        # temporaries) and implicitly serialising the chain
+        from functools import partial as _partial
+
+        def mk(fn):
+            return _partial(jax.jit, donate_argnums=(0,))(fn)
+
+        steps = []
+        for q, up, upc in gates:
+            steps.append(mk(lambda s, up=up, q=q: _ap.apply_matrix(
+                s, jnp.asarray(up, dtype=s.dtype), (q,))))
+            steps.append(mk(lambda s, upc=upc, q=q: _ap.apply_matrix(
+                s, jnp.asarray(upc, dtype=s.dtype), (q + n,))))
+        for q in range(0, n, 2):
+            steps.append(mk(lambda s, q=q: _deco.mix_damping(
+                s, jnp.asarray(0.02, jnp.float64), q, n)))
+        for q in range(1, n, 2):
+            steps.append(mk(lambda s, q=q: _deco.mix_depolarising(
+                s, jnp.asarray(0.02, jnp.float64), q, n)))
+
+        s = fresh()
+        for f in steps:  # compile + warm every per-op program
+            s = f(s)
+        float(trace_of(s))
+        del s
+        s = fresh()
+        t0 = time.perf_counter()
+        for _ in range(depth):
+            for f in steps:
+                s = f(s)
+        trace = float(trace_of(s))
+        dt = time.perf_counter() - t0
+        compute = max(dt, 1e-9)
+
+    assert abs(trace - 1.0) < 1e-2, f"trace not preserved: {trace}"
     value = (1 << (2 * n)) * num_ops * depth / compute
     return value, {"qubits": n, "depth": depth, "precision": precision,
                    "ops_per_layer": num_ops, "seconds": dt}
@@ -274,9 +325,11 @@ def main() -> None:
         add("random24_f64_fused", bench_random, n, depth, 2, True)
         add("random24_f64_unfused", bench_random, n, 10, 2, False)
         add("clifford_t_20q_f64", bench_clifford_t)
-        # f64 density at 14q exceeds HBM under f64 emulation (measured:
-        # 18.05G needed of 15.75G) — the density config runs at f32
         add("densmatr_14q_damping_depol_f32", bench_density, 14, 5, 1)
+        # f64 at this size needs the engine's chunked matmuls + elementwise
+        # channels + per-step donation to fit HBM; 1 layer keeps bench time
+        # bounded (~90 s — each emulated-f64 gate pass over 2^28 amps is ~2 s)
+        add("densmatr_14q_damping_depol_f64", bench_density, 14, 1, 2)
         add("qft_28q_f32", bench_qft, 28, 1)
         try:
             cpu = jax.devices("cpu")[:_N_VIRT]

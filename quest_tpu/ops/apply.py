@@ -390,6 +390,63 @@ def _dense_on(sub: jax.Array, u: jax.Array, plan: _Plan) -> jax.Array:
     return jnp.stack([out_re, out_im])
 
 
+_CHUNK_TARGET_BYTES = 256 * 1024 * 1024
+
+
+def _chunk_spec(plan: _Plan, sub_shape: tuple, itemsize: int):
+    """(axis, chunks) for piecewise application of a dense gate on a huge
+    f64 state, or None.
+
+    XLA's emulated-f64 dot_general materialises split-representation
+    temporaries of ~2x the state size per matmul (observed in the
+    allocation dump: two f32[...,2,128] 8 GiB temps for ONE lane gate on a
+    2^28-amp f64 state — 36 GiB total on a 16 GiB chip).  Slicing a large
+    non-contracted axis and applying the gate chunk-by-chunk inside a
+    fori_loop bounds those temporaries at ~2 x _CHUNK_TARGET_BYTES while
+    keeping true IEEE f64 arithmetic.  The axis index is in _dense_on's
+    convention (leading re/im axis excluded)."""
+    total = itemsize
+    for d in sub_shape:
+        total *= int(d)
+    if total <= 4 * _CHUNK_TARGET_BYTES:
+        return None
+    rank = len(sub_shape) - 1
+    cands = [a for a in range(rank) if a not in plan.slot_axes]
+    if not cands:
+        return None
+    want = 1
+    while total // want > 2 * _CHUNK_TARGET_BYTES:
+        want *= 2
+    # prefer the MINOR-most adequate axis: the amplitude sharding lives on
+    # the leading (major) axis, and a loop-varying dynamic-slice over a
+    # sharded axis would turn each chunk into a cross-shard gather — the
+    # minor axes are always shard-local
+    for axis in reversed(cands):
+        if int(sub_shape[1 + axis]) >= want:
+            return axis, want
+    axis = max(cands, key=lambda a: sub_shape[1 + a])
+    chunks = min(want, int(sub_shape[1 + axis]))
+    return (axis, chunks) if chunks > 1 else None
+
+
+def _dense_chunked(sub: jax.Array, u: jax.Array, plan: _Plan) -> jax.Array:
+    """Apply :func:`_dense_on`, chunking huge f64 states (see _chunk_spec)."""
+    spec = None
+    if sub.dtype == jnp.float64:
+        spec = _chunk_spec(plan, sub.shape, sub.dtype.itemsize)
+    if spec is None:
+        return _dense_on(sub, u, plan)
+    axis, chunks = spec
+    w = sub.shape[1 + axis] // chunks
+
+    def body(i, out):
+        piece = jax.lax.dynamic_slice_in_dim(sub, i * w, w, 1 + axis)
+        return jax.lax.dynamic_update_slice_in_dim(
+            out, _dense_on(piece, u, plan), i * w, 1 + axis)
+
+    return jax.lax.fori_loop(0, chunks, body, jnp.zeros_like(sub))
+
+
 def apply_matrix(state: jax.Array, u: jax.Array, targets: tuple,
                  controls: tuple = (), control_states: tuple = ()) -> jax.Array:
     """The universal dense gate (ref analogue:
@@ -457,9 +514,9 @@ def _apply_matrix_xla(state: jax.Array, u: jax.Array, targets: tuple,
     u = _expand_matrix(u, plan, state.dtype)
     t = state.reshape((2,) + plan.dims)
     if plan.slice_idx is not None:
-        t = t.at[plan.slice_idx].set(_dense_on(t[plan.slice_idx], u, plan))
+        t = t.at[plan.slice_idx].set(_dense_chunked(t[plan.slice_idx], u, plan))
     else:
-        t = _dense_on(t, u, plan)
+        t = _dense_chunked(t, u, plan)
     return t.reshape(2, -1)
 
 

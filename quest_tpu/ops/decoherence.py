@@ -65,7 +65,11 @@ def mix_depolarising(state: jax.Array, prob: jax.Array, target: int,
     """ρ → (1-p)ρ + p/3 (XρX + YρY + ZρZ)
     (ref: densmatr_mixDepolarisingLocal, QuEST_cpu.c:125, with its
     depolLevel = 4p/3 re-parametrisation resolved analytically):
-    off-diag *= 1-4p/3; populations mix as a00' = (1-2p/3)a00 + (2p/3)a11."""
+    off-diag *= 1-4p/3; populations mix as a00' = (1-2p/3)a00 + (2p/3)a11.
+
+    A dense 4x4 superoperator through the gate engine, whose chunked f64
+    path (apply.py _dense_chunked) bounds the emulated-f64 matmul temps —
+    a 14-qubit f64 density matrix fits a 16 GiB chip."""
     p = prob.astype(_F)
     mix = 2.0 * p / 3.0
     off = 1.0 - 4.0 * p / 3.0

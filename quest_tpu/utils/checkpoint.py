@@ -6,7 +6,9 @@ Here a Qureg checkpoints to a directory of per-shard ``.npy`` files plus a
 JSON manifest, written shard-by-shard from each device buffer (no full-state
 host materialisation), and restores onto any mesh whose sharding divides the
 amplitude count — the idiomatic orbax-style layout without requiring the
-orbax dependency for a plain array pair.
+orbax dependency for a plain array pair.  Save and restore are both
+multi-host capable over a shared filesystem: each process writes/reads only
+its addressable shards, with file names keyed on global offsets.
 """
 
 from __future__ import annotations
@@ -21,34 +23,51 @@ import numpy as np
 def save_qureg(qureg, directory: str) -> None:
     """Write the Qureg's amplitudes and metadata under ``directory``.
 
-    Multi-host note: each process sees only its addressable shards; a
-    correct multi-host checkpoint needs one directory per process (or a
-    shared filesystem with per-process file names).  Until that lands we
-    refuse rather than write a silently partial checkpoint."""
-    if jax.process_count() > 1:
-        raise NotImplementedError(
-            "save_qureg on multi-host meshes needs per-process shard files; "
-            "gather to one host or checkpoint with orbax for now")
+    Multi-host capable: every process writes only its ADDRESSABLE shards,
+    under globally-unique names keyed by the shard's global start offset
+    (so no cross-process coordination is needed for the data files);
+    process 0 then writes the manifest, derived from the global sharding
+    layout rather than from any gathered data.  Requires ``directory`` on
+    a filesystem all hosts share — the same contract as orbax.  Peak host
+    memory is one device shard."""
     os.makedirs(directory, exist_ok=True)
-    meta = {
-        "num_qubits": qureg.num_qubits_represented,
-        "is_density_matrix": bool(qureg.is_density_matrix),
-        "dtype": str(np.dtype(qureg.dtype)),
-        "num_shards": 0,
-    }
-    shards = []
     amps = qureg.amps
-    # write each addressable shard without gathering the full state
-    for i, shard in enumerate(sorted(amps.addressable_shards,
-                                     key=lambda s: s.index[1].start or 0)):
-        fn = f"shard_{i:05d}.npy"
-        np.save(os.path.join(directory, fn), np.asarray(shard.data))
-        start = shard.index[1].start or 0
-        shards.append({"file": fn, "start": int(start)})
-    meta["num_shards"] = len(shards)
-    meta["shards"] = shards
-    with open(os.path.join(directory, "manifest.json"), "w") as f:
-        json.dump(meta, f, indent=1)
+    # owner of each distinct shard window = the LOWEST process holding it,
+    # so cross-host replication never writes the same file from two hosts
+    owner: dict = {}
+    for device, idx in amps.sharding.devices_indices_map(amps.shape).items():
+        start = int(idx[1].start or 0)
+        p = device.process_index
+        owner[start] = p if start not in owner else min(owner[start], p)
+    me = jax.process_index()
+    written = set()
+    for shard in amps.addressable_shards:
+        start = int(shard.index[1].start or 0)
+        if owner[start] != me or start in written:
+            continue
+        written.add(start)
+        np.save(os.path.join(directory, f"shard_{start:020d}.npy"),
+                np.asarray(shard.data))
+    if jax.process_count() > 1:
+        # all data files must exist before the manifest announces them
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("quest_tpu_checkpoint_data")
+    if jax.process_index() == 0:
+        starts = sorted(owner)
+        meta = {
+            "num_qubits": qureg.num_qubits_represented,
+            "is_density_matrix": bool(qureg.is_density_matrix),
+            "dtype": str(np.dtype(qureg.dtype)),
+            "num_shards": len(starts),
+            "shards": [{"file": f"shard_{s:020d}.npy", "start": s}
+                       for s in starts],
+        }
+        with open(os.path.join(directory, "manifest.json"), "w") as f:
+            json.dump(meta, f, indent=1)
+    if jax.process_count() > 1:
+        # no process may return (and start reading) before the manifest exists
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("quest_tpu_checkpoint_manifest")
 
 
 def load_qureg(directory: str, env):
