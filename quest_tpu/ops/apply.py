@@ -315,13 +315,23 @@ def _gauss_mode() -> str:
     """Complex-product strategy: '3m' (Gauss 3-multiplication) or '4m'.
 
     QUEST_TPU_GAUSS=1 forces 3m everywhere, =0 forces 4m; default 'auto'
-    uses 3m for f64 and 4m for f32, from on-chip A/B measurement (v5e,
-    24q random circuit): f64 is MXU-emulation-FLOP-bound, so dropping the
-    4th matmul wins 20-23% fused AND unfused; f32 fused packs are
-    HBM-bound and the 4m form fuses better (6.1e10 vs 5.0e10 amps/s —
-    3m's (re+im) temp costs an extra materialisation).  3m's ~2 extra
-    ulps of cancellation error still clears the measured <1e-14 f64
-    agreement with the reference library (tests/test_capi.py).
+    uses 3m only for f64 on an accelerator backend, from on-chip A/B
+    measurement (v5e, 24q random circuit): emulated f64 is
+    MXU-FLOP-bound, so dropping the 4th matmul wins 20-23% fused AND
+    unfused; f32 fused packs are HBM-bound and the 4m form fuses better
+    (6.1e10 vs 5.0e10 amps/s — 3m's (re+im) temp costs an extra
+    materialisation).  On CPU, f64 keeps 4m: native f64 gains little,
+    and 3m's cancelation (m3-m1-m2) costs ~1 extra ulp at the summand
+    magnitude — measured 1.14e-13 absolute on the reference suite's
+    O(100)-magnitude debug states, marginally over the Catch2 suite's
+    REAL_EPS bar (2 of 53,057 assertions).  4m keeps the full reference
+    suite and the <1e-14 binary agreement green.
+
+    The auto selection keys on the PROCESS's default backend, not on
+    where each array is placed: in a mixed-placement process (accelerator
+    attached but the computation pinned to CPU devices) the accelerator
+    choice applies — set QUEST_TPU_GAUSS=0 there if CPU-side
+    bit-stability matters.
 
     Read once at import (the value participates in traced programs, so a
     mid-process change would silently not retrace already-compiled
@@ -380,7 +390,8 @@ def _dense_on(sub: jax.Array, u: jax.Array, plan: _Plan) -> jax.Array:
 
     re, im = sub[0], sub[1]
     mode = _gauss_mode()
-    if mode == "1" or (mode != "0" and sub.dtype == jnp.float64):
+    if mode == "1" or (mode != "0" and sub.dtype == jnp.float64
+                       and jax.default_backend() != "cpu"):
         m1 = mm(ur, re)
         m2 = mm(ui, im)
         m3 = mm(ur + ui, re + im)

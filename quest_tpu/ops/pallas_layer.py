@@ -1,0 +1,247 @@
+"""Whole-layer Pallas kernel: one pass applies every 1-qubit gate of a
+circuit layer.
+
+The XLA path (circuit.py + native fusion) compiles a 1q-gate layer into
+~4-5 kron-packed matmul ops — each one a full HBM read+write of the state.
+But a layer of single-qubit gates IS one big tensor product
+U_{n-1} ⊗ … ⊗ U_0, and the tile-aligned grouped view (SURVEY-driven design
+in ops/apply.py) factors the state as (top, fiber=128, sublane=8, lane=128).
+This kernel exploits that: ONE grid pass contracts the lane (128-wide),
+sublane (8-wide) and fiber (128-wide) axes — 17 qubits of gates — against a
+block held in VMEM, then a second fiber-style pass covers each remaining
+7-qubit group of top qubits.  A 24-qubit layer is 2 HBM passes instead of 5.
+
+This has no analogue in the reference (its per-gate kernels are one pass
+PER GATE, ref QuEST_cpu.c:1688) and is the hand-scheduled alternative to
+XLA's fusion.  f32 only (Mosaic path; CPU uses the interpreter for tests).
+
+Measured (v5e, 24 qubits, 24 Haar gates/layer): 2.5e10 amps/s — correct
+but ~1.6x SLOWER than the XLA engine's kron-packed programs (3.9e10 in
+the identical harness), chiefly the sublane transposes and Pallas's
+fixed double-buffer pipeline vs XLA's tuned fusion schedule.  XLA stays
+the default path; this module is the measured baseline for future
+hand-tuning (opt in via QUEST_TPU_PALLAS_LAYER=1 where integrated).
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+LANE = 128
+SUB = 8
+
+
+def _kron_pair(a, b):
+    """Complex kron on (2, d, d) real pairs."""
+    re = jnp.kron(a[0], b[0]) - jnp.kron(a[1], b[1])
+    im = jnp.kron(a[0], b[1]) + jnp.kron(a[1], b[0])
+    return jnp.stack([re, im])
+
+
+def _kron_gates(gates):
+    """kron over a list of (2, 2, 2) pairs, first gate = least-significant
+    qubit (matching the engine's bit order: qubit 0 is the LSB)."""
+    out = gates[-1]
+    for g in reversed(gates[:-1]):
+        out = _kron_pair(out, g)
+    return out
+
+
+def _layer17_kernel(ul_r, ul_i, us_r, us_i, uf_r, uf_i,
+                    re_ref, im_ref, ore_ref, oim_ref):
+    """Contract lane (last axis), sublane (axis 1) and fiber (axis 0) of a
+    (F=128, S=8, L=128) block with the three kron-packed gate matrices.
+    Complex products in the 4-multiplication form (f32: fuses/performs best,
+    see apply.py _gauss_mode)."""
+    hp = jax.lax.Precision.HIGHEST
+
+    def cmatmul(xr, xi, mr, mi, contract):
+        dot = partial(jax.lax.dot_general,
+                      dimension_numbers=((contract, (1,)), ((), ())),
+                      precision=hp, preferred_element_type=xr.dtype)
+        return (dot(xr, mr) - dot(xi, mi)), (dot(xr, mi) + dot(xi, mr))
+
+    xr = re_ref[...]
+    xi = im_ref[...]
+    f, s, l = xr.shape
+
+    # lane: out[f, s, j] = sum_l x[f, s, l] UL[j, l]
+    xr2 = xr.reshape(f * s, l)
+    xi2 = xi.reshape(f * s, l)
+    xr2, xi2 = cmatmul(xr2, xi2, ul_r[...], ul_i[...], (1,))
+    xr = xr2.reshape(f, s, l)
+    xi = xi2.reshape(f, s, l)
+
+    # sublane: out[f, j, l] = sum_s US[j, s] x[f, s, l] — left-multiply with
+    # S leading (Mosaic rejects the tall-narrow right-multiplication form;
+    # a statically-unrolled VPU variant exceeded the 16 MiB scoped VMEM)
+    def csub(xr_, xi_):
+        a = xr_.transpose(1, 0, 2).reshape(s, f * l)
+        b = xi_.transpose(1, 0, 2).reshape(s, f * l)
+        dot = partial(jax.lax.dot_general,
+                      dimension_numbers=(((1,), (0,)), ((), ())),
+                      precision=hp, preferred_element_type=a.dtype)
+        rr = dot(us_r[...], a) - dot(us_i[...], b)
+        ri = dot(us_r[...], b) + dot(us_i[...], a)
+        return (rr.reshape(s, f, l).transpose(1, 0, 2),
+                ri.reshape(s, f, l).transpose(1, 0, 2))
+
+    xr, xi = csub(xr, xi)
+
+    # fiber: out[j, s, l] = sum_f UF[j, f] x[f, s, l] — left-multiply, no
+    # output transpose
+    xr2 = xr.reshape(f, s * l)
+    xi2 = xi.reshape(f, s * l)
+
+    def dotl(m, x):
+        return jax.lax.dot_general(
+            m, x, dimension_numbers=(((1,), (0,)), ((), ())),
+            precision=hp, preferred_element_type=x.dtype)
+
+    ore_ref[...] = (dotl(uf_r[...], xr2) - dotl(uf_i[...], xi2)).reshape(f, s, l)
+    oim_ref[...] = (dotl(uf_r[...], xi2) + dotl(uf_i[...], xr2)).reshape(f, s, l)
+
+
+def _fiber_kernel(uf_r, uf_i, re_ref, im_ref, ore_ref, oim_ref):
+    """Contract a W-wide fiber axis: blocks are (W, B); out[j, b] =
+    sum_f U[j, f] x[f, b]."""
+    hp = jax.lax.Precision.HIGHEST
+    xr = re_ref[...]
+    xi = im_ref[...]
+
+    def dotl(m, x):
+        return jax.lax.dot_general(
+            m, x, dimension_numbers=(((1,), (0,)), ((), ())),
+            precision=hp, preferred_element_type=x.dtype)
+
+    ore_ref[...] = dotl(uf_r[...], xr) - dotl(uf_i[...], xi)
+    oim_ref[...] = dotl(uf_r[...], xi) + dotl(uf_i[...], xr)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() == "cpu"  # no Mosaic on CPU
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _apply_layer17(state, ul, us, uf):
+    """Apply UL(lane) ⊗ US(sublane) ⊗ UF(fiber: qubits 10..17) in one pass."""
+    n_amps = state.shape[1]
+    top = n_amps // (LANE * SUB * LANE)
+    shape3 = (top * LANE, SUB, LANE)
+
+    def mat_spec(d1, d2):
+        return pl.BlockSpec((d1, d2), lambda i: (0, 0))
+
+    run = pl.pallas_call(
+        _layer17_kernel,
+        interpret=_interpret(),
+        grid=(top,),
+        in_specs=[
+            mat_spec(LANE, LANE), mat_spec(LANE, LANE),   # UL
+            mat_spec(SUB, SUB), mat_spec(SUB, SUB),       # US
+            mat_spec(LANE, LANE), mat_spec(LANE, LANE),   # UF
+            pl.BlockSpec((LANE, SUB, LANE), lambda i: (i, 0, 0)),  # re
+            pl.BlockSpec((LANE, SUB, LANE), lambda i: (i, 0, 0)),  # im
+        ],
+        out_specs=[
+            pl.BlockSpec((LANE, SUB, LANE), lambda i: (i, 0, 0)),
+            pl.BlockSpec((LANE, SUB, LANE), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(shape3, state.dtype),
+            jax.ShapeDtypeStruct(shape3, state.dtype),
+        ],
+    )
+    out_re, out_im = run(ul[0], ul[1], us[0], us[1], uf[0], uf[1],
+                         state[0].reshape(shape3), state[1].reshape(shape3))
+    return jnp.stack([out_re.reshape(-1), out_im.reshape(-1)])
+
+
+_FIBER_COLS = 1024  # 128x1024 f32 block = 512 KiB per plane; larger blocks
+                    # exceed VMEM under Pallas double-buffering (measured:
+                    # 2048 fails to compile at 24q, 1024 works)
+
+
+@partial(jax.jit, static_argnames=("lo", "width"), donate_argnums=(0,))
+def _apply_fiber(state, uf, lo: int, width: int):
+    """Apply a W-wide kron pack to qubits [lo, lo+log2(W)) — viewed as the
+    contraction axis of a (left, W, right) factorisation of the state."""
+    n_amps = state.shape[1]
+    right = 1 << lo
+    w = width
+    left = n_amps // (right * w)
+    cols = min(_FIBER_COLS, right)
+    shape = (left * w, right)  # rank-2: rows a*w+f, block rows = one fiber
+
+    run = pl.pallas_call(
+        _fiber_kernel,
+        interpret=_interpret(),
+        grid=(left, right // cols),
+        in_specs=[
+            pl.BlockSpec((w, w), lambda i, j: (0, 0)),
+            pl.BlockSpec((w, w), lambda i, j: (0, 0)),
+            pl.BlockSpec((w, cols), lambda i, j: (i, j)),
+            pl.BlockSpec((w, cols), lambda i, j: (i, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((w, cols), lambda i, j: (i, j)),
+            pl.BlockSpec((w, cols), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(shape, state.dtype),
+            jax.ShapeDtypeStruct(shape, state.dtype),
+        ],
+    )
+    out_re, out_im = run(uf[0], uf[1],
+                         state[0].reshape(shape), state[1].reshape(shape))
+    return jnp.stack([out_re.reshape(-1), out_im.reshape(-1)])
+
+
+def layer_supported(n: int) -> bool:
+    return n >= 17
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _layer_all(state, gates):
+    """One program: build the kron packs (tiny in-trace matmuls) and run
+    every Pallas pass.  ``gates`` is an (n, 2, 2, 2) stacked pair array."""
+    n = int(state.shape[1]).bit_length() - 1
+    gp = [gates[q] for q in range(n)]
+    ul = _kron_gates(gp[0:7])
+    us = _kron_gates(gp[7:10])
+    uf = _kron_gates(gp[10:17])
+    state = _apply_layer17(state, ul, us, uf)
+    lo = 17
+    while lo < n:
+        hi = min(lo + 7, n)
+        state = _apply_fiber(state, _kron_gates(gp[lo:hi]), lo, 1 << (hi - lo))
+        lo = hi
+    return state
+
+
+def apply_1q_layer(state: jax.Array, gate_pairs) -> jax.Array:
+    """Apply one single-qubit gate per qubit (gate_pairs[q] is a (2, 2, 2)
+    real pair for qubit q) to an n>=17-qubit f32 state in ceil((n-10)/7)
+    HBM passes.  CONSUMES the input state (donated buffers)."""
+    n = int(state.shape[1]).bit_length() - 1
+    if not layer_supported(n):
+        raise ValueError(f"layer kernel needs n >= 17, got {n}")
+    if len(gate_pairs) != n:
+        raise ValueError(f"need exactly {n} gate pairs, got {len(gate_pairs)}")
+    if state.dtype != jnp.float32:
+        raise ValueError(f"layer kernel is f32-only, got {state.dtype}")
+    gates = jnp.stack([jnp.asarray(g, dtype=state.dtype) for g in gate_pairs])
+    # Mosaic lowering on this stack requires x64 off (same constraint as
+    # pallas_kernels.apply_lane_matrix_eager); f32 operands are unaffected
+    with jax.enable_x64(False):
+        return _layer_all(state, gates)
+
+
+def layer_enabled() -> bool:
+    return os.environ.get("QUEST_TPU_PALLAS_LAYER", "0") == "1"
