@@ -68,18 +68,21 @@ def _run_layered(ops_apply, state, depth, best_of=1):
         return jnp.sum(s[0] * s[0] + s[1] * s[1])
 
     float(run(state, 1))  # compile + warm
-    best = None
+    dts, overheads = [], []
+    total = 0.0
     for _ in range(max(1, best_of)):
         t0 = time.perf_counter()
         base = float(run(state, 0))
-        overhead = time.perf_counter() - t0
+        overheads.append(time.perf_counter() - t0)
         t0 = time.perf_counter()
         total = float(run(state, depth))
-        dt = time.perf_counter() - t0
-        compute = max(dt - overhead, 1e-9)
-        if best is None or compute < best[0]:
-            best = (compute, total, dt, overhead)
-    return best
+        dts.append(time.perf_counter() - t0)
+    # min over dt and overhead INDEPENDENTLY: a noisy overhead probe paired
+    # with a fast run would otherwise overstate throughput; this way noise
+    # can only make the reported number pessimistic
+    dt = min(dts)
+    overhead = min(overheads)
+    return max(dt - overhead, 1e-9), total, dt, overhead
 
 
 def bench_random(n, depth, precision, fuse, seed=11, best_of=1):
@@ -375,7 +378,9 @@ def main() -> None:
             matrix.append({"name": name, "error": f"{type(e).__name__}: {e}"})
 
     if with_matrix:
-        add("random29_f32_fused", bench_random_big)
+        if platform != "cpu":
+            # a 4 GiB 29q state is chip-sized work; skip on CPU dev boxes
+            add("random29_f32_fused", bench_random_big)
         add("random24_f32_unfused", bench_random, n, 10, 1, False)
         add("random24_f64_fused", bench_random, n, depth, 2, True)
         add("random24_f64_unfused", bench_random, n, 10, 2, False)

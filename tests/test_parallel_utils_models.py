@@ -200,3 +200,25 @@ def test_circuit_stats():
     assert st.diagonal_ops == 2          # cz records as controlled diagonal, s
     assert st.mxu_contractions == 2      # h, x
     assert st.cross_shard_ops == 2       # s(5), x(4)
+
+
+def test_distributed_qft_example_runs():
+    """examples/distributed_qft.py — the TPU-native distributed showcase —
+    runs end-to-end on the virtual mesh and concentrates QFT(|+..+>) on |0>."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env_vars = dict(os.environ)
+    env_vars["PYTHONPATH"] = root
+    env_vars.pop("QUEST_TEST_PLATFORM", None)
+    env_vars.pop("QUEST_EXAMPLE_REAL_MESH", None)
+    # pin the virtual mesh width regardless of ambient XLA_FLAGS
+    env_vars["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "examples", "distributed_qft.py")],
+        capture_output=True, text=True, timeout=580, env=env_vars)
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "amplitude of |0...0>: +1.000000" in r.stdout
+    assert "8 x cpu devices" in r.stdout or "tpu devices" in r.stdout
