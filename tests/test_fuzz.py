@@ -188,3 +188,26 @@ def test_fuzz_density_with_channels(env, seed):
         assert np.abs(got - ref).max() < 10 * DM_TOL, \
             f"seed {seed} diverged at step {step}"
     assert qt.calcTotalProb(rho_q) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_fuzz_under_select_control_style():
+    """The comm-free control style (QUEST_TPU_CONTROL_STYLE=select) survives
+    a full differential fuzz walk on both backends — the style changes the
+    compiled form of every controlled dense gate, so the walk re-validates
+    the whole interaction surface under it (style is read at import, hence
+    the subprocess)."""
+    import os
+    import subprocess
+    import sys
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    env_vars = dict(os.environ)
+    env_vars["QUEST_TPU_CONTROL_STYLE"] = "select"
+    fuzz = os.path.join(here, "test_fuzz.py")
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-x",
+         f"{fuzz}::test_fuzz_statevector[local-0]",
+         f"{fuzz}::test_fuzz_statevector[dist8-0]"],
+        capture_output=True, text=True, timeout=580, env=env_vars,
+        cwd=os.path.dirname(here))
+    assert r.returncode == 0, r.stdout[-600:] + r.stderr[-600:]
