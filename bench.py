@@ -41,15 +41,6 @@ if "xla_force_host_platform_device_count" not in _flags:
 BASELINE_AMPS_PER_SEC = 1e8  # driver target (BASELINE.md north star)
 
 
-def _timed(run, *args):
-    """(seconds, result) with dispatch overhead subtracted via a 0-iter call."""
-    float(run(*args, 1))  # warmup/compile
-    t0 = time.perf_counter()
-    base = float(run(*args, 0))
-    overhead = time.perf_counter() - t0
-    return base, overhead
-
-
 def _run_layered(ops_apply, state, depth, best_of=1):
     """(compute_seconds, norm, wall, overhead) — best of ``best_of`` timed
     runs of ONE compiled program (retries reuse the jitted function, so the
@@ -259,10 +250,7 @@ def bench_density(n=14, depth=5, precision=2, seed=7):
         # state allocation in place, keeping each single-op program at
         # ~10 GiB peak (state + output alias + the engine's chunked-matmul
         # temporaries) and implicitly serialising the chain
-        from functools import partial as _partial
-
-        def mk(fn):
-            return _partial(jax.jit, donate_argnums=(0,))(fn)
+        mk = partial(jax.jit, donate_argnums=(0,))
 
         steps = []
         for q, up, upc in gates:

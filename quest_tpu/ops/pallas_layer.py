@@ -20,12 +20,11 @@ but ~1.6x SLOWER than the XLA engine's kron-packed programs (3.9e10 in
 the identical harness), chiefly the sublane transposes and Pallas's
 fixed double-buffer pipeline vs XLA's tuned fusion schedule.  XLA stays
 the default path; this module is the measured baseline for future
-hand-tuning (opt in via QUEST_TPU_PALLAS_LAYER=1 where integrated).
+hand-tuning (callers opt in by invoking :func:`apply_1q_layer` directly).
 """
 
 from __future__ import annotations
 
-import os
 from functools import partial
 
 import jax
@@ -241,7 +240,3 @@ def apply_1q_layer(state: jax.Array, gate_pairs) -> jax.Array:
     # pallas_kernels.apply_lane_matrix_eager); f32 operands are unaffected
     with jax.enable_x64(False):
         return _layer_all(state, gates)
-
-
-def layer_enabled() -> bool:
-    return os.environ.get("QUEST_TPU_PALLAS_LAYER", "0") == "1"

@@ -35,6 +35,8 @@ class GatePlan:
 def comm_plan(circuit, num_devices: int, bytes_per_amp: int = 8) -> list:
     """Static communication plan of a :class:`quest_tpu.Circuit` over an
     n-device amplitude mesh.  ``bytes_per_amp`` defaults to f32 SoA (8 B)."""
+    from ..ops.apply import _control_style
+
     n = circuit.num_qubits
     shard_amps = (1 << n) // num_devices
     plans = []
@@ -54,7 +56,6 @@ def comm_plan(circuit, num_devices: int, bytes_per_amp: int = 8) -> list:
             # style the slice-update makes GSPMD exchange (measured:
             # collective-permute + all-reduce); the select style masks
             # elementwise instead — zero collectives
-            from ..ops.apply import _control_style
             if _control_style() == "select":
                 plans.append(GatePlan(i, op.kind, op.targets, True, "none", 0))
             else:

@@ -203,6 +203,9 @@ def test_fuzz_under_select_control_style():
     here = os.path.dirname(os.path.abspath(__file__))
     env_vars = dict(os.environ)
     env_vars["QUEST_TPU_CONTROL_STYLE"] = "select"
+    # always a CPU run: under QUEST_TEST_PLATFORM=tpu the dist8 node would
+    # silently skip and halve the claimed coverage
+    env_vars.pop("QUEST_TEST_PLATFORM", None)
     fuzz = os.path.join(here, "test_fuzz.py")
     r = subprocess.run(
         [sys.executable, "-m", "pytest", "-q", "-x",
