@@ -1,0 +1,118 @@
+"""Driver-integration regression tests.
+
+The driver validates multi-chip sharding by calling
+``__graft_entry__.dryrun_multichip(n)`` in an environment that may have a
+broken or absent accelerator runtime (r03: a libtpu client/terminal version
+mismatch made *any* touch of the default backend fatal).  These tests pin the
+property that the dryrun is accelerator-independent: it must run entirely on
+the virtual-device CPU platform and never initialise any other backend.
+
+Ref analogue: the reference proves its distributed backend by running under
+real MPI (examples/submissionScripts/mpi_SLURM_unit_tests.sh:1-17); here the
+equivalent proof artifact is the dryrun, so its environment-robustness is a
+first-class correctness property.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_DRYRUN_DRIVER = """
+import os, sys
+# Mimic the driver: virtual CPU devices via XLA_FLAGS, nothing else.  Any
+# JAX_PLATFORMS pin is removed so the default platform resolution (which may
+# prefer a site-registered accelerator plugin) is in effect — the dryrun
+# itself must neutralise it.
+os.environ.pop("JAX_PLATFORMS", None)
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+sys.path.insert(0, @REPO@)
+
+import __graft_entry__
+__graft_entry__.dryrun_multichip(8)
+
+# The pinned property: after a full dryrun, the ONLY initialised backend is
+# the host CPU platform.  If any eager op had touched the default backend,
+# the accelerator plugin would appear here (and in the driver's environment
+# it would have crashed the process before this point).  The registry of
+# already-initialised backends has no public accessor, so fall back to the
+# public default-platform signal if the private one moves in a jax upgrade.
+import jax
+from jax._src import xla_bridge
+registry = getattr(xla_bridge, "_backends", None)
+if registry is not None:
+    initialised = set(registry)
+    assert initialised == {"cpu"}, f"non-CPU backend initialised: {initialised}"
+else:
+    initialised = {d.platform for d in jax.devices()}
+    assert initialised == {"cpu"}, f"non-CPU default platform: {initialised}"
+print("BACKENDS_OK", sorted(initialised))
+"""
+
+
+def _driver_source() -> str:
+    return _DRYRUN_DRIVER.replace("@REPO@", repr(REPO))
+
+
+def test_dryrun_multichip_never_touches_accelerator_backend():
+    """dryrun_multichip(8) must complete using only the CPU backend, even
+    when an accelerator plugin is registered as the default platform."""
+    proc = subprocess.run(
+        [sys.executable, "-c", _driver_source()],
+        capture_output=True, text=True, timeout=600,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, (
+        f"dryrun subprocess failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    assert "BACKENDS_OK ['cpu']" in proc.stdout
+    assert "dryrun_multichip(8): OK" in proc.stdout
+
+
+def test_dryrun_multichip_with_poisoned_accelerator_runtime():
+    """Simulate the r03 driver failure mode: point the TPU runtime library at
+    a nonexistent file so that *any* TPU-plugin initialisation would crash,
+    and verify the dryrun still completes on CPU.
+
+    Note: the poison only bites in environments where a TPU PJRT plugin is
+    registered (like this repo's axon container); elsewhere this reduces to
+    the backend-registry check of the previous test — the registry assertion
+    there is the environment-independent guard."""
+    poisoned = (
+        "import os\n"
+        "os.environ['TPU_LIBRARY_PATH'] = '/nonexistent/libtpu.so'\n"
+        + _driver_source()
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", poisoned],
+        capture_output=True, text=True, timeout=600,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, (
+        f"dryrun subprocess failed under poisoned runtime\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    assert "dryrun_multichip(8): OK" in proc.stdout
+
+
+@pytest.mark.parametrize("n_devices", [2])
+def test_dryrun_multichip_device_counts(n_devices):
+    """The dryrun must work for any power-of-two device count the driver
+    picks, not just the 8 the other tests cover."""
+    body = _driver_source().replace(
+        "dryrun_multichip(8)", f"dryrun_multichip({n_devices})").replace(
+        "device_count=8", f"device_count={n_devices}")
+    proc = subprocess.run(
+        [sys.executable, "-c", body],
+        capture_output=True, text=True, timeout=600,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, (
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
+    assert f"dryrun_multichip({n_devices}): OK" in proc.stdout
