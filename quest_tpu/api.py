@@ -139,15 +139,16 @@ def _apply_unitary(qureg: Qureg, u, targets, controls=(), control_states=()):
     _maybe_clear_caches()
     """Gate + conjugated shadow on the column side for density matrices
     (ref: QuEST.c:8-10).  ``u`` is a complex host matrix; the op layer takes
-    (2, d, d) real pairs."""
+    (2, d, d) real pairs.  Density matrices dispatch ONE fused program for
+    gate + shadow (apply_matrix_density) instead of two."""
     up = _ap.mat_pair(u)
-    amps = _ap.apply_matrix(qureg.amps, up, targets, controls, control_states)
     if qureg.is_density_matrix:
-        n = qureg.num_qubits_represented
-        conj = np.stack([up[0], -up[1]])
-        amps = _ap.apply_matrix(amps, conj, _shift(targets, n),
-                                _shift(controls, n), control_states)
-    qureg.amps = amps
+        qureg.amps = _ap.apply_matrix_density(
+            qureg.amps, up, tuple(targets), tuple(controls),
+            tuple(control_states), qureg.num_qubits_represented)
+    else:
+        qureg.amps = _ap.apply_matrix(qureg.amps, up, targets, controls,
+                                      control_states)
 
 
 def _diag_pair(diag) -> np.ndarray:
@@ -158,13 +159,13 @@ def _diag_pair(diag) -> np.ndarray:
 def _apply_diag(qureg: Qureg, diag, targets, controls=(), control_states=()):
     _maybe_clear_caches()
     dp = _diag_pair(diag)
-    amps = _ap.apply_diagonal(qureg.amps, dp, targets, controls, control_states)
     if qureg.is_density_matrix:
-        n = qureg.num_qubits_represented
-        conj = np.stack([dp[0], -dp[1]])
-        amps = _ap.apply_diagonal(amps, conj, _shift(targets, n),
-                                  _shift(controls, n), control_states)
-    qureg.amps = amps
+        qureg.amps = _ap.apply_diagonal_density(
+            qureg.amps, dp, tuple(targets), tuple(controls),
+            tuple(control_states), qureg.num_qubits_represented)
+    else:
+        qureg.amps = _ap.apply_diagonal(qureg.amps, dp, targets, controls,
+                                        control_states)
 
 
 def _rotation_matrix(angle: float, axis) -> np.ndarray:

@@ -312,7 +312,8 @@ def _cmul(ar, ai, br, bi):
 
 
 def _gauss_mode() -> str:
-    """Complex-product strategy: '3m' (Gauss 3-multiplication) or '4m'.
+    """Complex-product strategy selector: returns the validated env value
+    ('auto', '1' or '0'); the consumer maps it to the 3m / 4m forms.
 
     QUEST_TPU_GAUSS=1 forces 3m everywhere, =0 forces 4m; default 'auto'
     uses 3m only for f64 on an accelerator backend, from on-chip A/B
@@ -339,7 +340,17 @@ def _gauss_mode() -> str:
     return _GAUSS_MODE
 
 
-_GAUSS_MODE = os.environ.get("QUEST_TPU_GAUSS", "auto")
+def _env_choice(name: str, default: str, allowed: tuple) -> str:
+    """Read a policy env var once at import, rejecting unknown values loudly
+    (a typo like QUEST_TPU_GAUSS=3m must not silently behave as 'auto')."""
+    val = os.environ.get(name, default)
+    if val not in allowed:
+        raise ValueError(
+            f"{name}={val!r} is not a valid setting; expected one of {allowed}")
+    return val
+
+
+_GAUSS_MODE = _env_choice("QUEST_TPU_GAUSS", "auto", ("auto", "0", "1"))
 
 
 def _control_style() -> str:
@@ -360,7 +371,8 @@ def _control_style() -> str:
     return _CONTROL_STYLE
 
 
-_CONTROL_STYLE = os.environ.get("QUEST_TPU_CONTROL_STYLE", "slice")
+_CONTROL_STYLE = _env_choice("QUEST_TPU_CONTROL_STYLE", "slice",
+                             ("slice", "select"))
 
 
 def _dense_on(sub: jax.Array, u: jax.Array, plan: _Plan) -> jax.Array:
@@ -431,11 +443,22 @@ def _chunk_spec(plan: _Plan, sub_shape: tuple, itemsize: int):
     # prefer the MINOR-most adequate axis: the amplitude sharding lives on
     # the leading (major) axis, and a loop-varying dynamic-slice over a
     # sharded axis would turn each chunk into a cross-shard gather — the
-    # minor axes are always shard-local
+    # minor axes are always shard-local.  (The >1 GiB trigger above keys on
+    # the GLOBAL state size: a many-way-sharded state may chunk when its
+    # per-shard slab is already small, which costs loop overhead but stays
+    # shard-local and correct.)
     for axis in reversed(cands):
         if int(sub_shape[1 + axis]) >= want:
             return axis, want
-    axis = max(cands, key=lambda a: sub_shape[1 + a])
+    # nothing is wide enough: fall back to the largest non-leading axis, and
+    # to the (possibly sharded) leading axis only when it is the sole option
+    nonlead = [a for a in cands if a != 0 and int(sub_shape[1 + a]) > 1]
+    if nonlead:
+        axis = max(nonlead, key=lambda a: sub_shape[1 + a])
+    elif 0 in cands:
+        axis = 0
+    else:
+        return None
     chunks = min(want, int(sub_shape[1 + axis]))
     return (axis, chunks) if chunks > 1 else None
 
@@ -456,6 +479,187 @@ def _dense_chunked(sub: jax.Array, u: jax.Array, plan: _Plan) -> jax.Array:
             out, _dense_on(piece, u, plan), i * w, 1 + axis)
 
     return jax.lax.fori_loop(0, chunks, body, jnp.zeros_like(sub))
+
+
+# ---------------------------------------------------------------------------
+# the f64 gather engine
+# ---------------------------------------------------------------------------
+#
+# XLA emulates f64 dot_general by splitting each operand into hi/lo f32
+# parts and issuing several f32 matmuls with ~2x-state-size temporaries —
+# measured ~100 ms for ONE 1-qubit gate on a 24q f64 state (v5e), against a
+# 3.7 ms elementwise f64 pass.  A dense k-qubit gate is, however, just a
+# 2^k-term XOR-shift sum:
+#
+#     new[i] = sum_m  u[b(i), b(i)^m] * state[i ^ shift(m)]
+#
+# where b(i) are the k target bits of amplitude index i and shift(m) places
+# the k-bit pattern m on the target positions.  Each term is ONE partner
+# gather (a static lane/sublane permutation or a prefix-axis flip — pure
+# data movement, dtype-agnostic) times an elementwise coefficient keyed on
+# the target bits (a tiny broadcastable table lookup).  No dot_general at
+# all: measured 11 ms (1q) / 16 ms (2q) per gate at 24q f64 — 6-9x the
+# emulated-matmul engine.  f32 keeps the MXU engine (measured faster there).
+#
+# ``patterns`` is a static sparsity hint: only these m are summed.  Callers
+# (ops/decoherence.py) use it for superoperators whose off-pattern
+# coefficients are exactly zero — a depolarising channel needs 2 of 4
+# patterns, a two-qubit depolarising 4 of 16.
+
+_GATHER_CAP = 4  # max gate qubits for the gather engine (2^k partner terms)
+
+_F64_STYLE = _env_choice("QUEST_TPU_F64_STYLE", "auto",
+                         ("auto", "gather", "matmul"))
+
+
+def _use_gather(dtype, k: int, patterns) -> bool:
+    """Gather engine policy: f64 only — by default only on accelerator
+    backends (CPU f64 matmuls are native and the matmul engine's summation
+    order keeps the <1e-14 binary agreement with the reference there)."""
+    if dtype != jnp.float64 or _F64_STYLE == "matmul":
+        return False
+    if (1 << k if patterns is None else len(patterns)) > (1 << _GATHER_CAP):
+        return False
+    return _F64_STYLE == "gather" or jax.default_backend() != "cpu"
+
+
+@lru_cache(maxsize=None)
+def _gather_plan(n: int, wires: tuple):
+    """View factorisation for the gather engine: every PREFIX wire (target or
+    control) gets its own size-2 axis; the sublane axis is isolated only when
+    a wire lives there; the lane axis is never split (bit moves inside it are
+    static lane permutations, preserving the (8, 128) tile)."""
+    l, s = _blocks(n)
+    lo = l + s
+    groups = tuple(sorted((q, 1) for q in wires if q >= lo))
+    sub_involved = any(l <= q < lo for q in wires)
+    return grouped_shape(n, groups, sub_involved) + (l, s)
+
+
+def _dense_gather(state: jax.Array, u: jax.Array, targets: tuple,
+                  controls: tuple = (), control_states: tuple = (),
+                  patterns: tuple | None = None) -> jax.Array:
+    """Apply a dense (2, 2^k, 2^k) gate via the XOR-shift gather sum above.
+    Plain traceable function (targets/controls/patterns must be static).
+
+    Huge states are processed in chunks along a non-wire axis: partner
+    moves happen only along target axes, so each chunk's partners lie inside
+    the chunk — the loop bounds the materialised partner copies the same way
+    _dense_chunked bounds the emulated-matmul temporaries (unchunked, a 1q
+    gate on a 4 GiB density state peaks at in + out + 2 partner planes
+    > 15.75 GiB HBM)."""
+    n = num_qubits_of(state)
+    k = len(targets)
+    dims, axis_of, sub_axis, lane_axis, l, s = _gather_plan(
+        n, tuple(sorted({*targets, *controls})))
+    t = state.reshape((2,) + dims)
+    body_rank = len(dims)
+
+    def wire_bits(q: int) -> jax.Array:
+        """Bit q of the amplitude index, broadcastable over the view body."""
+        shape = [1] * body_rank
+        if q < l:
+            v = (np.arange(1 << l) >> q) & 1
+            shape[lane_axis] = 1 << l
+        elif q < l + s:
+            v = (np.arange(1 << s) >> (q - l)) & 1
+            shape[sub_axis] = 1 << s
+        else:
+            v = np.arange(2)
+            shape[axis_of[q]] = 2
+        return jnp.asarray(v.reshape(shape), dtype=jnp.int32)
+
+    bidx = jnp.zeros((1,) * body_rank, dtype=jnp.int32)
+    for j, q in enumerate(targets):
+        bidx = bidx + (wire_bits(q) << j)
+
+    chi = None
+    if controls:
+        # comm-free 'select' form: keep the gated value only where every
+        # control bit matches (works for any control position — an
+        # elementwise mask, zero collectives even on sharded controls)
+        for c, st in zip(controls, control_states):
+            bit = wire_bits(c) == int(st)
+            chi = bit if chi is None else chi & bit
+
+    ur, ui = u[0].astype(state.dtype), u[1].astype(state.dtype)
+
+    def run(tc: jax.Array) -> jax.Array:
+        accr = jnp.zeros_like(tc[0])
+        acci = jnp.zeros_like(tc[1])
+        for m in (range(1 << k) if patterns is None else patterns):
+            lane_mask = sum(1 << q for j, q in enumerate(targets)
+                            if (m >> j) & 1 and q < l)
+            sub_mask = sum(1 << (q - l) for j, q in enumerate(targets)
+                           if (m >> j) & 1 and l <= q < l + s)
+            g = tc
+            if lane_mask:
+                g = g[..., np.arange(1 << l) ^ lane_mask]
+            if sub_mask:
+                g = jnp.take(g, np.arange(1 << s) ^ sub_mask,
+                             axis=1 + sub_axis)
+            for j, q in enumerate(targets):
+                if (m >> j) & 1 and q >= l + s:
+                    g = jnp.flip(g, axis=1 + axis_of[q])
+            cr = ur[bidx, bidx ^ m]
+            ci = ui[bidx, bidx ^ m]
+            accr = accr + cr * g[0] - ci * g[1]
+            acci = acci + cr * g[1] + ci * g[0]
+        if chi is not None:
+            accr = jnp.where(chi, accr, tc[0])
+            acci = jnp.where(chi, acci, tc[1])
+        return jnp.stack([accr, acci])
+
+    spec = _gather_chunk_spec(t.shape, state.dtype.itemsize, axis_of,
+                              sub_axis, lane_axis, tuple(sorted(
+                                  {*targets, *controls})), l, s)
+    if spec is None:
+        return run(t).reshape(2, -1)
+    axis, chunks = spec
+    w = t.shape[1 + axis] // chunks
+
+    def body(i, out):
+        piece = jax.lax.dynamic_slice_in_dim(t, i * w, w, 1 + axis)
+        return jax.lax.dynamic_update_slice_in_dim(out, run(piece),
+                                                   i * w, 1 + axis)
+
+    return jax.lax.fori_loop(0, chunks, body,
+                             jnp.zeros_like(t)).reshape(2, -1)
+
+
+def _gather_chunk_spec(shape: tuple, itemsize: int, axis_of, sub_axis,
+                       lane_axis, wires: tuple, l: int, s: int):
+    """(axis, chunks) for chunked gather application, or None.
+
+    Candidate axes are merged runs no wire lives on.  The lane/sublane axes
+    are never chunked (a narrow minor slice breaks the (8, 128) tile), and
+    the LEADING axis — where the amplitude sharding lives, so a loop-varying
+    dynamic slice over it would gather cross-shard every iteration — is used
+    only as a last resort when nothing else is wide enough."""
+    total = itemsize
+    for d in shape:
+        total *= int(d)
+    if total <= 4 * _CHUNK_TARGET_BYTES:
+        return None
+    wire_axes = {axis_of[q] for q in wires if q >= l + s}
+    wire_axes.add(sub_axis)
+    wire_axes.add(lane_axis)
+    rank = len(shape) - 1
+    cands = [a for a in range(1, rank) if a not in wire_axes]
+    want = 1
+    while total // want > 2 * _CHUNK_TARGET_BYTES:
+        want *= 2
+    for axis in reversed(cands):
+        if int(shape[1 + axis]) >= want:
+            return axis, want
+    if 0 not in wire_axes and int(shape[1]) >= want:
+        return 0, want
+    cands = [a for a in cands + ([0] if 0 not in wire_axes else [])
+             if int(shape[1 + a]) > 1]
+    if not cands:
+        return None
+    axis = max(cands, key=lambda a: shape[1 + a])
+    return axis, int(shape[1 + axis])
 
 
 def apply_matrix(state: jax.Array, u: jax.Array, targets: tuple,
@@ -492,6 +696,8 @@ def _apply_matrix_xla(state: jax.Array, u: jax.Array, targets: tuple,
     if not control_states:
         control_states = (1,) * len(controls)
     control_states = tuple(int(s) for s in control_states)
+    if _use_gather(state.dtype, len(targets), None):
+        return _dense_gather(state, u, targets, controls, control_states)
     plan = _gate_plan(n, targets, controls, control_states, False)
     if plan.reroute:
         mapping = dict(plan.reroute)
@@ -529,6 +735,43 @@ def _apply_matrix_xla(state: jax.Array, u: jax.Array, targets: tuple,
     else:
         t = _dense_chunked(t, u, plan)
     return t.reshape(2, -1)
+
+
+@partial(jax.jit, static_argnames=("targets", "controls", "control_states",
+                                   "num_qubits"))
+def apply_matrix_density(state: jax.Array, u: jax.Array, targets: tuple,
+                         controls: tuple, control_states: tuple,
+                         num_qubits: int) -> jax.Array:
+    """Gate + conjugated column-side shadow on a density matrix in ONE
+    compiled program (the reference dispatches these as two kernel calls,
+    ref: QuEST.c:8-10 + the densityMatrix branches of each API fn; fusing
+    them halves the per-gate dispatch overhead of the eager density path and
+    lets XLA schedule the two passes together)."""
+    if not control_states:
+        control_states = (1,) * len(controls)
+    state = _apply_matrix_xla(state, u, targets, controls, control_states)
+    conj = jnp.stack([u[0], -u[1]])
+    return _apply_matrix_xla(state, conj,
+                             tuple(t + num_qubits for t in targets),
+                             tuple(c + num_qubits for c in controls),
+                             control_states)
+
+
+@partial(jax.jit, static_argnames=("targets", "controls", "control_states",
+                                   "num_qubits"))
+def apply_diagonal_density(state: jax.Array, diag: jax.Array, targets: tuple,
+                           controls: tuple, control_states: tuple,
+                           num_qubits: int) -> jax.Array:
+    """Diagonal analogue of :func:`apply_matrix_density` — one program for
+    the row-side factor and its column-side conjugate."""
+    if not control_states:
+        control_states = (1,) * len(controls)
+    state = apply_diagonal(state, diag, targets, controls, control_states)
+    conj = jnp.stack([diag[0], -diag[1]])
+    return apply_diagonal(state, conj,
+                          tuple(t + num_qubits for t in targets),
+                          tuple(c + num_qubits for c in controls),
+                          control_states)
 
 
 @partial(jax.jit, static_argnames=("targets", "controls", "control_states"))
