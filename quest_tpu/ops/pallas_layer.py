@@ -127,10 +127,12 @@ def _interpret() -> bool:
     return jax.default_backend() == "cpu"  # no Mosaic on CPU
 
 
-@partial(jax.jit, donate_argnums=(0,))
-def _apply_layer17(state, ul, us, uf):
-    """Apply UL(lane) ⊗ US(sublane) ⊗ UF(fiber: qubits 10..17) in one pass."""
-    n_amps = state.shape[1]
+def _apply_layer17_p(re, im, ul, us, uf):
+    """Apply UL(lane) ⊗ US(sublane) ⊗ UF(fiber: qubits 10..17) in one pass.
+    Plane-pair form: takes/returns the re and im planes as separate flat
+    arrays so the in-place aliasing chain is never broken by a slice or
+    stack of the (2, N) pair."""
+    n_amps = re.shape[0]
     top = n_amps // (LANE * SUB * LANE)
     shape3 = (top * LANE, SUB, LANE)
 
@@ -153,13 +155,18 @@ def _apply_layer17(state, ul, us, uf):
             pl.BlockSpec((LANE, SUB, LANE), lambda i: (i, 0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(shape3, state.dtype),
-            jax.ShapeDtypeStruct(shape3, state.dtype),
+            jax.ShapeDtypeStruct(shape3, re.dtype),
+            jax.ShapeDtypeStruct(shape3, re.dtype),
         ],
+        # true in-place: output block (i) depends only on input block (i),
+        # so the state planes alias their outputs — with the caller's
+        # donation this makes the whole pass run in ~state-size HBM (the
+        # aliasing a 30-qubit 8 GiB f32 state needs on a 15.75 GiB chip)
+        input_output_aliases={6: 0, 7: 1},
     )
     out_re, out_im = run(ul[0], ul[1], us[0], us[1], uf[0], uf[1],
-                         state[0].reshape(shape3), state[1].reshape(shape3))
-    return jnp.stack([out_re.reshape(-1), out_im.reshape(-1)])
+                         re.reshape(shape3), im.reshape(shape3))
+    return out_re.reshape(-1), out_im.reshape(-1)
 
 
 _FIBER_COLS = 1024  # 128x1024 f32 block = 512 KiB per plane; larger blocks
@@ -167,11 +174,11 @@ _FIBER_COLS = 1024  # 128x1024 f32 block = 512 KiB per plane; larger blocks
                     # 2048 fails to compile at 24q, 1024 works)
 
 
-@partial(jax.jit, static_argnames=("lo", "width"), donate_argnums=(0,))
-def _apply_fiber(state, uf, lo: int, width: int):
+def _apply_fiber_p(re, im, uf, lo: int, width: int):
     """Apply a W-wide kron pack to qubits [lo, lo+log2(W)) — viewed as the
-    contraction axis of a (left, W, right) factorisation of the state."""
-    n_amps = state.shape[1]
+    contraction axis of a (left, W, right) factorisation of the state.
+    Plane-pair form (see _apply_layer17_p)."""
+    n_amps = re.shape[0]
     right = 1 << lo
     w = width
     left = n_amps // (right * w)
@@ -193,35 +200,66 @@ def _apply_fiber(state, uf, lo: int, width: int):
             pl.BlockSpec((w, cols), lambda i, j: (i, j)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(shape, state.dtype),
-            jax.ShapeDtypeStruct(shape, state.dtype),
+            jax.ShapeDtypeStruct(shape, re.dtype),
+            jax.ShapeDtypeStruct(shape, re.dtype),
         ],
+        # in-place (see _apply_layer17_p): out block (i, j) reads only
+        # in block (i, j)
+        input_output_aliases={2: 0, 3: 1},
     )
-    out_re, out_im = run(uf[0], uf[1],
-                         state[0].reshape(shape), state[1].reshape(shape))
-    return jnp.stack([out_re.reshape(-1), out_im.reshape(-1)])
+    out_re, out_im = run(uf[0], uf[1], re.reshape(shape), im.reshape(shape))
+    return out_re.reshape(-1), out_im.reshape(-1)
 
 
 def layer_supported(n: int) -> bool:
     return n >= 17
 
 
-@partial(jax.jit, donate_argnums=(0,))
-def _layer_all(state, gates):
-    """One program: build the kron packs (tiny in-trace matmuls) and run
+def _layer_all_p(re, im, gates):
+    """Plane-pair body: build the kron packs (tiny in-trace matmuls) and run
     every Pallas pass.  ``gates`` is an (n, 2, 2, 2) stacked pair array."""
-    n = int(state.shape[1]).bit_length() - 1
+    n = int(re.shape[0]).bit_length() - 1
     gp = [gates[q] for q in range(n)]
     ul = _kron_gates(gp[0:7])
     us = _kron_gates(gp[7:10])
     uf = _kron_gates(gp[10:17])
-    state = _apply_layer17(state, ul, us, uf)
+    re, im = _apply_layer17_p(re, im, ul, us, uf)
+    eye = jnp.asarray(np.stack([np.eye(2), np.zeros((2, 2))]),
+                      dtype=re.dtype)
     lo = 17
     while lo < n:
         hi = min(lo + 7, n)
-        state = _apply_fiber(state, _kron_gates(gp[lo:hi]), lo, 1 << (hi - lo))
+        pack = gp[lo:hi]
+        base = lo
+        if hi - lo < 3:
+            # a remainder group narrower than 3 qubits would give a fiber
+            # block width below the f32 sublane multiple of 8, which Mosaic
+            # tiling rejects — widen it DOWN over already-applied qubits
+            # with identity factors (harmless re-application)
+            pad = 3 - (hi - lo)
+            pack = [eye] * pad + pack
+            base = lo - pad
+        re, im = _apply_fiber_p(re, im, _kron_gates(pack), base,
+                                1 << (hi - base))
         lo = hi
-    return state
+    return re, im
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _layer_all_planes(re, im, gates):
+    """The in-place whole-layer program: peak HBM is ONE state copy plus
+    block buffers — this is what lets a 30-qubit (8 GiB) f32 state run on a
+    15.75 GiB chip, where any path that stacks planes or breaks aliasing
+    needs two copies."""
+    return _layer_all_p(re, im, gates)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _layer_all(state, gates):
+    """(2, N) compatibility entry; the plane slice/stack at the boundary
+    costs a second state copy, fine up to 29 qubits."""
+    re, im = _layer_all_p(state[0], state[1], gates)
+    return jnp.stack([re, im])
 
 
 def apply_1q_layer(state: jax.Array, gate_pairs) -> jax.Array:
@@ -240,3 +278,20 @@ def apply_1q_layer(state: jax.Array, gate_pairs) -> jax.Array:
     # pallas_kernels.apply_lane_matrix_eager); f32 operands are unaffected
     with jax.enable_x64(False):
         return _layer_all(state, gates)
+
+
+def apply_1q_layer_planes(re: jax.Array, im: jax.Array, gate_pairs):
+    """Plane-pair variant of :func:`apply_1q_layer`: CONSUMES both planes and
+    runs fully in place (one state copy of peak HBM) — required for the
+    largest single-chip states (30 qubits f32 = 8 GiB on a 15.75 GiB chip).
+    """
+    n = int(re.shape[0]).bit_length() - 1
+    if not layer_supported(n):
+        raise ValueError(f"layer kernel needs n >= 17, got {n}")
+    if len(gate_pairs) != n:
+        raise ValueError(f"need exactly {n} gate pairs, got {len(gate_pairs)}")
+    if re.dtype != jnp.float32 or im.dtype != jnp.float32:
+        raise ValueError(f"layer kernel is f32-only, got {re.dtype}/{im.dtype}")
+    gates = jnp.stack([jnp.asarray(g, dtype=re.dtype) for g in gate_pairs])
+    with jax.enable_x64(False):
+        return _layer_all_planes(re, im, gates)

@@ -20,7 +20,7 @@ def _haar(rng):
     return u * (np.diag(r) / np.abs(np.diag(r)))
 
 
-@pytest.mark.parametrize("n", [17, 18, 20])
+@pytest.mark.parametrize("n", [17, 18, 19, 20])
 def test_layer_matches_engine(n):
     rng = np.random.default_rng(42 + n)
     gates = [_haar(rng) for _ in range(n)]
