@@ -41,34 +41,48 @@ def comm_plan(circuit, num_devices: int, bytes_per_amp: int = 8) -> list:
     shard_amps = (1 << n) // num_devices
     plans = []
     for i, op in enumerate(circuit.ops):
-        if op.kind == "diagonal":
-            # diagonal gates never move data, controls included — the engine
-            # absorbs controls into the broadcast factor
-            # (ref: QuEST_cpu.c:2978-3109; ops/apply.py apply_diagonal)
-            plans.append(GatePlan(i, op.kind, op.targets, True, "none", 0))
-            continue
         cross = [t for t in op.targets
                  if not is_shard_local(t, n, num_devices)]
         cross_c = [c for c in op.controls
                    if not is_shard_local(c, n, num_devices)]
-        if not cross and cross_c:
-            # a prefix-control on a SHARDED axis: under the default slice
-            # style the slice-update makes GSPMD exchange (measured:
-            # collective-permute + all-reduce); the select style masks
-            # elementwise instead — zero collectives
-            if _control_style() == "select":
+        # a prefix-control on a SHARDED axis: under the default slice style
+        # the slice-update makes GSPMD exchange (measured: collective-permute
+        # + all-reduce); the select style masks elementwise — zero collectives
+        ctrl_comm = bool(cross_c) and _control_style() == "slice"
+
+        if op.kind == "diagonal":
+            # diagonal gates are broadcast multiplies — comm-free — and the
+            # engine absorbs controls into the factor only while
+            # targets+controls fit one expanded diagonal (<= 16 wires,
+            # ops/apply.py apply_diagonal); beyond that apply_diagonal
+            # ALWAYS slice-updates (it has no select-style branch), which
+            # communicates on a sharded control regardless of
+            # QUEST_TPU_CONTROL_STYLE
+            absorbed = (not op.controls
+                        or len(op.targets) + len(op.controls) <= 16)
+            if absorbed or not cross_c:
                 plans.append(GatePlan(i, op.kind, op.targets, True, "none", 0))
             else:
                 plans.append(GatePlan(i, op.kind, op.targets, False, "permute",
                                       shard_amps * bytes_per_amp))
-        elif not cross:
-            plans.append(GatePlan(i, op.kind, op.targets, True, "none", 0))
+            continue
+
+        if not cross:
+            if ctrl_comm:
+                plans.append(GatePlan(i, op.kind, op.targets, False, "permute",
+                                      shard_amps * bytes_per_amp))
+            else:
+                plans.append(GatePlan(i, op.kind, op.targets, True, "none", 0))
         elif len(op.targets) == 1:
+            # cross-shard target; a slice-style sharded control adds its own
+            # exchange on top of the pairwise permute
+            extra = shard_amps * bytes_per_amp if ctrl_comm else 0
             plans.append(GatePlan(i, op.kind, op.targets, False, "permute",
-                                  shard_amps * bytes_per_amp))
+                                  shard_amps * bytes_per_amp + extra))
         else:
             # dense multi-target with sharded targets: GSPMD reshards (the
             # reference's swap-rerouting, one all-to-all each way)
+            extra = shard_amps * bytes_per_amp if ctrl_comm else 0
             plans.append(GatePlan(i, op.kind, op.targets, False, "reshard",
-                                  2 * shard_amps * bytes_per_amp))
+                                  2 * shard_amps * bytes_per_amp + extra))
     return plans

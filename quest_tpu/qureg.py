@@ -35,7 +35,7 @@ class Qureg:
         self.num_qubits_in_state_vec = num_qubits * (2 if is_density_matrix else 1)
         self.env = env
         self.dtype = storage_dtype(dtype if dtype is not None else CONFIG.real_dtype)
-        self.amps: jax.Array | None = None
+        self._amps: jax.Array | None = None
         self.qasm = QASMLogger(num_qubits)
         if env is not None and hasattr(env, "_register"):
             env._register(self)  # weak: lets syncQuESTEnv barrier this env
@@ -54,11 +54,27 @@ class Qureg:
         return self.is_density_matrix
 
     # --- amplitude management ---------------------------------------------
+    @property
+    def amps(self) -> jax.Array | None:
+        return self._amps
+
+    @amps.setter
+    def amps(self, value) -> None:
+        """Every amplitude install re-pins the env's sharding: the eager op
+        path jits without out_shardings, so GSPMD is free to hand back a
+        different (even fully replicated) layout — on a multi-host mesh that
+        would silently un-distribute the state.  The reference never faces
+        this (each MPI rank owns its chunk by construction,
+        ref: QuEST_cpu_distributed.c:129-160); here the Qureg re-asserts the
+        layout whenever the compiler drifted from it (a no-op otherwise)."""
+        if (value is not None and self.env is not None
+                and self.env.sharding is not None
+                and getattr(value, "sharding", None) != self.env.sharding):
+            value = jax.device_put(value, self.env.sharding)
+        self._amps = value
+
     def set_amps_array(self, amps: jax.Array) -> None:
         """Install a new amplitude array, preserving the Qureg's sharding."""
-        if self.env is not None and self.env.sharding is not None:
-            if amps.sharding != self.env.sharding:
-                amps = jax.device_put(amps, self.env.sharding)
         self.amps = amps
 
     def sharded(self, amps: jax.Array) -> jax.Array:

@@ -1,0 +1,115 @@
+"""REAL multi-process distribution: 2 OS processes, one global device mesh.
+
+The reference's distribution is multi-process by construction
+(ref: QuEST_cpu_distributed.c:129-160 MPI_Init; run under SLURM by
+examples/submissionScripts/mpi_SLURM_unit_tests.sh).  The JAX equivalent is
+``jax.distributed.initialize``: every process contributes its local CPU
+devices to one global mesh and executes the same SPMD program.  This test
+launches 2 local processes (4 virtual CPU devices each — an 8-device global
+mesh), runs a sharded circuit with cross-shard gates and a global reduction,
+and round-trips the state through utils/checkpoint.py — executing its
+``jax.process_count() > 1`` branches (lowest-owner dedup + the two
+sync_global_devices barriers), which no single-process test can reach.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import os, sys
+pid = int(sys.argv[1]); port = sys.argv[2]; ckpt = sys.argv[3]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ.pop("JAX_PLATFORMS", None)
+sys.path.insert(0, @REPO@)
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address="127.0.0.1:" + port,
+                           num_processes=2, process_id=pid)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())
+assert len(jax.local_devices()) == 4
+
+import numpy as np
+import quest_tpu as qt
+from quest_tpu.utils.checkpoint import load_qureg, save_qureg
+
+env = qt.createQuESTEnv(num_devices=8)
+n = 10
+q = qt.createQureg(n, env)
+qt.initPlusState(q)
+# cross-shard work: the top 3 qubits are sharded on an 8-device mesh
+qt.hadamard(q, n - 1)
+qt.controlledNot(q, 0, n - 1)
+qt.rotateY(q, n - 2, 0.37)
+total = qt.calcTotalProb(q)
+assert abs(total - 1.0) < 1e-10, total
+
+save_qureg(q, ckpt)
+q2 = load_qureg(ckpt, env)
+
+# the Qureg re-pins the env sharding after every op, so the state must
+# still be distributed 8 ways (one window per device, 4 addressable here)
+assert q.amps.sharding == q.env.sharding, q.amps.sharding
+assert len(q.amps.addressable_shards) == 4
+
+# verify the round-trip GLOBALLY with collective probes (both unit-norm +
+# inner product 1 <=> identical states)
+assert abs(qt.calcTotalProb(q2) - 1.0) < 1e-10
+ip = qt.calcInnerProduct(q, q2)
+assert abs(ip.real - 1.0) < 1e-12 and abs(ip.imag) < 1e-12, ip
+for t in (0, n - 2, n - 1):
+    a = qt.calcProbOfOutcome(q, t, 1)
+    b = qt.calcProbOfOutcome(q2, t, 1)
+    assert abs(a - b) < 1e-12, (t, a, b)
+
+nshards = len(q2.amps.addressable_shards)
+print("WORKER" + str(pid) + " OK local_shards=" + str(nshards))
+"""
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="needs local TCP coordinator")
+def test_two_process_distributed_checkpoint(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    ckpt = tmp_path / "ckpt"
+    src = tmp_path / "worker.py"
+    src.write_text(WORKER.replace("@REPO@", repr(REPO)))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen([sys.executable, str(src), str(pid), str(port), str(ckpt)],
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True, cwd=REPO, env=env)
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process workers timed out (coordinator hang?)")
+        outs.append((p.returncode, out, err))
+    for pid, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"worker {pid} failed\nstdout:\n{out}\nstderr:\n{err[-2000:]}"
+        assert f"WORKER{pid} OK" in out
+
+    # the checkpoint on disk is complete and process-0-authored where shared
+    manifest = ckpt / "manifest.json"
+    assert manifest.exists()
+    import json
+    meta = json.loads(manifest.read_text())
+    assert meta["num_shards"] == 8
+    files = sorted(f.name for f in ckpt.glob("shard_*.npy"))
+    assert len(files) == 8
