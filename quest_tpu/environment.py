@@ -98,11 +98,22 @@ def sync_quest_env(env: QuESTEnv) -> None:
     Blocks until every Qureg created under this env has drained its pending
     device work.  Per-device execution is in-order, so blocking on the env's
     quregs (a weak registry, not a scan of every live array in the process)
-    is a complete barrier for this env's work."""
+    is a complete barrier for this env's work.
+
+    ``block_until_ready`` alone is NOT trusted here: through remote-device
+    tunnels it has been observed returning early (an 83 µs return on a 2 s
+    op).  The authoritative barrier is a scalar readback from every
+    addressable shard — a device->host transfer cannot complete before the
+    producing computation has, on any stack.  This is the same barrier the
+    benchmark layer uses for its timings."""
     for q in list(getattr(env, "_quregs", ())):
         amps = getattr(q, "amps", None)
-        if amps is not None:
-            amps.block_until_ready()
+        if amps is None:
+            continue
+        amps.block_until_ready()
+        for sh in amps.addressable_shards:
+            if sh.data.size:
+                float(sh.data.reshape(-1)[0])
 
 
 def sync_quest_success(env: QuESTEnv, success_code: int) -> int:
