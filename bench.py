@@ -40,6 +40,25 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 BASELINE_AMPS_PER_SEC = 1e8  # driver target (BASELINE.md north star)
 
+HBM_PEAK_BYTES_PER_SEC = 819e9  # v5e HBM bandwidth (public spec ~819 GB/s)
+
+
+def _roofline(num_amps: int, precision: int, passes: float,
+              seconds: float) -> dict:
+    """Achieved-HBM-bandwidth fields making each number auditable as
+    'N passes x state size at X% of peak'.  A pass is modeled as one full
+    read + one full write of the state (gather partners / matmul temps add
+    unmodeled traffic, so the true fraction is >= the reported one).
+    ``num_amps`` is the stored amplitude count (2^n, or 4^n for a density
+    matrix); the SoA pair stores 8 (f32) / 16 (f64) bytes per amplitude."""
+    state_bytes = num_amps * 2 * (4 if precision == 1 else 8)
+    traffic = 2.0 * state_bytes * passes
+    gbps = traffic / max(seconds, 1e-9) / 1e9
+    return {"hbm_passes": passes,
+            "state_bytes": state_bytes,
+            "hbm_gb_per_sec": round(gbps, 2),
+            "hbm_peak_frac": round(gbps * 1e9 / HBM_PEAK_BYTES_PER_SEC, 4)}
+
 
 def _run_layered(ops_apply, state, depth, best_of=1):
     """(compute_seconds, norm, wall, overhead) — best of ``best_of`` timed
@@ -97,9 +116,11 @@ def bench_random(n, depth, precision, fuse, seed=11, best_of=1):
                                                 best_of=best_of)
     assert abs(total - 1.0) < 1e-2, f"state not normalised: {total}"
     value = (1 << n) * n * depth / compute
-    return value, {"qubits": n, "depth": depth, "precision": precision,
-                   "fused": fuse, "ops_per_layer": len(ops),
-                   "seconds": dt, "overhead_seconds": overhead}
+    cfg = {"qubits": n, "depth": depth, "precision": precision,
+           "fused": fuse, "ops_per_layer": len(ops),
+           "seconds": dt, "overhead_seconds": overhead}
+    cfg.update(_roofline(1 << n, precision, len(ops) * depth, compute))
+    return value, cfg
 
 
 def bench_random_big30(depth=4, seed=11):
@@ -156,9 +177,12 @@ def bench_random_big30(depth=4, seed=11):
         best = dt if best is None else min(best, dt)
     assert abs(total - 1.0) < 1e-2, f"norm lost: {total}"
     value = (1 << n) * ops * depth / best
-    return value, {"qubits": n, "depth": depth, "precision": 1,
-                   "ops_per_layer": ops, "seconds": best,
-                   "engine": "pallas_inplace"}
+    cfg = {"qubits": n, "depth": depth, "precision": 1,
+           "ops_per_layer": ops, "seconds": best,
+           "engine": "pallas_inplace"}
+    # 3 Pallas passes (layer17 + two fiber groups) + 1 fused CZ pass / layer
+    cfg.update(_roofline(1 << n, 1, 4 * depth, best))
+    return value, cfg
 
 
 def bench_random_big(n=29, depth=6, seed=11):
@@ -199,8 +223,10 @@ def bench_random_big(n=29, depth=6, seed=11):
     dt = time.perf_counter() - t0
     assert abs(total - 1.0) < 1e-2, f"norm lost: {total}"
     value = (1 << n) * n * depth / dt
-    return value, {"qubits": n, "depth": depth, "precision": 1,
-                   "fused_ops": len(ops), "seconds": dt}
+    cfg = {"qubits": n, "depth": depth, "precision": 1,
+           "fused_ops": len(ops), "seconds": dt}
+    cfg.update(_roofline(1 << n, 1, len(ops) * depth, dt))
+    return value, cfg
 
 
 def bench_clifford_t(n=20, depth=50, precision=2, seed=5):
@@ -230,9 +256,11 @@ def bench_clifford_t(n=20, depth=50, precision=2, seed=5):
     compute, total, dt, overhead = _run_layered(layer, state, depth)
     assert abs(total - 1.0) < 1e-2
     value = (1 << n) * gates * depth / compute
-    return value, {"qubits": n, "depth": depth, "precision": precision,
-                   "gates_per_layer": gates, "fused_ops": len(ops),
-                   "seconds": dt}
+    cfg = {"qubits": n, "depth": depth, "precision": precision,
+           "gates_per_layer": gates, "fused_ops": len(ops),
+           "seconds": dt}
+    cfg.update(_roofline(1 << n, precision, len(ops) * depth, compute))
+    return value, cfg
 
 
 def bench_density(n=14, depth=5, precision=2, seed=7):
@@ -347,8 +375,10 @@ def bench_density(n=14, depth=5, precision=2, seed=7):
 
     assert abs(trace - 1.0) < 1e-2, f"trace not preserved: {trace}"
     value = (1 << (2 * n)) * num_ops * depth / compute
-    return value, {"qubits": n, "depth": depth, "precision": precision,
-                   "ops_per_layer": num_ops, "seconds": dt}
+    cfg = {"qubits": n, "depth": depth, "precision": precision,
+           "ops_per_layer": num_ops, "seconds": dt}
+    cfg.update(_roofline(1 << (2 * n), precision, num_ops * depth, compute))
+    return value, cfg
 
 
 def bench_qft(n, precision=1, devices=None):
@@ -400,9 +430,16 @@ def bench_qft(n, precision=1, devices=None):
     value = (1 << n) * gates / compute
     cfg = {"qubits": n, "precision": precision, "gates": gates,
            "fused_ops": len(ops), "seconds": dt}
-    if devices is not None:
+    if devices is None:
+        # roofline fields only for single-chip runs — normalising a virtual
+        # CPU-mesh run against the TPU's HBM peak would be meaningless
+        cfg.update(_roofline(1 << n, precision, len(ops), compute))
+    else:
         cfg["devices"] = len(devices)
         cfg["platform"] = devices[0].platform
+        # CPU-mesh configs validate cross-shard communication patterns, not
+        # chip throughput: their amps/s is NOT comparable to the baseline
+        cfg["validation_only"] = True
     return value, cfg
 
 
