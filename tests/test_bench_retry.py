@@ -1,0 +1,72 @@
+"""Regression tests for bench.py's transient-error retry policy.
+
+The driver records bench.py's single JSON line as the round's BENCH artifact;
+a transient axon-tunnel drop (observed: "INTERNAL: ...remote_compile: read
+body: response body closed before all bytes were read") must cost one retry,
+not a red config row, while deterministic failures must fail fast and keep
+their root cause.  These tests drive the helper directly — no device work.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def test_transient_failure_is_retried_and_recorded():
+    calls = {"n": 0}
+
+    def flaky(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError(
+                "INTERNAL: http://127.0.0.1:8103/remote_compile: read body: "
+                "response body closed before all bytes were read")
+        return 2e8, {"qubits": 24}
+
+    value, cfg, errors = bench._run_config(flaky)
+    assert calls["n"] == 2
+    assert value == 2e8
+    # the JSON stays auditable: the swallowed failure is recorded
+    assert cfg["retried"] == 1
+    assert "remote_compile" in cfg["retry_error"]
+    assert len(errors) == 1
+
+
+@pytest.mark.parametrize("exc", [
+    AssertionError("state not normalised: 0.5"),
+    ValueError("bad config"),
+])
+def test_deterministic_failure_fails_fast_with_root_cause(exc):
+    calls = {"n": 0}
+
+    def det(*a, **k):
+        calls["n"] += 1
+        raise exc
+
+    value, cfg, errors = bench._run_config(det)
+    assert value is None and cfg is None
+    assert calls["n"] == 1, "deterministic failures must not be re-run"
+    assert errors == [f"{type(exc).__name__}: {exc}"]
+    assert bench._run_config.last_exc is exc
+
+
+def test_double_transient_failure_keeps_root_cause_first():
+    calls = {"n": 0}
+
+    def twice(*a, **k):
+        calls["n"] += 1
+        raise OSError("connection reset by peer" if calls["n"] == 1
+                      else "RESOURCE_EXHAUSTED: out of memory")
+
+    value, cfg, errors = bench._run_config(twice)
+    assert value is None
+    assert calls["n"] == 2
+    assert "connection reset" in errors[0]  # root cause, not the retry's OOM
+    assert "RESOURCE_EXHAUSTED" in errors[1]
