@@ -1,0 +1,26 @@
+#!/bin/bash
+# Launch the multi-host example on every host of a TPU pod slice — the
+# TPU-native analogue of the reference's mpirun submission script
+# (ref: examples/submissionScripts/mpi_SLURM_example.sh: 4 nodes x 1 MPI
+# rank x 8 OMP threads).  On TPU there is no mpirun: the pod launcher runs
+# the SAME Python program on every host, and jax.distributed.initialize()
+# (called inside the program) plays MPI_Init, discovering the coordinator
+# from the TPU runtime.
+#
+# Usage (from a machine with gcloud configured):
+#   TPU_NAME=my-v5e-pod ZONE=us-west4-a ./tpu_pod_example.sh
+#
+# No pod at hand? Rehearse the identical code path locally:
+#   python ../multihost_example.py --rehearse
+
+set -euo pipefail
+
+: "${TPU_NAME:?set TPU_NAME to the pod slice name}"
+: "${ZONE:?set ZONE to the pod's GCE zone}"
+REPO_DIR=${REPO_DIR:-$(cd "$(dirname "$0")/../.." && pwd)}
+
+# Ship the framework to every host, then run the example everywhere.
+gcloud compute tpus tpu-vm scp --recurse "$REPO_DIR" "$TPU_NAME":~/quest-tpu \
+    --zone "$ZONE" --worker=all
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+    --command='cd ~/quest-tpu && PYTHONPATH=. python examples/multihost_example.py'

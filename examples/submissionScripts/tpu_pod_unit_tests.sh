@@ -1,0 +1,29 @@
+#!/bin/bash
+# Run the unit-test suite against a real multi-host TPU pod — the analogue of
+# the reference's distributed test submission
+# (ref: examples/submissionScripts/mpi_SLURM_unit_tests.sh, which reruns the
+# whole Catch2 suite under 4 MPI ranks).
+#
+# The suite's dist8 parametrisation normally shards over 8 VIRTUAL CPU
+# devices; on a pod host the same tests run with the env built over the
+# pod's real chips (QUEST_TEST_PLATFORM=tpu).  Accelerator-precision
+# tolerances apply (precision 1), exactly as the reference's GPU test run
+# loosens its own tolerances.
+#
+# Usage:
+#   TPU_NAME=my-v5e-pod ZONE=us-west4-a ./tpu_pod_unit_tests.sh
+#
+# The 2-process distribution properties (jax.distributed.initialize,
+# multi-host checkpointing) are also covered hermetically on any machine by:
+#   python -m pytest tests/test_multihost.py -q
+
+set -euo pipefail
+
+: "${TPU_NAME:?set TPU_NAME to the pod slice name}"
+: "${ZONE:?set ZONE to the pod's GCE zone}"
+REPO_DIR=${REPO_DIR:-$(cd "$(dirname "$0")/../.." && pwd)}
+
+gcloud compute tpus tpu-vm scp --recurse "$REPO_DIR" "$TPU_NAME":~/quest-tpu \
+    --zone "$ZONE" --worker=all
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+    --command='cd ~/quest-tpu && QUEST_TEST_PLATFORM=tpu python -m pytest tests/ -x -q'

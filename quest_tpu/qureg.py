@@ -21,6 +21,22 @@ from .qasm import QASMLogger
 from .validation import validate_create_num_qubits
 
 
+def _repin(value: jax.Array, sharding) -> jax.Array:
+    """Re-lay ``value`` out as ``sharding``.
+
+    ``jax.device_put`` handles the common case, but when the compiler handed
+    back a non-Named sharding whose device order differs from the mesh's
+    (observed on multi-process meshes), jax's eager reshard path asserts
+    (dispatch.py ``_different_device_order_reshard`` requires a
+    NamedSharding input).  The compiled identity is the universally valid
+    reshard — XLA inserts whatever collectives the layout change needs —
+    and jax caches the compilation per (shape, dtype, src, dst)."""
+    try:
+        return jax.device_put(value, sharding)
+    except Exception:
+        return jax.jit(lambda x: x, out_shardings=sharding)(value)
+
+
 class Qureg:
     """Mutable shell over an immutable amplitude array (functional core,
     imperative surface — the QuEST API mutates, jnp does not).
@@ -70,7 +86,7 @@ class Qureg:
         if (value is not None and self.env is not None
                 and self.env.sharding is not None
                 and getattr(value, "sharding", None) != self.env.sharding):
-            value = jax.device_put(value, self.env.sharding)
+            value = _repin(value, self.env.sharding)
         self._amps = value
 
     def set_amps_array(self, amps: jax.Array) -> None:

@@ -222,3 +222,26 @@ def test_distributed_qft_example_runs():
     assert r.returncode == 0, r.stderr[-500:]
     assert "amplitude of |0...0>: +1.000000" in r.stdout
     assert "8 x cpu devices" in r.stdout or "tpu devices" in r.stdout
+
+
+def test_multihost_example_rehearsal():
+    """examples/multihost_example.py --rehearse: the pod submission-script
+    code path (jax.distributed.initialize + one env over the global mesh)
+    as 2 local processes (ref analogue:
+    examples/submissionScripts/mpi_SLURM_example.sh's mpirun launch)."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env_vars = dict(os.environ)
+    env_vars.pop("XLA_FLAGS", None)  # workers pin their own device count
+    env_vars.pop("QUEST_TEST_PLATFORM", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "examples", "multihost_example.py"),
+         "--rehearse"],
+        capture_output=True, text=True, timeout=580, env=env_vars)
+    assert r.returncode == 0, (r.stdout[-500:], r.stderr[-1000:])
+    assert "processes=2 devices=8" in r.stdout
+    assert "MODE=distributed NUMDEVICES=8" in r.stdout
+    assert "OK" in r.stdout
