@@ -516,10 +516,10 @@ def main() -> None:
         add("random24_f64_unfused", bench_random, n, 10, 2, False)
         add("clifford_t_20q_f64", bench_clifford_t)
         add("densmatr_14q_damping_depol_f32", bench_density, 14, 5, 1)
-        # f64 at this size needs the engine's chunked matmuls + elementwise
-        # channels + per-step donation to fit HBM; 1 layer keeps bench time
-        # bounded (~90 s — each emulated-f64 gate pass over 2^28 amps is ~2 s)
-        add("densmatr_14q_damping_depol_f64", bench_density, 14, 1, 2)
+        # f64 at this size needs the gather engine + per-step donation to fit
+        # HBM; depth 3 amortises the 42 per-op dispatches (~5 s/layer on the
+        # chip) so the number is not a single-layer sample
+        add("densmatr_14q_damping_depol_f64", bench_density, 14, 3, 2)
         add("qft_28q_f32", bench_qft, 28, 1)
         try:
             cpu = jax.devices("cpu")[:_N_VIRT]
