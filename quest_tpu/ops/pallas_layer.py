@@ -215,6 +215,24 @@ def layer_supported(n: int) -> bool:
     return n >= 17
 
 
+def _fiber_group(q: int, n: int):
+    """The 7-qubit-aligned fiber group [lo, hi) covering qubit q >= 17, with
+    the Mosaic width floor applied: a group narrower than 3 qubits would
+    give a fiber block width below the f32 sublane multiple of 8, which
+    Mosaic tiling rejects, so narrow remainder groups are widened DOWN over
+    lower qubits (callers put identity factors there — harmless
+    re-application).  Returns (base, hi): the pack spans [base, hi).
+
+    Single-sourced for _layer_all_p and _gate1_body: ad-hoc geometries
+    (e.g. an (8, 2^27) view at n=30) force XLA into state-sized relayout
+    loops that break aliasing — this alignment is the one proven to compile
+    in place at the 30q ceiling."""
+    lo = 17 + 7 * ((q - 17) // 7)
+    hi = min(lo + 7, n)
+    base = lo if hi - lo >= 3 else lo - (3 - (hi - lo))
+    return base, hi
+
+
 def _layer_all_p(re, im, gates):
     """Plane-pair body: build the kron packs (tiny in-trace matmuls) and run
     every Pallas pass.  ``gates`` is an (n, 2, 2, 2) stacked pair array."""
@@ -228,17 +246,10 @@ def _layer_all_p(re, im, gates):
                       dtype=re.dtype)
     lo = 17
     while lo < n:
-        hi = min(lo + 7, n)
-        pack = gp[lo:hi]
-        base = lo
-        if hi - lo < 3:
-            # a remainder group narrower than 3 qubits would give a fiber
-            # block width below the f32 sublane multiple of 8, which Mosaic
-            # tiling rejects — widen it DOWN over already-applied qubits
-            # with identity factors (harmless re-application)
-            pad = 3 - (hi - lo)
-            pack = [eye] * pad + pack
-            base = lo - pad
+        base, hi = _fiber_group(lo, n)
+        # already-applied qubits below lo get identity factors (the widened
+        # remainder-group case — see _fiber_group)
+        pack = [eye] * (lo - base) + gp[lo:hi]
         re, im = _apply_fiber_p(re, im, _kron_gates(pack), base,
                                 1 << (hi - base))
         lo = hi
@@ -278,6 +289,48 @@ def apply_1q_layer(state: jax.Array, gate_pairs) -> jax.Array:
     # pallas_kernels.apply_lane_matrix_eager); f32 operands are unaffected
     with jax.enable_x64(False):
         return _layer_all(state, gates)
+
+
+def _gate1_body(re, im, gate, q: int):
+    """Traceable single-gate pass body (one Pallas pass); see
+    apply_1q_gate_planes for the jitted entry and ops/qft_inplace.py for a
+    caller that fuses many of these into one program (separate per-gate
+    programs re-lay the flat planes into the tiled 2-D view on every call —
+    a state-sized relayout copy that breaks aliasing at the 30q ceiling)."""
+    n = int(re.shape[0]).bit_length() - 1
+    eye = jnp.asarray(np.stack([np.eye(2), np.zeros((2, 2))]), dtype=re.dtype)
+    if q < 17:
+        gp = [eye] * 17
+        gp[q] = gate
+        return _apply_layer17_p(re, im, _kron_gates(gp[0:7]),
+                                _kron_gates(gp[7:10]), _kron_gates(gp[10:17]))
+    base, hi = _fiber_group(q, n)
+    pack = [eye] * (hi - base)
+    pack[q - base] = gate
+    return _apply_fiber_p(re, im, _kron_gates(pack), base, 1 << (hi - base))
+
+
+@partial(jax.jit, donate_argnums=(0, 1), static_argnames=("q",))
+def _gate1_planes(re, im, gate, q: int):
+    return _gate1_body(re, im, gate, q)
+
+
+def apply_1q_gate_planes(re: jax.Array, im: jax.Array, gate, q: int):
+    """Apply ONE single-qubit gate to qubit ``q`` in a single in-place HBM
+    pass (identity factors elsewhere in the pack).  CONSUMES both planes.
+    The building block for algorithms that interleave 1q gates with
+    elementwise passes at the 30-qubit single-chip ceiling (see
+    ops/qft_inplace.py), where any two-copy path exceeds HBM."""
+    n = int(re.shape[0]).bit_length() - 1
+    if not layer_supported(n):
+        raise ValueError(f"layer kernel needs n >= 17, got {n}")
+    if not 0 <= q < n:
+        raise ValueError(f"qubit {q} out of range for {n} qubits")
+    if re.dtype != jnp.float32 or im.dtype != jnp.float32:
+        raise ValueError(f"layer kernel is f32-only, got {re.dtype}/{im.dtype}")
+    gate = jnp.asarray(gate, dtype=re.dtype)
+    with jax.enable_x64(False):
+        return _gate1_planes(re, im, gate, q)
 
 
 def apply_1q_layer_planes(re: jax.Array, im: jax.Array, gate_pairs):

@@ -1,0 +1,123 @@
+"""In-place QFT for the largest single-chip states (f32, n >= 17).
+
+The circuit QFT (circuit.py qft_circuit; ref analogue QuEST's
+H + controlled-phase + swap construction) is H(q) followed by a
+controlled-phase ladder for each qubit.  Every gate in the ladder after H(q)
+is diagonal and mutually commuting, so the whole ladder collapses to ONE
+closed-form elementwise pass:
+
+    angle(k) = pi * bit_q(k) * (k mod 2^q) / 2^q
+
+since sum_{j<q} bit_j(k) * pi / 2^(q-j) = pi * (k mod 2^q) / 2^q.  A full
+n-qubit QFT is therefore n single-gate Pallas passes (one per H, in place —
+ops/pallas_layer.py) + n fused diagonal passes + one final bit-reversal
+permutation, instead of the n(n+1)/2 + n/2 gate applications of the circuit
+form.
+
+The WHOLE transform is one jitted donated program.  That is a memory
+requirement, not a convenience: a per-gate program chain re-lays the flat
+planes into the Pallas passes' tiled 2-D views on every call boundary (a
+state-sized relayout copy per plane that defeats donation — observed OOM at
+n=30), while inside one program XLA threads the layout through, the Pallas
+input_output_aliases keep every pass at one state copy, and only the final
+bit-reversal (which cannot alias) peaks at one extra PLANE: in 4 GiB + out
+4 GiB + other plane 4 GiB = 12 GiB at n=30 — which is what lets a 30-qubit
+8 GiB state run the full QFT on a 15.75 GiB chip.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .pallas_layer import _gate1_body, layer_supported
+
+_INV_SQRT2 = 0.7071067811865476
+
+
+def _ladder_diag(re, im, q: int):
+    """The fused controlled-phase ladder following H(q): multiply amplitude k
+    by exp(i * pi * bit_q(k) * (k mod 2^q) / 2^q).  One elementwise pass."""
+    n_amps = re.shape[0]
+    k = jax.lax.iota(jnp.uint32, n_amps)
+    m = (k & jnp.uint32((1 << q) - 1)).astype(jnp.float32)
+    bit = ((k >> q) & 1).astype(jnp.float32)
+    ang = (jnp.float32(np.pi) / jnp.float32(1 << q)) * m * bit
+    c, s = jnp.cos(ang), jnp.sin(ang)
+    return re * c - im * s, re * s + im * c
+
+
+def _rev_perm(bits: int) -> np.ndarray:
+    """Host-side table: i -> bit-reversal of i over ``bits`` bits."""
+    k = np.arange(1 << bits, dtype=np.uint32)
+    r = np.zeros_like(k)
+    for b in range(bits):
+        r |= ((k >> b) & 1) << (bits - 1 - b)
+    return r.astype(np.int32)
+
+
+def _bit_reverse(plane, n: int):
+    """Permute amplitude index k -> reverse of its n-bit pattern (the QFT's
+    trailing swap network).
+
+    A direct (2,)*n transpose is catastrophic on TPU (the trailing dim-2
+    axes tile at T(2,128): 64x padding = 256 GiB at n=30).  Instead factor
+    k = row*2^b + col (row: a high bits, col: b low bits), so
+    rev_n(k) = rev_b(col)*2^a + rev_a(row) and the permutation is
+
+        out[i, j] = in[rev_a(j), rev_b(i)]  =  (in[rev_a] .T)[rev_b][i, j]
+
+    — two ROW gathers (contiguous 2^b-element rows) around one 2-D
+    transpose, every step tile-friendly and peaking at in+out = 2 planes."""
+    a = n // 2
+    b = n - a
+    x = plane.reshape(1 << a, 1 << b)
+    x = x[jnp.asarray(_rev_perm(a))]      # rows permuted: [rev_a(j), col]
+    x = x.T                               # [col, rev_a(j)]
+    x = x[jnp.asarray(_rev_perm(b))]      # [rev_b(col), rev_a(j)]
+    return x.reshape(-1)
+
+
+@partial(jax.jit, donate_argnums=(0, 1), static_argnames=("bit_reversal",))
+def _qft_all(re, im, bit_reversal: bool):
+    n = int(re.shape[0]).bit_length() - 1
+    h = jnp.asarray([[[_INV_SQRT2, _INV_SQRT2], [_INV_SQRT2, -_INV_SQRT2]],
+                     [[0.0, 0.0], [0.0, 0.0]]], dtype=re.dtype)
+    for q in range(n - 1, -1, -1):
+        re, im = _gate1_body(re, im, h, q)
+        if q:
+            re, im = _ladder_diag(re, im, q)
+    if bit_reversal:
+        # Reverse the planes STRICTLY one after the other: each reversal
+        # peaks at in+out (it cannot alias), and letting the scheduler
+        # interleave the two puts four state-sized buffers in flight.  The
+        # barrier pins im's reversal behind re's completion.
+        re = _bit_reverse(re, n)
+        re, im = jax.lax.optimization_barrier((re, im))
+        im = _bit_reverse(im, n)
+    return re, im
+
+
+def qft_planes(re: jax.Array, im: jax.Array, *, bit_reversal: bool = True):
+    """Full QFT on plane-pair storage (matching circuit.qft_circuit's
+    convention when ``bit_reversal`` is True).  CONSUMES both planes.  f32,
+    n >= 17 (the Pallas layer-engine floor).
+
+    ``bit_reversal=False`` returns the transform in bit-reversed amplitude
+    order — amplitude k of the true QFT lands at index reverse_n(k) — the
+    standard unordered-transform convention of FFT libraries.  This is the
+    required mode at the single-chip ceiling (n=30, an 8 GiB state): the
+    gate+ladder passes all run in place, but the final reversal cannot
+    alias (it needs a second copy of each plane in flight), and
+    args(8G, reserved for the aliased outputs) + 2 reversal temps(4G each)
+    exceeds the 15.75 GiB HBM.  At n <= 29 both modes fit."""
+    n = int(re.shape[0]).bit_length() - 1
+    if not layer_supported(n):
+        raise ValueError(f"in-place QFT needs n >= 17, got {n}")
+    if re.dtype != jnp.float32 or im.dtype != jnp.float32:
+        raise ValueError(f"in-place QFT is f32-only, got {re.dtype}/{im.dtype}")
+    with jax.enable_x64(False):
+        return _qft_all(re, im, bit_reversal)

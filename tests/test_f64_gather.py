@@ -71,7 +71,10 @@ def test_gather_beyond_matmul_expansion_cap(state):
     u = rs.randn(2, 16, 16)
     got = _gather(state, jnp.asarray(u, dtype=jnp.float64), targets, (), (), None)
 
-    sv = np.asarray(state[0] + 1j * state[1])
+    # host-side complex assembly: a device-side `re + 1j*im` would build a
+    # C128 array, which the TPU rejects at the program boundary
+    st = np.asarray(state)
+    sv = st[0] + 1j * st[1]
     U = u[0] + 1j * u[1]
     out = np.empty_like(sv)
     for i in range(len(sv)):
@@ -83,8 +86,8 @@ def test_gather_beyond_matmul_expansion_cap(state):
                 ip = (ip & ~(1 << q)) | (((bp >> j) & 1) << q)
             acc += U[b, bp] * sv[ip]
         out[i] = acc
-    np.testing.assert_allclose(np.asarray(got[0] + 1j * got[1]), out,
-                               rtol=0, atol=1e-12)
+    g = np.asarray(got)
+    np.testing.assert_allclose(g[0] + 1j * g[1], out, rtol=0, atol=1e-12)
 
 
 @pytest.mark.parametrize("patterns,build", [

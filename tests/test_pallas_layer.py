@@ -41,3 +41,82 @@ def test_layer_rejects_small_states():
     state = jnp.zeros((2, 1 << 10), jnp.float32)
     with pytest.raises(ValueError):
         pll.apply_1q_layer(state, [ap.mat_pair(np.eye(2))] * 10)
+
+
+@pytest.mark.parametrize("n,q", [
+    # n=19: lane/sublane/fiber positions + a widened (padded) high group
+    (19, 0), (19, 5), (19, 8), (19, 12), (19, 16), (19, 17), (19, 18),
+    # n=21: full-width unpadded group-0 arithmetic (q in [17, 21), no pad)
+    (21, 17), (21, 20),
+    # n=25: the SECOND fiber group (lo = 24) — pins the group-offset math
+    (25, 24),
+])
+def test_single_gate_pass_matches_engine(n, q):
+    rng = np.random.default_rng(100 * n + q)
+    u = _haar(rng)
+    amps = rng.normal(size=(2, 1 << n)).astype(np.float32)
+    amps /= np.sqrt((amps ** 2).sum())
+
+    want = ap.apply_matrix(jnp.asarray(amps),
+                           jnp.asarray(ap.mat_pair(u), jnp.float32), (q,))
+    re, im = pll.apply_1q_gate_planes(jnp.asarray(amps[0]),
+                                      jnp.asarray(amps[1]),
+                                      ap.mat_pair(u), q)
+    got = np.stack([np.asarray(re), np.asarray(im)])
+    np.testing.assert_allclose(got, np.asarray(want), atol=2e-6)
+
+
+def test_qft_inplace_matches_circuit_engine():
+    """The fused-ladder in-place QFT (ops/qft_inplace.py) must equal the
+    circuit QFT (H + controlled phases + swaps) applied by the XLA engine."""
+    from quest_tpu.circuit import _apply_one, qft_circuit
+    from quest_tpu.ops.qft_inplace import qft_planes
+
+    n = 18
+    rng = np.random.default_rng(7)
+    amps = rng.normal(size=(2, 1 << n)).astype(np.float32)
+    amps /= np.sqrt((amps ** 2).sum())
+
+    want = jnp.asarray(amps)
+    for op in qft_circuit(n).key():
+        want = _apply_one(want, op)
+
+    re, im = qft_planes(jnp.asarray(amps[0]), jnp.asarray(amps[1]))
+    got = np.stack([np.asarray(re), np.asarray(im)])
+    np.testing.assert_allclose(got, np.asarray(want), atol=5e-6)
+
+
+def test_qft_inplace_concentrates_plus_state():
+    """QFT(|+...+>) = |0...0> — the same end-to-end check the distributed
+    QFT example uses, here through the in-place engine."""
+    from quest_tpu.ops.qft_inplace import qft_planes
+
+    n = 17
+    re = jnp.full((1 << n,), 1.0 / np.sqrt(1 << n), jnp.float32)
+    im = jnp.zeros((1 << n,), jnp.float32)
+    re, im = qft_planes(re, im)
+    assert abs(float(re[0]) - 1.0) < 1e-4
+    assert abs(float(im[0])) < 1e-4
+    norm = float(jnp.sum(re ** 2 + im ** 2))
+    assert abs(norm - 1.0) < 1e-3
+
+
+def test_qft_inplace_unordered_mode():
+    """bit_reversal=False (the 30q-ceiling mode) returns the transform in
+    bit-reversed amplitude order: undoing the permutation on the host must
+    reproduce the ordered transform."""
+    from quest_tpu.ops.qft_inplace import _rev_perm, qft_planes
+
+    n = 17
+    rng = np.random.default_rng(3)
+    amps = rng.normal(size=(2, 1 << n)).astype(np.float32)
+    amps /= np.sqrt((amps ** 2).sum())
+
+    re_o, im_o = qft_planes(jnp.asarray(amps[0]), jnp.asarray(amps[1]))
+    re_u, im_u = qft_planes(jnp.asarray(amps[0]), jnp.asarray(amps[1]),
+                            bit_reversal=False)
+    perm = _rev_perm(n)
+    np.testing.assert_allclose(np.asarray(re_u)[perm], np.asarray(re_o),
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(im_u)[perm], np.asarray(im_o),
+                               atol=1e-6)
