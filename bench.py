@@ -411,9 +411,10 @@ def bench_qft30():
     value = (1 << n) * gates / best
     cfg = {"qubits": n, "precision": 1, "gates": gates, "seconds": best,
            "engine": "pallas_inplace", "bit_reversed_output": True}
-    # 2 passes per (H, ladder) stage: the Pallas gate pass + the fused
-    # elementwise ladder (n H passes + n-1 ladder passes)
-    cfg.update(_roofline(1 << n, 1, 2 * n - 1, best))
+    # per high-q stage (q=29..17): two half-state _h_flip passes (= 1 state
+    # pass) + one in-place Pallas ladder pass; then ONE fused tail pass
+    # covers all 33 remaining circuit passes (q<=16)
+    cfg.update(_roofline(1 << n, 1, 2 * (n - 17) + 1, best))
     return value, cfg
 
 

@@ -293,10 +293,13 @@ def apply_1q_layer(state: jax.Array, gate_pairs) -> jax.Array:
 
 def _gate1_body(re, im, gate, q: int):
     """Traceable single-gate pass body (one Pallas pass); see
-    apply_1q_gate_planes for the jitted entry and ops/qft_inplace.py for a
-    caller that fuses many of these into one program (separate per-gate
-    programs re-lay the flat planes into the tiled 2-D view on every call —
-    a state-sized relayout copy that breaks aliasing at the 30q ceiling)."""
+    apply_1q_gate_planes for the jitted entry.  Note the layout caveat: the
+    fiber passes' banded 2-D block views get their own tiled layouts, so a
+    caller chaining many of these (or mixing them with flat elementwise
+    passes) pays a state-sized relayout copy per plane at each layout
+    boundary — at the 30q ceiling that breaks in-place execution, which is
+    why ops/qft_inplace.py applies its high-qubit H's as flat-layout XLA
+    flip passes instead of through this path."""
     n = int(re.shape[0]).bit_length() - 1
     eye = jnp.asarray(np.stack([np.eye(2), np.zeros((2, 2))]), dtype=re.dtype)
     if q < 17:

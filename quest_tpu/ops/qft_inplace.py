@@ -8,21 +8,37 @@ closed-form elementwise pass:
 
     angle(k) = pi * bit_q(k) * (k mod 2^q) / 2^q
 
-since sum_{j<q} bit_j(k) * pi / 2^(q-j) = pi * (k mod 2^q) / 2^q.  A full
-n-qubit QFT is therefore n single-gate Pallas passes (one per H, in place —
-ops/pallas_layer.py) + n fused diagonal passes + one final bit-reversal
-permutation, instead of the n(n+1)/2 + n/2 gate applications of the circuit
-form.
+since sum_{j<q} bit_j(k) * pi / 2^(q-j) = pi * (k mod 2^q) / 2^q.
 
-The WHOLE transform is one jitted donated program.  That is a memory
-requirement, not a convenience: a per-gate program chain re-lays the flat
-planes into the Pallas passes' tiled 2-D views on every call boundary (a
-state-sized relayout copy per plane that defeats donation — observed OOM at
-n=30), while inside one program XLA threads the layout through, the Pallas
-input_output_aliases keep every pass at one state copy, and only the final
-bit-reversal (which cannot alias) peaks at one extra PLANE: in 4 GiB + out
-4 GiB + other plane 4 GiB = 12 GiB at n=30 — which is what lets a 30-qubit
-8 GiB state run the full QFT on a 15.75 GiB chip.
+The program, per stage, high qubits first:
+
+- q >= 17: H(q) as a fused flip+elementwise XLA pass per plane (_h_flip —
+  H is real, so the planes transform independently), then the fused ladder
+  as ONE aliased Pallas pass (_ladder_pallas — a joint plane rotation needs
+  both inputs for both outputs, which in XLA form holds four state buffers;
+  the aliased kernel runs it truly in place).
+- q <= 16: ALL 33 remaining circuit passes (17 H + 16 ladders) are
+  block-local in the (fiber=128, sublane=8, lane=128) tile view, and ONE
+  Pallas pass (_apply_tail_p / _qft_tail_kernel) applies them per block,
+  MXU/VPU-resident in VMEM.
+
+That is ~2(n-17)+1 HBM passes for the whole transform instead of the
+n(n+1)/2 + n/2 gate applications of the circuit form.
+
+The WHOLE transform is one jitted donated program, and every stage either
+aliases in place or (the _h_flip XLA passes) peaks at one extra plane with
+the two planes barriered so at most three state-sized buffers are ever in
+flight — 12 GiB at n=30 on a 15.75 GiB chip.  Everything stays in FLAT
+byte order (the 3-D (top*128, 8, 128) T(8,128) view is byte-identical to
+flat, so those reshapes are free bitcasts); routing H through e.g. the
+banded 2-D fiber-pass views of pallas_layer instead costs a state-sized
+relayout copy per plane at each layout boundary, which is exactly what
+OOM'd the earlier per-gate formulation at n=30.
+
+The ONLY piece that cannot run in place is the trailing bit-reversal (out
+block (i) reads in block rev(i)): it needs a second copy of each plane in
+flight, so at n=30 the transform runs with ``bit_reversal=False`` — the
+standard unordered-FFT convention; n <= 29 fits the ordered output.
 """
 
 from __future__ import annotations
@@ -33,14 +49,149 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .pallas_layer import _gate1_body, layer_supported
+from jax.experimental import pallas as pl
+
+from .pallas_layer import LANE, SUB, _interpret, layer_supported
 
 _INV_SQRT2 = 0.7071067811865476
 
 
+def _h_flip(plane, q: int, n: int):
+    """H on high qubit q as a fused flip+elementwise pass on ONE plane (H is
+    real, so the planes transform independently): out = (x[k^2^q] +
+    sgn(bit_q)*x[k]) / sqrt(2).  Runs on the FLAT layout — critically, this
+    keeps every stage boundary in flat byte order, so the tail pass's 3-D
+    view is a free bitcast instead of a state-sized relayout (the Pallas
+    fiber pass's banded 2-D output layout forced one 4 GiB relayout copy
+    per plane at the tail boundary — over HBM at n=30)."""
+    # (pre, 2, mid, 128): the flip axis in the middle with a tile-sized
+    # minor lane axis — the geometry the f64 gather engine's partner flips
+    # compile cleanly with (ops/apply.py _dense_gather); a (pre, 2, 2^q)
+    # view with a 2^q-wide minor dim drew a transposed-layout 4 GiB copy
+    # from XLA at n=30
+    x4 = plane.reshape(1 << (n - q - 1), 2, 1 << (q - 7), 128)
+    sgn = jnp.asarray([1.0, -1.0], plane.dtype).reshape(1, 2, 1, 1)
+    out = (jnp.flip(x4, axis=1) + x4 * sgn) * plane.dtype.type(_INV_SQRT2)
+    return out.reshape(-1)
+
+
+def _axis_h(j: int, bits: int) -> np.ndarray:
+    """H at bit j of a ``bits``-wide axis: I_{2^(bits-1-j)} (x) H (x) I_{2^j}
+    (qubit 0 = LSB, matching _kron_gates / the grouped view's bit order)."""
+    h = np.array([[1.0, 1.0], [1.0, -1.0]], np.float32) * np.float32(_INV_SQRT2)
+    return np.kron(np.eye(1 << (bits - 1 - j), dtype=np.float32),
+                   np.kron(h, np.eye(1 << j, dtype=np.float32)))
+
+
+def _qft_tail_kernel(hl_ref, hs_ref, hf_ref, re_ref, im_ref,
+                     ore_ref, oim_ref):
+    """Apply QFT stages q=16..0 — H(q) then its fused phase ladder — to one
+    (F=128, S=8, L=128) block.
+
+    Every one of these 33 circuit passes is BLOCK-LOCAL: H(q) acts on a
+    lane/sublane/fiber bit, and ladder(q)'s angle pi*bit_q*(k mod 2^q)/2^q
+    reads only bits < q <= 16 — the block-local 17-bit index, identical for
+    every block.  One HBM pass replaces all of them; per block the work is
+    14 (128x128) + 3 (8x8) real matmul pairs (H is real) and 16 elementwise
+    phase rotations, MXU/VPU-resident in VMEM."""
+    hp = jax.lax.Precision.HIGHEST
+    xr = re_ref[...]
+    xi = im_ref[...]
+    f, s, l = xr.shape
+
+    # block-local 17-bit amplitude index (bits: fiber 10-16, sub 7-9, lane
+    # 0-6) — int32: Mosaic has no uint32->f32 cast, and 2^17 fits easily
+    k = (jax.lax.broadcasted_iota(jnp.int32, (f, s, l), 0) * 1024
+         + jax.lax.broadcasted_iota(jnp.int32, (f, s, l), 1) * 128
+         + jax.lax.broadcasted_iota(jnp.int32, (f, s, l), 2))
+
+    def ldot(m, x):
+        return jax.lax.dot_general(
+            m, x, dimension_numbers=(((1,), (0,)), ((), ())),
+            precision=hp, preferred_element_type=x.dtype)
+
+    def rdot(x, m):  # out[., j] = sum_l x[., l] m[j, l]
+        return jax.lax.dot_general(
+            x, m, dimension_numbers=(((1,), (1,)), ((), ())),
+            precision=hp, preferred_element_type=x.dtype)
+
+    for q in range(16, -1, -1):
+        if q >= 10:  # fiber bit: left-multiply over the leading axis
+            m = hf_ref[q - 10]
+            xr = ldot(m, xr.reshape(f, s * l)).reshape(f, s, l)
+            xi = ldot(m, xi.reshape(f, s * l)).reshape(f, s, l)
+        elif q >= 7:  # sublane bit (left-multiply, S leading — see
+            m = hs_ref[q - 7]  # _layer17_kernel's csub rationale)
+            a = xr.transpose(1, 0, 2).reshape(s, f * l)
+            b = xi.transpose(1, 0, 2).reshape(s, f * l)
+            xr = ldot(m, a).reshape(s, f, l).transpose(1, 0, 2)
+            xi = ldot(m, b).reshape(s, f, l).transpose(1, 0, 2)
+        else:  # lane bit: right-multiply over the minor axis
+            m = hl_ref[q]
+            xr = rdot(xr.reshape(f * s, l), m).reshape(f, s, l)
+            xi = rdot(xi.reshape(f * s, l), m).reshape(f, s, l)
+        if q:  # the fused controlled-phase ladder following H(q)
+            ang = ((k & jnp.int32((1 << q) - 1))
+                   * ((k >> q) & 1)).astype(jnp.float32) * jnp.float32(
+                       np.pi / (1 << q))
+            c, sn = jnp.cos(ang), jnp.sin(ang)
+            xr, xi = xr * c - xi * sn, xr * sn + xi * c
+    ore_ref[...] = xr
+    oim_ref[...] = xi
+
+
+def _apply_tail_p(re, im):
+    """Run the 17-qubit QFT tail (stages q=16..0) in ONE in-place HBM pass
+    (geometry and aliasing exactly as pallas_layer._apply_layer17_p)."""
+    n_amps = re.shape[0]
+    top = n_amps // (LANE * SUB * LANE)
+    shape3 = (top * LANE, SUB, LANE)
+    hl = np.stack([_axis_h(j, 7) for j in range(7)])
+    hs = np.stack([_axis_h(j, 3) for j in range(3)])
+    hf = np.stack([_axis_h(j, 7) for j in range(7)])
+
+    run = pl.pallas_call(
+        _qft_tail_kernel,
+        interpret=_interpret(),
+        grid=(top,),
+        in_specs=[
+            pl.BlockSpec((7, LANE, LANE), lambda i: (0, 0, 0)),
+            pl.BlockSpec((3, SUB, SUB), lambda i: (0, 0, 0)),
+            pl.BlockSpec((7, LANE, LANE), lambda i: (0, 0, 0)),
+            pl.BlockSpec((LANE, SUB, LANE), lambda i: (i, 0, 0)),
+            pl.BlockSpec((LANE, SUB, LANE), lambda i: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((LANE, SUB, LANE), lambda i: (i, 0, 0)),
+            pl.BlockSpec((LANE, SUB, LANE), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(shape3, re.dtype),
+            jax.ShapeDtypeStruct(shape3, re.dtype),
+        ],
+        input_output_aliases={3: 0, 4: 1},
+    )
+    # The planes arrive in whatever layout the preceding passes produced;
+    # reshaping into the kernel's 3-D view may be a state-sized relayout
+    # copy.  Sequence the two relayouts (barrier) so the first plane's dead
+    # argument buffer is reusable for the second plane's copy — without it
+    # both 4 GiB temps coexist and the 30q program exceeds HBM.
+    re3 = re.reshape(shape3)
+    re3, im = jax.lax.optimization_barrier((re3, im))
+    im3 = im.reshape(shape3)
+    out_re, out_im = run(jnp.asarray(hl), jnp.asarray(hs), jnp.asarray(hf),
+                         re3, im3)
+    return out_re.reshape(-1), out_im.reshape(-1)
+
+
 def _ladder_diag(re, im, q: int):
     """The fused controlled-phase ladder following H(q): multiply amplitude k
-    by exp(i * pi * bit_q(k) * (k mod 2^q) / 2^q).  One elementwise pass."""
+    by exp(i * pi * bit_q(k) * (k mod 2^q) / 2^q).  One elementwise pass.
+
+    XLA form, used by tests as the reference; the QFT program itself uses
+    :func:`_ladder_pallas` — a joint plane rotation needs both inputs for
+    both outputs, so the XLA form holds FOUR state buffers at its peak
+    (over HBM at n=30), while the aliased Pallas form runs truly in place."""
     n_amps = re.shape[0]
     k = jax.lax.iota(jnp.uint32, n_amps)
     m = (k & jnp.uint32((1 << q) - 1)).astype(jnp.float32)
@@ -48,6 +199,54 @@ def _ladder_diag(re, im, q: int):
     ang = (jnp.float32(np.pi) / jnp.float32(1 << q)) * m * bit
     c, s = jnp.cos(ang), jnp.sin(ang)
     return re * c - im * s, re * s + im * c
+
+
+def _ladder_kernel(q: int, re_ref, im_ref, ore_ref, oim_ref):
+    """Block-local ladder rotation: out block (i) reads only in block (i),
+    so the planes alias their outputs — the rotation runs in place."""
+    xr = re_ref[...]
+    xi = im_ref[...]
+    f, s, l = xr.shape
+    i = pl.program_id(0)
+    k = (i * jnp.int32(1 << 17)
+         + jax.lax.broadcasted_iota(jnp.int32, (f, s, l), 0) * 1024
+         + jax.lax.broadcasted_iota(jnp.int32, (f, s, l), 1) * 128
+         + jax.lax.broadcasted_iota(jnp.int32, (f, s, l), 2))
+    # (k mod 2^q) can reach 2^29; the f32 cast rounds its low bits, a phase
+    # error <= pi*2^5/2^q ~ 2e-7 rad — far below f32 amplitude precision
+    # (the XLA form above casts identically)
+    ang = ((k & jnp.int32((1 << q) - 1)) * ((k >> q) & 1)).astype(
+        jnp.float32) * jnp.float32(np.pi / (1 << q))
+    c, sn = jnp.cos(ang), jnp.sin(ang)
+    ore_ref[...] = xr * c - xi * sn
+    oim_ref[...] = xr * sn + xi * c
+
+
+def _ladder_pallas(re, im, q: int):
+    """In-place ladder pass on the 3-D flat-ordered view (free bitcast)."""
+    n_amps = re.shape[0]
+    top = n_amps // (LANE * SUB * LANE)
+    shape3 = (top * LANE, SUB, LANE)
+    run = pl.pallas_call(
+        partial(_ladder_kernel, q),
+        interpret=_interpret(),
+        grid=(top,),
+        in_specs=[
+            pl.BlockSpec((LANE, SUB, LANE), lambda i: (i, 0, 0)),
+            pl.BlockSpec((LANE, SUB, LANE), lambda i: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((LANE, SUB, LANE), lambda i: (i, 0, 0)),
+            pl.BlockSpec((LANE, SUB, LANE), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(shape3, re.dtype),
+            jax.ShapeDtypeStruct(shape3, re.dtype),
+        ],
+        input_output_aliases={0: 0, 1: 1},
+    )
+    out_re, out_im = run(re.reshape(shape3), im.reshape(shape3))
+    return out_re.reshape(-1), out_im.reshape(-1)
 
 
 def _rev_perm(bits: int) -> np.ndarray:
@@ -84,12 +283,15 @@ def _bit_reverse(plane, n: int):
 @partial(jax.jit, donate_argnums=(0, 1), static_argnames=("bit_reversal",))
 def _qft_all(re, im, bit_reversal: bool):
     n = int(re.shape[0]).bit_length() - 1
-    h = jnp.asarray([[[_INV_SQRT2, _INV_SQRT2], [_INV_SQRT2, -_INV_SQRT2]],
-                     [[0.0, 0.0], [0.0, 0.0]]], dtype=re.dtype)
-    for q in range(n - 1, -1, -1):
-        re, im = _gate1_body(re, im, h, q)
-        if q:
-            re, im = _ladder_diag(re, im, q)
+    for q in range(n - 1, 16, -1):
+        # H per plane, barriered so the two flip passes never hold four
+        # state-sized buffers at once; then the fused phase ladder
+        re = _h_flip(re, q, n)
+        re, im = jax.lax.optimization_barrier((re, im))
+        im = _h_flip(im, q, n)
+        re, im = _ladder_pallas(re, im, q)
+    # stages q=16..0 are block-local: one Pallas pass applies all 33 of them
+    re, im = _apply_tail_p(re, im)
     if bit_reversal:
         # Reverse the planes STRICTLY one after the other: each reversal
         # peaks at in+out (it cannot alias), and letting the scheduler

@@ -120,3 +120,23 @@ def test_qft_inplace_unordered_mode():
                                atol=1e-6)
     np.testing.assert_allclose(np.asarray(im_u)[perm], np.asarray(im_o),
                                atol=1e-6)
+
+
+@pytest.mark.parametrize("q", [3, 16, 17, 18])
+def test_ladder_pallas_matches_xla_form(q):
+    """The in-place Pallas ladder kernel must equal the XLA reference form
+    (_ladder_diag) — pins the kernel's global-index reconstruction."""
+    from quest_tpu.ops.qft_inplace import _ladder_diag, _ladder_pallas
+
+    n = 19
+    rng = np.random.default_rng(q)
+    amps = rng.normal(size=(2, 1 << n)).astype(np.float32)
+    amps /= np.sqrt((amps ** 2).sum())
+    re, im = jnp.asarray(amps[0]), jnp.asarray(amps[1])
+
+    want_re, want_im = _ladder_diag(re, im, q)
+    got_re, got_im = jax.jit(_ladder_pallas, static_argnums=(2,))(re, im, q)
+    np.testing.assert_allclose(np.asarray(got_re), np.asarray(want_re),
+                               atol=2e-6)
+    np.testing.assert_allclose(np.asarray(got_im), np.asarray(want_im),
+                               atol=2e-6)
