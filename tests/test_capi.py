@@ -525,22 +525,35 @@ def catch2_full_binary(tmp_path_factory, c_binary):
 
 @pytest.mark.parametrize("tag", list(FULL_TAG_CASES))
 def test_reference_catch2_full_suite(catch2_full_binary, tag):
-    """Run one reference Catch2 tag to completion and require that every one
-    of its known test cases passed.  QUEST_TPU_CLEAR_CACHES_EVERY bounds the
-    process mmap budget — the generator-driven tags compile thousands of
-    distinct gate arrangements (see api.py _maybe_clear_caches)."""
-    import re
-
+    """Run every test case of one reference Catch2 tag, EACH IN ITS OWN
+    PROCESS — the reference's own granularity: ctest registers every case
+    as a separate target (ref tests/CMakeLists.txt:40-47), so each starts
+    with a fresh C rand() stream.  Running a whole tag in one process
+    diverges from that: the reference's getRandomUnitary(2) is a single-pass
+    classical Gram-Schmidt whose own unitarity DEMAND (utilities.cpp:527)
+    deterministically fails on the ill-conditioned draw that appears at one
+    particular mid-tag stream position — a latent flaw of the reference's
+    generator, never observed under ctest because no case inherits another's
+    stream.  QUEST_TPU_CLEAR_CACHES_EVERY bounds each process's mmap budget
+    (see api.py _maybe_clear_caches)."""
     env = dict(os.environ)
     env.update(RUN_ENV)
     env.pop("XLA_FLAGS", None)
     env.setdefault("QUEST_TPU_CLEAR_CACHES_EVERY", "64")
-    r = subprocess.run([str(catch2_full_binary), tag], capture_output=True,
-                       text=True, env=env, timeout=5400)
-    assert r.returncode == 0, (tag, r.stdout[-1200:])
-    assert "All tests passed" in r.stdout, (tag, r.stdout[-800:])
-    m = re.search(r"in (\d+) test cases?", r.stdout)
-    assert m is not None, (tag, r.stdout[-400:])
-    assert int(m.group(1)) == FULL_TAG_CASES[tag], (
-        f"{tag}: expected {FULL_TAG_CASES[tag]} cases, Catch2 ran "
-        f"{m.group(1)} — the committed count table is stale")
+
+    r = subprocess.run([str(catch2_full_binary), "--list-test-names-only",
+                        tag], capture_output=True, text=True, env=env,
+                       timeout=600)
+    cases = [ln.strip() for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(cases) == FULL_TAG_CASES[tag], (
+        f"{tag}: expected {FULL_TAG_CASES[tag]} cases, binary lists "
+        f"{len(cases)} — the committed count table is stale")
+
+    failures = []
+    for case in cases:
+        r = subprocess.run([str(catch2_full_binary), case],
+                           capture_output=True, text=True, env=env,
+                           timeout=5400)
+        if r.returncode != 0 or "All tests passed" not in r.stdout:
+            failures.append((case, r.stdout[-800:]))
+    assert not failures, failures
