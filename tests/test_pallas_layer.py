@@ -135,7 +135,11 @@ def test_ladder_pallas_matches_xla_form(q):
     re, im = jnp.asarray(amps[0]), jnp.asarray(amps[1])
 
     want_re, want_im = _ladder_diag(re, im, q)
-    got_re, got_im = jax.jit(_ladder_pallas, static_argnums=(2,))(re, im, q)
+    # Mosaic lowering requires x64 off (the qft_planes entry does the same;
+    # see pallas_layer apply_1q_layer) — f32 operands are unaffected
+    with jax.enable_x64(False):
+        got_re, got_im = jax.jit(_ladder_pallas,
+                                 static_argnums=(2,))(re, im, q)
     np.testing.assert_allclose(np.asarray(got_re), np.asarray(want_re),
                                atol=2e-6)
     np.testing.assert_allclose(np.asarray(got_im), np.asarray(want_im),
