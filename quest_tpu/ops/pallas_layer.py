@@ -127,14 +127,24 @@ def _interpret() -> bool:
     return jax.default_backend() == "cpu"  # no Mosaic on CPU
 
 
+def _shape3(n_amps: int):
+    """(grid_size, 3-D view shape) of the (F=128, S=8, L=128) block walk —
+    byte-identical to the flat layout, so the reshape is a free bitcast."""
+    top = n_amps // (LANE * SUB * LANE)
+    return top, (top * LANE, SUB, LANE)
+
+
+def _state_spec():
+    """BlockSpec of one (F, S, L) state block, indexed by the 1-D grid."""
+    return pl.BlockSpec((LANE, SUB, LANE), lambda i: (i, 0, 0))
+
+
 def _apply_layer17_p(re, im, ul, us, uf):
     """Apply UL(lane) ⊗ US(sublane) ⊗ UF(fiber: qubits 10..17) in one pass.
     Plane-pair form: takes/returns the re and im planes as separate flat
     arrays so the in-place aliasing chain is never broken by a slice or
     stack of the (2, N) pair."""
-    n_amps = re.shape[0]
-    top = n_amps // (LANE * SUB * LANE)
-    shape3 = (top * LANE, SUB, LANE)
+    top, shape3 = _shape3(re.shape[0])
 
     def mat_spec(d1, d2):
         return pl.BlockSpec((d1, d2), lambda i: (0, 0))

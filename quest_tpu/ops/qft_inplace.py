@@ -51,7 +51,8 @@ import numpy as np
 
 from jax.experimental import pallas as pl
 
-from .pallas_layer import LANE, SUB, _interpret, layer_supported
+from .pallas_layer import (LANE, SUB, _interpret, _shape3, _state_spec,
+                           layer_supported)
 
 _INV_SQRT2 = 0.7071067811865476
 
@@ -83,8 +84,27 @@ def _axis_h(j: int, bits: int) -> np.ndarray:
                    np.kron(h, np.eye(1 << j, dtype=np.float32)))
 
 
-def _qft_tail_kernel(hl_ref, hs_ref, hf_ref, re_ref, im_ref,
-                     ore_ref, oim_ref):
+def _block_k(shape, base):
+    """Amplitude index of each element of an (F, S, L) block whose first
+    flat amplitude is ``base`` — int32: Mosaic has no uint32->f32 cast, and
+    indices stay < 2^31 through n=30."""
+    f, s, l = shape
+    return (base
+            + jax.lax.broadcasted_iota(jnp.int32, (f, s, l), 0) * (SUB * LANE)
+            + jax.lax.broadcasted_iota(jnp.int32, (f, s, l), 1) * LANE
+            + jax.lax.broadcasted_iota(jnp.int32, (f, s, l), 2))
+
+
+def _ladder_cos_sin(k, q: int):
+    """cos/sin of the fused-ladder angle pi*bit_q(k)*(k mod 2^q)/2^q.
+    (k mod 2^q) can reach 2^29; the f32 cast rounds its low bits, a phase
+    error <= pi*2^5/2^q ~ 2e-7 rad — far below f32 amplitude precision."""
+    ang = ((k & jnp.int32((1 << q) - 1)) * ((k >> q) & 1)).astype(
+        jnp.float32) * jnp.float32(np.pi / (1 << q))
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def _qft_tail_kernel(h7_ref, hs_ref, re_ref, im_ref, ore_ref, oim_ref):
     """Apply QFT stages q=16..0 — H(q) then its fused phase ladder — to one
     (F=128, S=8, L=128) block.
 
@@ -93,17 +113,13 @@ def _qft_tail_kernel(hl_ref, hs_ref, hf_ref, re_ref, im_ref,
     reads only bits < q <= 16 — the block-local 17-bit index, identical for
     every block.  One HBM pass replaces all of them; per block the work is
     14 (128x128) + 3 (8x8) real matmul pairs (H is real) and 16 elementwise
-    phase rotations, MXU/VPU-resident in VMEM."""
+    phase rotations, MXU/VPU-resident in VMEM.  The lane and fiber axes are
+    both 7 bits wide, so ONE stack of 7 (128x128) H matrices serves both."""
     hp = jax.lax.Precision.HIGHEST
     xr = re_ref[...]
     xi = im_ref[...]
     f, s, l = xr.shape
-
-    # block-local 17-bit amplitude index (bits: fiber 10-16, sub 7-9, lane
-    # 0-6) — int32: Mosaic has no uint32->f32 cast, and 2^17 fits easily
-    k = (jax.lax.broadcasted_iota(jnp.int32, (f, s, l), 0) * 1024
-         + jax.lax.broadcasted_iota(jnp.int32, (f, s, l), 1) * 128
-         + jax.lax.broadcasted_iota(jnp.int32, (f, s, l), 2))
+    k = _block_k(xr.shape, 0)  # block-local: fiber 10-16, sub 7-9, lane 0-6
 
     def ldot(m, x):
         return jax.lax.dot_general(
@@ -117,7 +133,7 @@ def _qft_tail_kernel(hl_ref, hs_ref, hf_ref, re_ref, im_ref,
 
     for q in range(16, -1, -1):
         if q >= 10:  # fiber bit: left-multiply over the leading axis
-            m = hf_ref[q - 10]
+            m = h7_ref[q - 10]
             xr = ldot(m, xr.reshape(f, s * l)).reshape(f, s, l)
             xi = ldot(m, xi.reshape(f, s * l)).reshape(f, s, l)
         elif q >= 7:  # sublane bit (left-multiply, S leading — see
@@ -127,14 +143,11 @@ def _qft_tail_kernel(hl_ref, hs_ref, hf_ref, re_ref, im_ref,
             xr = ldot(m, a).reshape(s, f, l).transpose(1, 0, 2)
             xi = ldot(m, b).reshape(s, f, l).transpose(1, 0, 2)
         else:  # lane bit: right-multiply over the minor axis
-            m = hl_ref[q]
+            m = h7_ref[q]
             xr = rdot(xr.reshape(f * s, l), m).reshape(f, s, l)
             xi = rdot(xi.reshape(f * s, l), m).reshape(f, s, l)
         if q:  # the fused controlled-phase ladder following H(q)
-            ang = ((k & jnp.int32((1 << q) - 1))
-                   * ((k >> q) & 1)).astype(jnp.float32) * jnp.float32(
-                       np.pi / (1 << q))
-            c, sn = jnp.cos(ang), jnp.sin(ang)
+            c, sn = _ladder_cos_sin(k, q)
             xr, xi = xr * c - xi * sn, xr * sn + xi * c
     ore_ref[...] = xr
     oim_ref[...] = xi
@@ -143,12 +156,9 @@ def _qft_tail_kernel(hl_ref, hs_ref, hf_ref, re_ref, im_ref,
 def _apply_tail_p(re, im):
     """Run the 17-qubit QFT tail (stages q=16..0) in ONE in-place HBM pass
     (geometry and aliasing exactly as pallas_layer._apply_layer17_p)."""
-    n_amps = re.shape[0]
-    top = n_amps // (LANE * SUB * LANE)
-    shape3 = (top * LANE, SUB, LANE)
-    hl = np.stack([_axis_h(j, 7) for j in range(7)])
+    top, shape3 = _shape3(re.shape[0])
+    h7 = np.stack([_axis_h(j, 7) for j in range(7)])  # lane AND fiber
     hs = np.stack([_axis_h(j, 3) for j in range(3)])
-    hf = np.stack([_axis_h(j, 7) for j in range(7)])
 
     run = pl.pallas_call(
         _qft_tail_kernel,
@@ -157,19 +167,15 @@ def _apply_tail_p(re, im):
         in_specs=[
             pl.BlockSpec((7, LANE, LANE), lambda i: (0, 0, 0)),
             pl.BlockSpec((3, SUB, SUB), lambda i: (0, 0, 0)),
-            pl.BlockSpec((7, LANE, LANE), lambda i: (0, 0, 0)),
-            pl.BlockSpec((LANE, SUB, LANE), lambda i: (i, 0, 0)),
-            pl.BlockSpec((LANE, SUB, LANE), lambda i: (i, 0, 0)),
+            _state_spec(),
+            _state_spec(),
         ],
-        out_specs=[
-            pl.BlockSpec((LANE, SUB, LANE), lambda i: (i, 0, 0)),
-            pl.BlockSpec((LANE, SUB, LANE), lambda i: (i, 0, 0)),
-        ],
+        out_specs=[_state_spec(), _state_spec()],
         out_shape=[
             jax.ShapeDtypeStruct(shape3, re.dtype),
             jax.ShapeDtypeStruct(shape3, re.dtype),
         ],
-        input_output_aliases={3: 0, 4: 1},
+        input_output_aliases={2: 0, 3: 1},
     )
     # The planes arrive in whatever layout the preceding passes produced;
     # reshaping into the kernel's 3-D view may be a state-sized relayout
@@ -179,8 +185,7 @@ def _apply_tail_p(re, im):
     re3 = re.reshape(shape3)
     re3, im = jax.lax.optimization_barrier((re3, im))
     im3 = im.reshape(shape3)
-    out_re, out_im = run(jnp.asarray(hl), jnp.asarray(hs), jnp.asarray(hf),
-                         re3, im3)
+    out_re, out_im = run(jnp.asarray(h7), jnp.asarray(hs), re3, im3)
     return out_re.reshape(-1), out_im.reshape(-1)
 
 
@@ -206,39 +211,21 @@ def _ladder_kernel(q: int, re_ref, im_ref, ore_ref, oim_ref):
     so the planes alias their outputs — the rotation runs in place."""
     xr = re_ref[...]
     xi = im_ref[...]
-    f, s, l = xr.shape
-    i = pl.program_id(0)
-    k = (i * jnp.int32(1 << 17)
-         + jax.lax.broadcasted_iota(jnp.int32, (f, s, l), 0) * 1024
-         + jax.lax.broadcasted_iota(jnp.int32, (f, s, l), 1) * 128
-         + jax.lax.broadcasted_iota(jnp.int32, (f, s, l), 2))
-    # (k mod 2^q) can reach 2^29; the f32 cast rounds its low bits, a phase
-    # error <= pi*2^5/2^q ~ 2e-7 rad — far below f32 amplitude precision
-    # (the XLA form above casts identically)
-    ang = ((k & jnp.int32((1 << q) - 1)) * ((k >> q) & 1)).astype(
-        jnp.float32) * jnp.float32(np.pi / (1 << q))
-    c, sn = jnp.cos(ang), jnp.sin(ang)
+    k = _block_k(xr.shape, pl.program_id(0) * jnp.int32(LANE * SUB * LANE))
+    c, sn = _ladder_cos_sin(k, q)
     ore_ref[...] = xr * c - xi * sn
     oim_ref[...] = xr * sn + xi * c
 
 
 def _ladder_pallas(re, im, q: int):
     """In-place ladder pass on the 3-D flat-ordered view (free bitcast)."""
-    n_amps = re.shape[0]
-    top = n_amps // (LANE * SUB * LANE)
-    shape3 = (top * LANE, SUB, LANE)
+    top, shape3 = _shape3(re.shape[0])
     run = pl.pallas_call(
         partial(_ladder_kernel, q),
         interpret=_interpret(),
         grid=(top,),
-        in_specs=[
-            pl.BlockSpec((LANE, SUB, LANE), lambda i: (i, 0, 0)),
-            pl.BlockSpec((LANE, SUB, LANE), lambda i: (i, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((LANE, SUB, LANE), lambda i: (i, 0, 0)),
-            pl.BlockSpec((LANE, SUB, LANE), lambda i: (i, 0, 0)),
-        ],
+        in_specs=[_state_spec(), _state_spec()],
+        out_specs=[_state_spec(), _state_spec()],
         out_shape=[
             jax.ShapeDtypeStruct(shape3, re.dtype),
             jax.ShapeDtypeStruct(shape3, re.dtype),
