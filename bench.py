@@ -289,15 +289,30 @@ def bench_density(n=14, depth=5, precision=2, seed=7):
 
     import jax
 
-    def layer(s):
-        for q, up, upc in gates:
-            s = _ap.apply_matrix(s, jnp.asarray(up, dtype=s.dtype), (q,))
-            s = _ap.apply_matrix(s, jnp.asarray(upc, dtype=s.dtype), (q + n,))
+    def channels(s):
         for q in range(0, n, 2):
             s = _deco.mix_damping(s, jnp.asarray(0.02, dtype=jnp.float64), q, n)
         for q in range(1, n, 2):
             s = _deco.mix_depolarising(s, jnp.asarray(0.02, dtype=jnp.float64), q, n)
         return s
+
+    def layer(s):
+        for q, up, upc in gates:
+            s = _ap.apply_matrix(s, jnp.asarray(up, dtype=s.dtype), (q,))
+            s = _ap.apply_matrix(s, jnp.asarray(upc, dtype=s.dtype), (q + n,))
+        return channels(s)
+
+    def layer_packed(s):
+        """f32 form: ALL 2n single-qubit ops of the layer (gate U_q on
+        qubit q, shadow conj(U_q) on qubit q+n — distinct qubits, so their
+        product is one 2n-fold kron) via the in-place Pallas layer engine:
+        ~3 HBM passes replace 2n per-op passes."""
+        from quest_tpu.ops.pallas_layer import _layer_all_p
+        packed = jnp.asarray(np.stack([up for _, up, _ in gates]
+                                      + [upc for _, _, upc in gates]),
+                             dtype=s.dtype)
+        re, im = _layer_all_p(s[0], s[1], packed)
+        return channels(jnp.stack([re, im]))
 
     # rho = |0><0| flattened; donation consumes the buffer, so each timed
     # call gets a fresh state
@@ -317,19 +332,26 @@ def bench_density(n=14, depth=5, precision=2, seed=7):
     num_ops = 2 * n + n  # gate+shadow per qubit, channel per qubit
 
     if precision == 1:
+        from quest_tpu.ops.pallas_layer import layer_supported
+
+        f32_layer = layer_packed if layer_supported(2 * n) else layer
+
         @partial(jax.jit, donate_argnums=(0,))
         def run(s, iters):
             def body(_, st):
-                return layer(st)
+                return f32_layer(st)
             return trace_of(jax.lax.fori_loop(0, iters, body, s))
 
-        float(run(fresh(), 1))
-        t0 = time.perf_counter()
-        base = float(run(fresh(), 0))
-        overhead = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        trace = float(run(fresh(), depth))
-        dt = time.perf_counter() - t0
+        # x64 off for the Mosaic layer pass (same constraint as
+        # pallas_layer.apply_1q_layer); f32 operands are unaffected
+        with jax.enable_x64(False):
+            float(run(fresh(), 1))
+            t0 = time.perf_counter()
+            base = float(run(fresh(), 0))
+            overhead = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            trace = float(run(fresh(), depth))
+            dt = time.perf_counter() - t0
         compute = max(dt - overhead, 1e-9)
     else:
         # one DONATING program per op: at 4 GiB state even a 3-op f64
