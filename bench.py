@@ -403,40 +403,41 @@ def bench_density(n=14, depth=5, precision=2, seed=7):
     return value, cfg
 
 
-def bench_qft30():
-    """30-qubit QFT through the in-place engine (ops/qft_inplace.py): n
-    single-gate Pallas passes + n fused phase-ladder passes, unordered
-    (bit-reversed) output — the standard FFT convention, required at the
-    single-chip ceiling where the swap network's second state copy cannot
-    fit (see qft_planes docstring).  Gate count credits H + the n(n-1)/2
-    controlled phases the fused ladders implement; the swaps are NOT
-    counted since they are not applied."""
+def bench_qft_inplace(n, bit_reversal):
+    """QFT through the in-place engine (ops/qft_inplace.py).  At n=30 —
+    the single-chip ceiling, where the swap network's second state copy
+    cannot fit — output is unordered (bit-reversed, the standard FFT
+    convention) and the gate count credits H + the n(n-1)/2 controlled
+    phases the fused ladders implement, NOT the unapplied swaps; at
+    n <= 29 the ordered transform includes the reversal and counts the
+    n/2 swaps it implements."""
     import jax
     import jax.numpy as jnp
     import numpy as np
     from quest_tpu.ops.qft_inplace import qft_planes
 
-    n = 30
     re = jnp.full((1 << n,), np.float32(1.0 / np.sqrt(1 << n)), jnp.float32)
     im = jnp.zeros((1 << n,), jnp.float32)
-    re, im = qft_planes(re, im, bit_reversal=False)  # compile + warm
+    re, im = qft_planes(re, im, bit_reversal=bit_reversal)  # compile + warm
     a0 = float(re[0])
     assert abs(a0 - 1.0) < 1e-3, f"QFT(|+..+>) != |0..0>: amp0={a0}"
     best = None
     for _ in range(2):  # best-of-2 against tunnel noise windows
         t0 = time.perf_counter()
-        re, im = qft_planes(re, im, bit_reversal=False)
+        re, im = qft_planes(re, im, bit_reversal=bit_reversal)
         float(re[0])
         dt = time.perf_counter() - t0
         best = dt if best is None else min(best, dt)
-    gates = n + n * (n - 1) // 2
+    gates = n + n * (n - 1) // 2 + (n // 2 if bit_reversal else 0)
     value = (1 << n) * gates / best
     cfg = {"qubits": n, "precision": 1, "gates": gates, "seconds": best,
-           "engine": "pallas_inplace", "bit_reversed_output": True}
-    # per high-q stage (q=29..17): two half-state _h_flip passes (= 1 state
-    # pass) + one in-place Pallas ladder pass; then ONE fused tail pass
-    # covers all 33 remaining circuit passes (q<=16)
-    cfg.update(_roofline(1 << n, 1, 2 * (n - 17) + 1, best))
+           "engine": "pallas_inplace", "bit_reversed_output": not bit_reversal}
+    # per high-q stage (q=n-1..17): two half-state _h_flip passes (= 1
+    # state pass) + one in-place Pallas ladder pass; ONE fused tail pass
+    # covers all 33 remaining circuit passes (q<=16); the ordered mode
+    # adds 3 permutation passes per plane (= 3 state passes)
+    cfg.update(_roofline(1 << n, 1,
+                         2 * (n - 17) + 1 + (3 if bit_reversal else 0), best))
     return value, cfg
 
 
@@ -581,7 +582,8 @@ def main() -> None:
         add("densmatr_14q_damping_depol_f64", bench_density, 14, 3, 2)
         add("qft_28q_f32", bench_qft, 28, 1)
         if platform != "cpu":
-            add("qft_30q_f32_unordered", bench_qft30)
+            add("qft_28q_f32_inplace_ordered", bench_qft_inplace, 28, True)
+            add("qft_30q_f32_unordered", bench_qft_inplace, 30, False)
         try:
             cpu = jax.devices("cpu")[:_N_VIRT]
         except RuntimeError:
