@@ -104,9 +104,11 @@ def _ladder_cos_sin(k, q: int):
     return jnp.cos(ang), jnp.sin(ang)
 
 
-def _qft_tail_kernel(h7_ref, hs_ref, re_ref, im_ref, ore_ref, oim_ref):
+def _qft_tail_kernel(inverse: bool, h7_ref, hs_ref, re_ref, im_ref,
+                     ore_ref, oim_ref):
     """Apply QFT stages q=16..0 — H(q) then its fused phase ladder — to one
-    (F=128, S=8, L=128) block.
+    (F=128, S=8, L=128) block; ``inverse`` runs the adjoint (ascending q,
+    negated ladder before each H — H is real-symmetric, so self-adjoint).
 
     Every one of these 33 circuit passes is BLOCK-LOCAL: H(q) acts on a
     lane/sublane/fiber bit, and ladder(q)'s angle pi*bit_q*(k mod 2^q)/2^q
@@ -131,37 +133,51 @@ def _qft_tail_kernel(h7_ref, hs_ref, re_ref, im_ref, ore_ref, oim_ref):
             x, m, dimension_numbers=(((1,), (1,)), ((), ())),
             precision=hp, preferred_element_type=x.dtype)
 
-    for q in range(16, -1, -1):
+    def hadamard(xr, xi, q):
         if q >= 10:  # fiber bit: left-multiply over the leading axis
             m = h7_ref[q - 10]
-            xr = ldot(m, xr.reshape(f, s * l)).reshape(f, s, l)
-            xi = ldot(m, xi.reshape(f, s * l)).reshape(f, s, l)
-        elif q >= 7:  # sublane bit (left-multiply, S leading — see
+            return (ldot(m, xr.reshape(f, s * l)).reshape(f, s, l),
+                    ldot(m, xi.reshape(f, s * l)).reshape(f, s, l))
+        if q >= 7:  # sublane bit (left-multiply, S leading — see
             m = hs_ref[q - 7]  # _layer17_kernel's csub rationale)
             a = xr.transpose(1, 0, 2).reshape(s, f * l)
             b = xi.transpose(1, 0, 2).reshape(s, f * l)
-            xr = ldot(m, a).reshape(s, f, l).transpose(1, 0, 2)
-            xi = ldot(m, b).reshape(s, f, l).transpose(1, 0, 2)
-        else:  # lane bit: right-multiply over the minor axis
-            m = h7_ref[q]
-            xr = rdot(xr.reshape(f * s, l), m).reshape(f, s, l)
-            xi = rdot(xi.reshape(f * s, l), m).reshape(f, s, l)
-        if q:  # the fused controlled-phase ladder following H(q)
-            c, sn = _ladder_cos_sin(k, q)
-            xr, xi = xr * c - xi * sn, xr * sn + xi * c
+            return (ldot(m, a).reshape(s, f, l).transpose(1, 0, 2),
+                    ldot(m, b).reshape(s, f, l).transpose(1, 0, 2))
+        m = h7_ref[q]  # lane bit: right-multiply over the minor axis
+        return (rdot(xr.reshape(f * s, l), m).reshape(f, s, l),
+                rdot(xi.reshape(f * s, l), m).reshape(f, s, l))
+
+    def ladder(xr, xi, q):
+        c, sn = _ladder_cos_sin(k, q)
+        if inverse:
+            sn = -sn
+        return xr * c - xi * sn, xr * sn + xi * c
+
+    if inverse:  # adjoint order: ladder^-1(q) then H(q), q ascending
+        for q in range(17):
+            if q:
+                xr, xi = ladder(xr, xi, q)
+            xr, xi = hadamard(xr, xi, q)
+    else:
+        for q in range(16, -1, -1):
+            xr, xi = hadamard(xr, xi, q)
+            if q:
+                xr, xi = ladder(xr, xi, q)
     ore_ref[...] = xr
     oim_ref[...] = xi
 
 
-def _apply_tail_p(re, im):
-    """Run the 17-qubit QFT tail (stages q=16..0) in ONE in-place HBM pass
-    (geometry and aliasing exactly as pallas_layer._apply_layer17_p)."""
+def _apply_tail_p(re, im, inverse: bool = False):
+    """Run the 17-qubit QFT tail (stages q=16..0, or its adjoint) in ONE
+    in-place HBM pass (geometry and aliasing exactly as
+    pallas_layer._apply_layer17_p)."""
     top, shape3 = _shape3(re.shape[0])
     h7 = np.stack([_axis_h(j, 7) for j in range(7)])  # lane AND fiber
     hs = np.stack([_axis_h(j, 3) for j in range(3)])
 
     run = pl.pallas_call(
-        _qft_tail_kernel,
+        partial(_qft_tail_kernel, inverse),
         interpret=_interpret(),
         grid=(top,),
         in_specs=[
@@ -206,22 +222,24 @@ def _ladder_diag(re, im, q: int):
     return re * c - im * s, re * s + im * c
 
 
-def _ladder_kernel(q: int, re_ref, im_ref, ore_ref, oim_ref):
+def _ladder_kernel(q: int, inverse: bool, re_ref, im_ref, ore_ref, oim_ref):
     """Block-local ladder rotation: out block (i) reads only in block (i),
     so the planes alias their outputs — the rotation runs in place."""
     xr = re_ref[...]
     xi = im_ref[...]
     k = _block_k(xr.shape, pl.program_id(0) * jnp.int32(LANE * SUB * LANE))
     c, sn = _ladder_cos_sin(k, q)
+    if inverse:
+        sn = -sn
     ore_ref[...] = xr * c - xi * sn
     oim_ref[...] = xr * sn + xi * c
 
 
-def _ladder_pallas(re, im, q: int):
+def _ladder_pallas(re, im, q: int, inverse: bool = False):
     """In-place ladder pass on the 3-D flat-ordered view (free bitcast)."""
     top, shape3 = _shape3(re.shape[0])
     run = pl.pallas_call(
-        partial(_ladder_kernel, q),
+        partial(_ladder_kernel, q, inverse),
         interpret=_interpret(),
         grid=(top,),
         in_specs=[_state_spec(), _state_spec()],
@@ -267,33 +285,55 @@ def _bit_reverse(plane, n: int):
     return x.reshape(-1)
 
 
-@partial(jax.jit, donate_argnums=(0, 1), static_argnames=("bit_reversal",))
-def _qft_all(re, im, bit_reversal: bool):
+def _reverse_planes(re, im, n):
+    # Reverse the planes STRICTLY one after the other: each reversal peaks
+    # at in+out (it cannot alias), and letting the scheduler interleave the
+    # two puts four state-sized buffers in flight.  The barrier pins im's
+    # reversal behind re's completion.
+    re = _bit_reverse(re, n)
+    re, im = jax.lax.optimization_barrier((re, im))
+    return re, _bit_reverse(im, n)
+
+
+def _h_flip_stage(re, im, q, n):
+    # H per plane, barriered so the two flip passes never hold four
+    # state-sized buffers at once
+    re = _h_flip(re, q, n)
+    re, im = jax.lax.optimization_barrier((re, im))
+    return re, _h_flip(im, q, n)
+
+
+@partial(jax.jit, donate_argnums=(0, 1),
+         static_argnames=("bit_reversal", "inverse"))
+def _qft_all(re, im, bit_reversal: bool, inverse: bool):
     n = int(re.shape[0]).bit_length() - 1
-    for q in range(n - 1, 16, -1):
-        # H per plane, barriered so the two flip passes never hold four
-        # state-sized buffers at once; then the fused phase ladder
-        re = _h_flip(re, q, n)
-        re, im = jax.lax.optimization_barrier((re, im))
-        im = _h_flip(im, q, n)
-        re, im = _ladder_pallas(re, im, q)
-    # stages q=16..0 are block-local: one Pallas pass applies all 33 of them
-    re, im = _apply_tail_p(re, im)
-    if bit_reversal:
-        # Reverse the planes STRICTLY one after the other: each reversal
-        # peaks at in+out (it cannot alias), and letting the scheduler
-        # interleave the two puts four state-sized buffers in flight.  The
-        # barrier pins im's reversal behind re's completion.
-        re = _bit_reverse(re, n)
-        re, im = jax.lax.optimization_barrier((re, im))
-        im = _bit_reverse(im, n)
+    if not inverse:
+        for q in range(n - 1, 16, -1):
+            re, im = _h_flip_stage(re, im, q, n)
+            re, im = _ladder_pallas(re, im, q)
+        # stages q=16..0 are block-local: ONE Pallas pass for all 33
+        re, im = _apply_tail_p(re, im)
+        if bit_reversal:
+            re, im = _reverse_planes(re, im, n)
+    else:
+        # adjoint, stages reversed: (un)reverse first, then the tail's
+        # adjoint, then ladder^-1(q) before H(q) for q ascending
+        if bit_reversal:
+            re, im = _reverse_planes(re, im, n)
+        re, im = _apply_tail_p(re, im, inverse=True)
+        for q in range(17, n):
+            re, im = _ladder_pallas(re, im, q, inverse=True)
+            re, im = _h_flip_stage(re, im, q, n)
     return re, im
 
 
-def qft_planes(re: jax.Array, im: jax.Array, *, bit_reversal: bool = True):
-    """Full QFT on plane-pair storage (matching circuit.qft_circuit's
-    convention when ``bit_reversal`` is True).  CONSUMES both planes.  f32,
-    n >= 17 (the Pallas layer-engine floor).
+def qft_planes(re: jax.Array, im: jax.Array, *, bit_reversal: bool = True,
+               inverse: bool = False):
+    """Full QFT — or, with ``inverse``, its adjoint — on plane-pair storage
+    (matching circuit.qft_circuit's convention when ``bit_reversal`` is
+    True).  CONSUMES both planes.  f32, n >= 17 (the Pallas layer-engine
+    floor).  ``inverse=True`` undoes the forward transform of the SAME
+    ``bit_reversal`` mode (the common primitive of phase estimation).
 
     ``bit_reversal=False`` returns the transform in bit-reversed amplitude
     order — amplitude k of the true QFT lands at index reverse_n(k) — the
@@ -309,4 +349,4 @@ def qft_planes(re: jax.Array, im: jax.Array, *, bit_reversal: bool = True):
     if re.dtype != jnp.float32 or im.dtype != jnp.float32:
         raise ValueError(f"in-place QFT is f32-only, got {re.dtype}/{im.dtype}")
     with jax.enable_x64(False):
-        return _qft_all(re, im, bit_reversal)
+        return _qft_all(re, im, bit_reversal, inverse)

@@ -144,3 +144,21 @@ def test_ladder_pallas_matches_xla_form(q):
                                atol=2e-6)
     np.testing.assert_allclose(np.asarray(got_im), np.asarray(want_im),
                                atol=2e-6)
+
+
+@pytest.mark.parametrize("bit_reversal", [True, False])
+def test_qft_inverse_roundtrip(bit_reversal):
+    """inverse=True undoes the forward transform of the same ordering mode
+    (the phase-estimation primitive)."""
+    from quest_tpu.ops.qft_inplace import qft_planes
+
+    n = 17
+    rng = np.random.default_rng(11)
+    amps = rng.normal(size=(2, 1 << n)).astype(np.float32)
+    amps /= np.sqrt((amps ** 2).sum())
+
+    re, im = qft_planes(jnp.asarray(amps[0]), jnp.asarray(amps[1]),
+                        bit_reversal=bit_reversal)
+    re, im = qft_planes(re, im, bit_reversal=bit_reversal, inverse=True)
+    np.testing.assert_allclose(np.asarray(re), amps[0], atol=2e-6)
+    np.testing.assert_allclose(np.asarray(im), amps[1], atol=2e-6)
