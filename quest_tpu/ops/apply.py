@@ -746,7 +746,14 @@ def apply_matrix_density(state: jax.Array, u: jax.Array, targets: tuple,
     compiled program (the reference dispatches these as two kernel calls,
     ref: QuEST.c:8-10 + the densityMatrix branches of each API fn; fusing
     them halves the per-gate dispatch overhead of the eager density path and
-    lets XLA schedule the two passes together)."""
+    lets XLA schedule the two passes together).
+
+    Note: this fusion supersedes the opt-in eager Pallas kernel
+    (QUEST_TPU_PALLAS=1) for density matrices — inside the jitted program
+    the state is a tracer, so apply_matrix's eager-kernel branch cannot
+    engage.  That is the better trade: the flag's measured win was over
+    per-gate EAGER dispatch, and the fused program removes one of the two
+    dispatches outright.  The flag still applies to statevector gates."""
     if not control_states:
         control_states = (1,) * len(controls)
     state = _apply_matrix_xla(state, u, targets, controls, control_states)

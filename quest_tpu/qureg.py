@@ -12,6 +12,8 @@ Ref analogue: struct Qureg (QuEST.h:203-234).  Differences by design:
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -21,20 +23,34 @@ from .qasm import QASMLogger
 from .validation import validate_create_num_qubits
 
 
+@functools.lru_cache(maxsize=64)
+def _repin_fn(sharding):
+    """Compiled identity resharding to ``sharding``.  Cached per sharding:
+    jit caches traces on the function OBJECT, so a fresh lambda per call
+    would retrace + recompile every reshard."""
+    return jax.jit(lambda x: x, out_shardings=sharding)
+
+
 def _repin(value: jax.Array, sharding) -> jax.Array:
     """Re-lay ``value`` out as ``sharding``.
 
-    ``jax.device_put`` handles the common case, but when the compiler handed
-    back a non-Named sharding whose device order differs from the mesh's
-    (observed on multi-process meshes), jax's eager reshard path asserts
-    (dispatch.py ``_different_device_order_reshard`` requires a
-    NamedSharding input).  The compiled identity is the universally valid
-    reshard — XLA inserts whatever collectives the layout change needs —
-    and jax caches the compilation per (shape, dtype, src, dst)."""
+    The compiled identity is the primary path: it is the universally valid
+    reshard (``jax.device_put``'s eager path asserts on non-Named shardings
+    with a different device order, observed on multi-process meshes — jax
+    dispatch.py ``_different_device_order_reshard``), it dispatches
+    asynchronously, and the trace is cached per sharding.  ``device_put``
+    remains as the fallback should a sharding ever reject the jit route.
+
+    A drifted eager op pays one resharding pass here; the deeper fix —
+    pinning the layout inside each op's compiled program via
+    ``with_sharding_constraint`` (a static ``out_sharding`` argument on the
+    op layer) — would remove the corrective pass entirely and is the
+    natural next step if eager multi-device dispatch becomes a hot path
+    (compiled whole-circuit programs never take this branch)."""
     try:
-        return jax.device_put(value, sharding)
+        return _repin_fn(sharding)(value)
     except Exception:
-        return jax.jit(lambda x: x, out_shardings=sharding)(value)
+        return jax.device_put(value, sharding)
 
 
 class Qureg:
