@@ -62,6 +62,8 @@ GROUPS = [
     ("TPU-native extensions", ["set_precision", "get_precision", "Circuit",
                                "compile_circuit", "apply_circuit", "random_circuit",
                                "qft_circuit"]),
+    ("Differentiable simulation", ["Param", "ParamCircuit", "build_param_circuit",
+                                   "state_fn", "expectation_fn"]),
 ]
 
 

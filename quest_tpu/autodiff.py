@@ -1,0 +1,360 @@
+"""Differentiable parametric circuits: jax.grad / jax.vmap / optax-native
+variational simulation.
+
+No reference analogue — QuEST (C99, ref: /root/reference/QuEST) has no
+gradient capability at all; a VQE/QAOA user of the reference must build
+parameter-shift differentiation by hand, one full circuit execution per
+shifted parameter (2·P executions per gradient).  This module is the
+capability the TPU re-architecture buys outright: the gate engine
+(ops/apply.py) keeps matrices as *runtime values* inside the traced program,
+so gates built from traced parameters make the whole simulation one
+differentiable XLA program — `jax.grad` computes the full parameter gradient
+in a single forward+adjoint pass, `jax.vmap` batches circuit executions over
+parameter sets onto the MXU, and both compose with the same GSPMD sharding
+as every other program in the framework (the state argument may live on a
+device mesh; parameters are replicated and the adjoint's psum is inserted by
+the partitioner).
+
+Structure stays static, parameters stay traced: a :class:`ParamCircuit`
+records the gate list host-side exactly like :class:`~quest_tpu.circuit.Circuit`
+(whose static gates it inherits), but rotation angles may be
+:class:`Param` placeholders — indices into a flat parameter vector, with an
+optional affine transform (``2.0 * p``, ``p + shift``) resolved inside the
+trace.  Density-matrix mode applies the conjugated column-side shadow of
+every gate (same rule as the eager API, ref: QuEST.c:8-10) and additionally
+admits *differentiable noise*: the decoherence channels (ops/decoherence.py)
+already take their probabilities as traced scalars, so channel strengths can
+be Params too — gradients through dephasing/depolarising/damping come from
+the same adjoint pass.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .circuit import Circuit, GateOp, _apply_one, _shadow_op
+from .ops import apply as _ap
+from .ops import calc as _calc
+from .ops import decoherence as _dec
+from . import precision as _prec
+
+__all__ = ["Param", "ParamCircuit", "build", "state_fn", "expectation_fn"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Param:
+    """Placeholder for entry ``index`` of the parameter vector, carrying an
+    affine transform: the traced angle is ``scale * params[index] + shift``.
+    Supports ``2.0 * p``, ``-p``, ``p + 0.5``, ``p - 0.5``."""
+
+    index: int
+    scale: float = 1.0
+    shift: float = 0.0
+
+    def __mul__(self, f):
+        f = float(f)
+        return Param(self.index, self.scale * f, self.shift * f)
+
+    __rmul__ = __mul__
+
+    def __neg__(self):
+        return self * -1.0
+
+    def __add__(self, f):
+        return Param(self.index, self.scale, self.shift + float(f))
+
+    __radd__ = __add__
+
+    def __sub__(self, f):
+        return self + (-float(f))
+
+
+@dataclasses.dataclass(frozen=True)
+class ParamOp:
+    """A recorded parametric operation.  ``param`` is a Param or a float
+    (floats trace as constants, so a ParamCircuit needs no special-casing of
+    bound angles).  ``codes`` carries the Pauli string for kind 'mrp'."""
+
+    kind: str          # rx|ry|rz|phase|mrz|mrp|dephase|dephase2|depolarise|damp
+    targets: tuple
+    controls: tuple = ()
+    control_states: tuple = ()
+    param: object = None
+    codes: tuple | None = None
+
+
+_NOISE_KINDS = ("dephase", "dephase2", "depolarise", "damp")
+
+
+class ParamCircuit(Circuit):
+    """A Circuit whose rotation angles (and channel probabilities, in density
+    mode) may be traced parameters.  Static gates (h, x, cnot, unitary, …)
+    are inherited from :class:`Circuit` and embedded as constants."""
+
+    def __init__(self, num_qubits: int):
+        super().__init__(num_qubits)
+        self.num_params = 0
+
+    # --- parameter allocation ---------------------------------------------
+    def param(self) -> Param:
+        """Allocate the next parameter slot and return its placeholder."""
+        p = Param(self.num_params)
+        self.num_params += 1
+        return p
+
+    def params(self, k: int) -> list:
+        return [self.param() for _ in range(k)]
+
+    # --- parametric gates --------------------------------------------------
+    def _p(self, kind, targets, angle, controls=(), control_states=(), codes=None):
+        self.ops.append(ParamOp(kind, tuple(targets), tuple(controls),
+                                tuple(control_states), angle, codes))
+        return self
+
+    def rx(self, target, angle):
+        if not isinstance(angle, Param):
+            return super().rx(target, angle)
+        return self._p("rx", (target,), angle)
+
+    def ry(self, target, angle):
+        if not isinstance(angle, Param):
+            return super().ry(target, angle)
+        return self._p("ry", (target,), angle)
+
+    def rz(self, target, angle):
+        if not isinstance(angle, Param):
+            return super().rz(target, angle)
+        return self._p("rz", (target,), angle)
+
+    def phase_shift(self, target, angle, controls=()):
+        if not isinstance(angle, Param):
+            return super().phase_shift(target, angle, controls)
+        return self._p("phase", (target,), angle, tuple(controls))
+
+    def multi_rotate_z(self, targets, angle):
+        """exp(-i angle/2 Z⊗..⊗Z) on ``targets`` (ref: multiRotateZ)."""
+        return self._p("mrz", tuple(targets), angle)
+
+    def multi_rotate_pauli(self, targets, paulis, angle):
+        """exp(-i angle/2 P⊗..) for a Pauli string (ref: multiRotatePauli,
+        QuEST_common.c:411-448 — basis-change to Z, parity rotation, undo)."""
+        codes = tuple(int(p) for p in paulis)
+        assert len(codes) == len(tuple(targets))
+        return self._p("mrp", tuple(targets), angle, codes=codes)
+
+    # --- parametric noise channels (density mode only) ---------------------
+    def dephase(self, target, prob):
+        """mixDephasing with a (possibly trained) probability."""
+        return self._p("dephase", (target,), prob)
+
+    def two_qubit_dephase(self, q1, q2, prob):
+        return self._p("dephase2", (q1, q2), prob)
+
+    def depolarise(self, target, prob):
+        return self._p("depolarise", (target,), prob)
+
+    def damp(self, target, prob):
+        return self._p("damp", (target,), prob)
+
+    def optimize(self, max_pack: int = 7):
+        """The native fusion engine packs static matrices only; a circuit
+        with parametric ops must stay unfused (XLA still fuses elementwise
+        chains inside the compiled program)."""
+        if any(isinstance(op, ParamOp) for op in self.ops):
+            raise ValueError(
+                "ParamCircuit.optimize: native gate fusion requires static "
+                "gates; run optimize() before adding parametric ops")
+        return super().optimize(max_pack)
+
+
+# ---------------------------------------------------------------------------
+# traced gate construction
+# ---------------------------------------------------------------------------
+
+def _angle(p, params):
+    # params is coerced to a float dtype by _runner, so constants keep their
+    # fractional part and Param affine transforms stay exact
+    if isinstance(p, Param):
+        return params[p.index] * p.scale + p.shift
+    return jnp.asarray(p, dtype=params.dtype)
+
+
+def _rx_pair(theta):
+    c, s = jnp.cos(theta / 2), jnp.sin(theta / 2)
+    z = jnp.zeros_like(c)
+    re = jnp.stack([jnp.stack([c, z]), jnp.stack([z, c])])
+    im = jnp.stack([jnp.stack([z, -s]), jnp.stack([-s, z])])
+    return jnp.stack([re, im])
+
+
+def _ry_pair(theta):
+    c, s = jnp.cos(theta / 2), jnp.sin(theta / 2)
+    re = jnp.stack([jnp.stack([c, -s]), jnp.stack([s, c])])
+    return jnp.stack([re, jnp.zeros_like(re)])
+
+
+def _rz_diag(theta):
+    h = theta / 2
+    return jnp.stack([jnp.stack([jnp.cos(h), jnp.cos(h)]),
+                      jnp.stack([-jnp.sin(h), jnp.sin(h)])])
+
+
+def _phase_diag(theta):
+    one, zero = jnp.ones_like(theta), jnp.zeros_like(theta)
+    return jnp.stack([jnp.stack([one, jnp.cos(theta)]),
+                      jnp.stack([zero, jnp.sin(theta)])])
+
+
+def _apply_mrp(state, theta, targets, codes, conj):
+    """multiRotatePauli with a traced angle: the eager API's implementation
+    (basis-change to Z, parity rotation, undo — api.py
+    _multi_rotate_pauli_statevec) is trace-compatible, so reuse it."""
+    from .api import _multi_rotate_pauli_statevec  # lazy: api is the upper layer
+
+    return _multi_rotate_pauli_statevec(state, targets, codes, theta, conj)
+
+
+def _apply_param_op(state, op: ParamOp, params, shadow_n: int | None):
+    """Apply one parametric op; if ``shadow_n`` is set (density mode), also
+    apply the conjugated column-side twin on targets/controls + n.  The
+    conjugate of exp(-iθG/2) is the same gate at -θ for real generators
+    (rx, rz, phase, mrz) and at +θ for ry (imaginary generator)."""
+    theta = _angle(op.param, params)
+    t, c, cs = op.targets, op.controls, op.control_states
+    dt = state.dtype
+
+    if op.kind in _NOISE_KINDS:
+        if shadow_n is None:
+            raise ValueError(
+                f"noise op {op.kind!r} requires density=True (channels act on "
+                "the doubled Choi space)")
+        prob = theta
+        if op.kind == "dephase":
+            return _dec.mix_dephasing(state, prob, t[0], shadow_n)
+        if op.kind == "dephase2":
+            return _dec.mix_two_qubit_dephasing(state, prob, t[0], t[1], shadow_n)
+        if op.kind == "depolarise":
+            return _dec.mix_depolarising(state, prob, t[0], shadow_n)
+        return _dec.mix_damping(state, prob, t[0], shadow_n)
+
+    sides = [(t, c, False)]
+    if shadow_n is not None:
+        sides.append((tuple(q + shadow_n for q in t),
+                      tuple(q + shadow_n for q in c), True))
+    for targets, controls, conj in sides:
+        a = -theta if (conj and op.kind != "ry") else theta
+        if op.kind == "rx":
+            state = _ap.apply_matrix(state, _rx_pair(a).astype(dt), targets,
+                                     controls, cs)
+        elif op.kind == "ry":
+            state = _ap.apply_matrix(state, _ry_pair(a).astype(dt), targets,
+                                     controls, cs)
+        elif op.kind == "rz":
+            state = _ap.apply_diagonal(state, _rz_diag(a).astype(dt), targets,
+                                       controls, cs)
+        elif op.kind == "phase":
+            state = _ap.apply_diagonal(state, _phase_diag(a).astype(dt),
+                                       targets, controls, cs)
+        elif op.kind == "mrz":
+            state = _ap.apply_multi_rotate_z(state, a, targets)
+        elif op.kind == "mrp":
+            state = _apply_mrp(state, theta, targets, op.codes, conj)
+        else:
+            raise ValueError(f"unknown parametric op kind {op.kind!r}")
+    return state
+
+
+# ---------------------------------------------------------------------------
+# program construction
+# ---------------------------------------------------------------------------
+
+def _runner(pc: ParamCircuit, density: bool):
+    ops = tuple(pc.ops)
+    n = pc.num_qubits
+
+    def run(params, state):
+        params = jnp.asarray(params)
+        if not jnp.issubdtype(params.dtype, jnp.floating):
+            params = params.astype(_prec.CONFIG.real_dtype)
+        for op in ops:
+            if isinstance(op, GateOp):
+                state = _apply_one(state, op)
+                if density:
+                    state = _apply_one(state, _shadow_op(op, n))
+            else:
+                state = _apply_param_op(state, op, params,
+                                        n if density else None)
+        return state
+
+    return run
+
+
+def build(pc: ParamCircuit, density: bool = False):
+    """Compile to a jitted pure ``(params, state) -> state``.
+
+    ``state`` is the usual (2, 2^m) SoA real pair (m = n for statevectors,
+    2n Choi-flattened for ``density=True``) and may be sharded over a device
+    mesh; ``params`` is a flat real vector of ``pc.num_params`` entries.
+    The result differentiates (``jax.grad`` w.r.t. params or state) and
+    vmaps (batched params and/or states)."""
+    return jax.jit(_runner(pc, density))
+
+
+def _zero_state(num_qubits: int, density: bool, dtype):
+    m = 2 * num_qubits if density else num_qubits
+    return jnp.zeros((2, 1 << m), dtype=dtype).at[0, 0].set(1.0)
+
+
+def state_fn(pc: ParamCircuit, init=None, density: bool = False):
+    """Jitted ``params -> state``: the circuit applied to ``init`` (default
+    |0…0⟩, or ρ=|0…0⟩⟨0…0| in density mode).  ``init`` may be an amplitude
+    pair array or a Qureg (whose density flag then wins)."""
+    init, density = _resolve_init(pc, init, density)
+    run = _runner(pc, density)
+
+    @jax.jit
+    def fn(params):
+        state = (_zero_state(pc.num_qubits, density, _prec.CONFIG.real_dtype)
+                 if init is None else init)
+        return run(params, state)
+
+    return fn
+
+
+def _resolve_init(pc, init, density):
+    if init is None:
+        return None, density
+    if hasattr(init, "amps") and hasattr(init, "is_density_matrix"):  # Qureg
+        return init.amps, init.is_density_matrix
+    return jnp.asarray(init), density
+
+
+def expectation_fn(pc: ParamCircuit, hamil, init=None, density: bool = False):
+    """Jitted ``params -> <H>``: run the circuit from ``init`` and evaluate
+    the PauliHamil expectation with the fused one-pass Pauli-sum kernel
+    (ops/calc.py — no workspace clone, one lax.scan over terms).  This is the
+    VQE/QAOA objective: compose with ``jax.value_and_grad`` for energy and
+    full gradient in one forward+adjoint program, or ``jax.vmap`` for
+    batched multi-start optimisation."""
+    from .api import _pauli_sum_masks  # lazy: api imports circuit at import time
+
+    xm, zym, yc = _pauli_sum_masks(np.asarray(hamil.pauli_codes))
+    cf = jnp.asarray(np.asarray(hamil.term_coeffs, dtype=np.float64))
+    init, density = _resolve_init(pc, init, density)
+    run = _runner(pc, density)
+    n = pc.num_qubits
+
+    @jax.jit
+    def energy(params):
+        state = (_zero_state(n, density, _prec.CONFIG.real_dtype)
+                 if init is None else init)
+        state = run(params, state)
+        if density:
+            return _calc.expec_pauli_sum_densmatr(state, xm, zym, yc, cf, n)
+        return _calc.expec_pauli_sum_statevec(state, xm, zym, yc, cf)
+
+    return energy

@@ -6,3 +6,6 @@ BASELINE.md)."""
 from .algorithms import (bernstein_vazirani_circuit, ghz_circuit,  # noqa: F401
                          grover_circuit, phase_estimation_circuit,
                          qft_circuit, random_circuit, trotter_circuit)
+from .variational import (hardware_efficient_ansatz, maxcut_hamiltonian,  # noqa: F401
+                          pauli_sum_matrix, qaoa_maxcut_circuit,
+                          tfim_hamiltonian)

@@ -1,0 +1,107 @@
+"""Variational circuit families and Hamiltonian builders (VQE / QAOA).
+
+No reference analogue: these are the workloads the differentiable layer
+(quest_tpu/autodiff.py) exists for.  Everything returns either a
+:class:`~quest_tpu.autodiff.ParamCircuit` (trainable structure) or a
+:class:`~quest_tpu.matrices.PauliHamil` (observable), so objectives compose
+as ``expectation_fn(circuit, hamil)`` → jax.value_and_grad / optax.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..autodiff import ParamCircuit
+from ..matrices import PauliHamil
+
+__all__ = ["hardware_efficient_ansatz", "qaoa_maxcut_circuit",
+           "maxcut_hamiltonian", "tfim_hamiltonian", "pauli_sum_matrix"]
+
+_I, _X, _Y, _Z = 0, 1, 2, 3
+
+
+def hardware_efficient_ansatz(num_qubits: int, layers: int,
+                              final_rotations: bool = True) -> ParamCircuit:
+    """The standard hardware-efficient VQE ansatz: per-layer Ry+Rz rotations
+    on every qubit followed by a brickwork CZ entangler, with an optional
+    closing rotation layer.  Parameters: ``(layers + final) * 2 * n``."""
+    pc = ParamCircuit(num_qubits)
+    for layer in range(layers):
+        for q in range(num_qubits):
+            pc.ry(q, pc.param())
+            pc.rz(q, pc.param())
+        for q in range(layer % 2, num_qubits - 1, 2):
+            pc.cz(q, q + 1)
+    if final_rotations:
+        for q in range(num_qubits):
+            pc.ry(q, pc.param())
+            pc.rz(q, pc.param())
+    return pc
+
+
+def qaoa_maxcut_circuit(num_qubits: int, edges, p: int) -> ParamCircuit:
+    """Depth-``p`` QAOA for MaxCut: |+…+⟩, then alternating cost layers
+    exp(-iγ Z_a Z_b) per edge and mixer layers exp(-iβ X_q).  Parameter
+    layout: [γ_1, β_1, …, γ_p, β_p] (2p parameters; each γ/β is shared by
+    its whole layer via the Param affine transform)."""
+    pc = ParamCircuit(num_qubits)
+    for q in range(num_qubits):
+        pc.h(q)
+    for _ in range(p):
+        gamma = pc.param()
+        for a, b in edges:
+            # exp(-iγ ZZ) = multiRotateZ(2γ) on (a, b)
+            pc.multi_rotate_z((a, b), 2.0 * gamma)
+        beta = pc.param()
+        for q in range(num_qubits):
+            pc.rx(q, 2.0 * beta)
+    return pc
+
+
+def maxcut_hamiltonian(num_qubits: int, edges) -> PauliHamil:
+    """C = Σ_(a,b) (Z_a Z_b − 1)/2 — minimised at −(max cut size), so the
+    QAOA objective is a plain energy minimisation."""
+    edges = list(edges)
+    terms = len(edges) + 1
+    h = PauliHamil(num_qubits, terms)
+    for t, (a, b) in enumerate(edges):
+        h.pauli_codes[t, a] = _Z
+        h.pauli_codes[t, b] = _Z
+        h.term_coeffs[t] = 0.5
+    h.term_coeffs[-1] = -0.5 * len(edges)  # identity term (all codes 0)
+    return h
+
+
+def tfim_hamiltonian(num_qubits: int, field: float = 1.0,
+                     coupling: float = 1.0, periodic: bool = False) -> PauliHamil:
+    """Transverse-field Ising chain H = −J Σ Z_i Z_{i+1} − h Σ X_i — the
+    standard VQE testbed with a nontrivial entangled ground state."""
+    n = num_qubits
+    bonds = [(i, (i + 1) % n) for i in range(n if periodic and n > 2 else n - 1)]
+    h = PauliHamil(n, len(bonds) + n)
+    for t, (a, b) in enumerate(bonds):
+        h.pauli_codes[t, a] = _Z
+        h.pauli_codes[t, b] = _Z
+        h.term_coeffs[t] = -coupling
+    for q in range(n):
+        h.pauli_codes[len(bonds) + q, q] = _X
+        h.term_coeffs[len(bonds) + q] = -field
+    return h
+
+
+_P1 = {_I: np.eye(2), _X: np.array([[0, 1], [1, 0]], dtype=complex),
+       _Y: np.array([[0, -1j], [1j, 0]]), _Z: np.diag([1.0, -1.0]).astype(complex)}
+
+
+def pauli_sum_matrix(hamil: PauliHamil) -> np.ndarray:
+    """Dense 2^n × 2^n matrix of a PauliHamil (host-side; for exact
+    diagonalisation baselines in tests/examples).  Qubit 0 is the
+    least-significant index bit, matching the amplitude ordering."""
+    dim = 1 << hamil.num_qubits
+    out = np.zeros((dim, dim), dtype=complex)
+    for t in range(hamil.num_sum_terms):
+        m = np.eye(1, dtype=complex)
+        for q in range(hamil.num_qubits):  # qubit 0 least significant: kron from the top
+            m = np.kron(_P1[int(hamil.pauli_codes[t, q])], m)
+        out += hamil.term_coeffs[t] * m
+    return out
