@@ -49,7 +49,8 @@ GROUPS = [
                      "mixDensityMatrix", "mixKrausMap", "mixTwoQubitKrausMap",
                      "mixMultiQubitKrausMap"]),
     ("Measurement & calculations", ["measure", "measureWithStats", "collapseToOutcome",
-                   "calcProbOfOutcome", "calcTotalProb", "getAmp", "getRealAmp",
+                   "calcProbOfOutcome", "calcProbOfAllOutcomes", "sampleOutcomes",
+                   "calcTotalProb", "getAmp", "getRealAmp",
                    "getImagAmp", "getProbAmp", "getDensityAmp", "calcInnerProduct",
                    "calcDensityInnerProduct", "calcPurity", "calcFidelity",
                    "calcHilbertSchmidtDistance", "calcExpecPauliProd",

@@ -105,11 +105,12 @@ def trainable_noise():
         purity = jnp.sum(rho[0] ** 2 + rho[1] ** 2)
         return (purity - target_purity) ** 2
 
+    grad_fn = jax.jit(jax.grad(loss))
     p = jnp.asarray([0.05])
     opt = optax.adam(0.02)
     st = opt.init(p)
     for _ in range(200):
-        g = jax.grad(loss)(p)
+        g = grad_fn(p)
         up, st = opt.update(g, st)
         p = optax.apply_updates(p, up)
     print(f"  fitted damping rate: {float(p[0]):.4f}  "

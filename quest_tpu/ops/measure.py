@@ -82,3 +82,42 @@ def densmatr_collapse_to_outcome(state: jax.Array, target: int, outcome: int,
     dr = jnp.zeros(4, dtype=_ACC).at[3 * outcome].set(1.0 / outcome_prob.astype(_ACC))
     d = jnp.stack([dr, jnp.zeros_like(dr)])
     return apply_diagonal(state, d, (int(target), int(target) + num_qubits))
+
+
+# ---------------------------------------------------------------------------
+# joint outcome distributions (TPU-native extension; the reference can only
+# query one qubit at a time — calcProbOfOutcome)
+# ---------------------------------------------------------------------------
+
+def _group_probs(weights: jax.Array, n: int, targets: tuple) -> jax.Array:
+    """Sum ``weights`` (2^n, f64) into the 2^k joint-outcome histogram of the
+    ``targets`` bits: outcome index bit i = state bit targets[i].  One fused
+    iota keys a segment-sum — a single scatter-add pass, no reshape (so no
+    tile-padding hazard at any n, and GSPMD turns the segment ids into a
+    shard-local scatter + psum under a sharded state)."""
+    if tuple(targets) == tuple(range(n)):
+        return weights  # identity grouping: the histogram IS the weight vector
+    dt = jnp.uint32 if n <= 32 else jnp.uint64
+    k = jax.lax.iota(dt, 1 << n)
+    idx = jnp.zeros_like(k)
+    for i, q in enumerate(targets):
+        idx = idx | (((k >> int(q)) & 1) << i)
+    return jax.ops.segment_sum(weights, idx.astype(jnp.int32),
+                               num_segments=1 << len(targets))
+
+
+@partial(jax.jit, static_argnames=("targets",))
+def prob_all_outcomes(state: jax.Array, targets: tuple) -> jax.Array:
+    """Joint probability of every outcome of the ``targets`` qubits of a
+    statevector, as a 2^k f64 vector."""
+    n = num_qubits_of(state)
+    re, im = state[0].astype(_ACC), state[1].astype(_ACC)
+    return _group_probs(re * re + im * im, n, targets)
+
+
+@partial(jax.jit, static_argnames=("targets", "num_qubits"))
+def densmatr_prob_all_outcomes(state: jax.Array, targets: tuple,
+                               num_qubits: int) -> jax.Array:
+    """Joint outcome distribution from the density-matrix diagonal."""
+    diag = densmatr_diagonal(state, num_qubits)[0].astype(_ACC)
+    return _group_probs(diag, num_qubits, targets)

@@ -1,0 +1,115 @@
+"""Joint outcome distributions and multi-shot sampling (TPU-native
+extensions: calcProbOfAllOutcomes / sampleOutcomes — the reference's v3.2
+surface queries one qubit at a time)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from oracle import NUM_QUBITS, SV_TOL, random_density_matrix, random_statevector, set_dm, set_sv
+
+N = NUM_QUBITS
+
+
+def _oracle_probs(weights: np.ndarray, qubits) -> np.ndarray:
+    """Independent reduction: loop over every state index."""
+    out = np.zeros(1 << len(qubits))
+    for k, w in enumerate(weights):
+        idx = 0
+        for i, q in enumerate(qubits):
+            idx |= ((k >> q) & 1) << i
+        out[idx] += w
+    return out
+
+
+@pytest.mark.parametrize("qubits", [[0], [2], [0, 1], [3, 1], [4, 0, 2],
+                                    list(range(N))])
+def test_prob_all_outcomes_statevector(env, qubits):
+    psi = qt.createQureg(N, env)
+    vec = random_statevector(N)
+    set_sv(psi, vec)
+    got = qt.calcProbOfAllOutcomes(psi, qubits)
+    np.testing.assert_allclose(got, _oracle_probs(np.abs(vec) ** 2, qubits),
+                               atol=10 * SV_TOL)
+    assert np.sum(got) == pytest.approx(1.0, abs=10 * SV_TOL)
+
+
+@pytest.mark.parametrize("qubits", [[1], [2, 0], [0, 1, 3]])
+def test_prob_all_outcomes_density(env, qubits):
+    rho_q = qt.createDensityQureg(N, env)
+    rho = random_density_matrix(N)
+    set_dm(rho_q, rho)
+    got = qt.calcProbOfAllOutcomes(rho_q, qubits)
+    np.testing.assert_allclose(got, _oracle_probs(np.real(np.diag(rho)), qubits),
+                               atol=10 * SV_TOL)
+
+
+def test_prob_all_outcomes_ordering(env_local):
+    """Outcome index bit i must be qubits[i]: |01> (qubit 0 = 1) seen through
+    qubits=[1,0] is outcome 0b10."""
+    psi = qt.createQureg(2, env_local)
+    qt.initClassicalState(psi, 1)
+    p = qt.calcProbOfAllOutcomes(psi, [1, 0])
+    np.testing.assert_allclose(p, [0.0, 0.0, 1.0, 0.0], atol=SV_TOL)
+
+
+def test_prob_all_outcomes_validation(env_local):
+    psi = qt.createQureg(3, env_local)
+    with pytest.raises(qt.QuESTError):
+        qt.calcProbOfAllOutcomes(psi, [0, 3])
+    with pytest.raises(qt.QuESTError):
+        qt.calcProbOfAllOutcomes(psi, [1, 1])
+
+
+def test_sample_outcomes_deterministic_and_reproducible(env_local):
+    psi = qt.createQureg(3, env_local)
+    qt.initClassicalState(psi, 5)
+    s = qt.sampleOutcomes(psi, 64)
+    assert np.all(s == 5)  # deterministic state: every shot is |101>
+    qt.initPlusState(psi)
+    qt.seedQuEST([123])
+    a = qt.sampleOutcomes(psi, 50)
+    qt.seedQuEST([123])
+    b = qt.sampleOutcomes(psi, 50)
+    np.testing.assert_array_equal(a, b)
+    # sampling must not collapse the state
+    assert qt.calcProbOfOutcome(psi, 0, 0) == pytest.approx(0.5, abs=SV_TOL)
+
+
+def test_sample_outcomes_frequencies(env):
+    """Empirical frequencies converge to the analytic distribution."""
+    psi = qt.createQureg(N, env)
+    vec = random_statevector(N)
+    set_sv(psi, vec)
+    qubits = [0, 2, 4]
+    qt.seedQuEST([7])
+    shots = 20000
+    s = qt.sampleOutcomes(psi, shots, qubits)
+    freq = np.bincount(s, minlength=8) / shots
+    np.testing.assert_allclose(freq, _oracle_probs(np.abs(vec) ** 2, qubits),
+                               atol=0.02)
+
+
+def test_sample_outcomes_density(env_local):
+    rho = qt.createDensityQureg(2, env_local)
+    qt.pauliX(rho, 1)  # |10><10|
+    s = qt.sampleOutcomes(rho, 16)
+    assert np.all(s == 2)
+
+
+def test_sample_outcomes_subset_bits(env_local):
+    psi = qt.createQureg(3, env_local)
+    qt.initClassicalState(psi, 0b110)
+    np.testing.assert_array_equal(qt.sampleOutcomes(psi, 4, [1]), [1, 1, 1, 1])
+    np.testing.assert_array_equal(qt.sampleOutcomes(psi, 4, [0]), [0, 0, 0, 0])
+    np.testing.assert_array_equal(qt.sampleOutcomes(psi, 4, [2, 0]), [1, 1, 1, 1])
+
+
+def test_sample_outcomes_validation(env_local):
+    psi = qt.createQureg(2, env_local)
+    with pytest.raises(ValueError):
+        qt.sampleOutcomes(psi, 0)
+    with pytest.raises(qt.QuESTError):
+        qt.sampleOutcomes(psi, 4, [5])
