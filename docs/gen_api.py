@@ -50,6 +50,7 @@ GROUPS = [
                      "mixMultiQubitKrausMap"]),
     ("Measurement & calculations", ["measure", "measureWithStats", "collapseToOutcome",
                    "calcProbOfOutcome", "calcProbOfAllOutcomes", "sampleOutcomes",
+                   "calcPartialTrace", "calcVonNeumannEntropy",
                    "calcTotalProb", "getAmp", "getRealAmp",
                    "getImagAmp", "getProbAmp", "getDensityAmp", "calcInnerProduct",
                    "calcDensityInnerProduct", "calcPurity", "calcFidelity",

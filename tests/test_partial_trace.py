@@ -1,0 +1,122 @@
+"""Partial trace and entanglement entropy (TPU-native extensions:
+calcPartialTrace / calcVonNeumannEntropy — no v3.2 analogue)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from oracle import (DM_TOL, NUM_QUBITS, dm, random_density_matrix,
+                    random_statevector, set_dm, set_sv)
+
+N = NUM_QUBITS
+
+
+def _oracle_ptrace(rho: np.ndarray, n: int, keep) -> np.ndarray:
+    """Independent dense reduction, elementwise over kept/traced bits."""
+    m = len(keep)
+    out = np.zeros((1 << m, 1 << m), dtype=complex)
+    traced = [q for q in range(n) if q not in keep]
+    for r in range(1 << n):
+        for c in range(1 << n):
+            if any(((r >> q) & 1) != ((c >> q) & 1) for q in traced):
+                continue
+            a = sum(((r >> q) & 1) << i for i, q in enumerate(keep))
+            b = sum(((c >> q) & 1) << i for i, q in enumerate(keep))
+            out[a, b] += rho[r, c]
+    return out
+
+
+@pytest.mark.parametrize("trace_out", [[0], [4], [1, 3], [0, 2, 4]])
+def test_partial_trace_density(env, trace_out):
+    rho_q = qt.createDensityQureg(N, env)
+    rho = random_density_matrix(N)
+    set_dm(rho_q, rho)
+    red = qt.calcPartialTrace(rho_q, trace_out)
+    keep = [q for q in range(N) if q not in trace_out]
+    assert red.is_density_matrix and red.num_qubits_represented == len(keep)
+    np.testing.assert_allclose(dm(red), _oracle_ptrace(rho, N, keep),
+                               atol=10 * DM_TOL)
+    assert qt.calcTotalProb(red) == pytest.approx(1.0, abs=10 * DM_TOL)
+
+
+@pytest.mark.parametrize("trace_out", [[0], [2, 4], [1, 2, 3], [0, 1]])
+def test_partial_trace_statevector(env, trace_out):
+    psi = qt.createQureg(N, env)
+    vec = random_statevector(N)
+    set_sv(psi, vec)
+    red = qt.calcPartialTrace(psi, trace_out)
+    keep = [q for q in range(N) if q not in trace_out]
+    np.testing.assert_allclose(dm(red), _oracle_ptrace(np.outer(vec, vec.conj()), N, keep),
+                               atol=10 * DM_TOL)
+    # input register untouched
+    assert qt.calcTotalProb(psi) == pytest.approx(1.0, abs=DM_TOL)
+
+
+def test_partial_trace_bell(env_local):
+    """Tracing one side of a Bell pair leaves the maximally mixed qubit."""
+    psi = qt.createQureg(2, env_local)
+    qt.hadamard(psi, 0)
+    qt.controlledNot(psi, 0, 1)
+    red = qt.calcPartialTrace(psi, [1])
+    np.testing.assert_allclose(dm(red), np.eye(2) / 2, atol=DM_TOL)
+
+
+def test_partial_trace_product_state(env_local):
+    """A product state reduces to the exact single-qubit factor."""
+    psi = qt.createQureg(3, env_local)
+    qt.rotateY(psi, 1, 0.8)
+    red = qt.calcPartialTrace(psi, [0, 2])
+    c, s = np.cos(0.4), np.sin(0.4)
+    expect = np.outer([c, s], [c, s])
+    np.testing.assert_allclose(dm(red), expect, atol=DM_TOL)
+
+
+def test_partial_trace_validation(env_local):
+    psi = qt.createQureg(3, env_local)
+    with pytest.raises(qt.QuESTError):
+        qt.calcPartialTrace(psi, [0, 1, 2])  # nothing left
+    with pytest.raises(qt.QuESTError):
+        qt.calcPartialTrace(psi, [3])
+    with pytest.raises(qt.QuESTError):
+        qt.calcPartialTrace(psi, [1, 1])
+
+
+def test_entropy_bell_and_ghz(env_local):
+    psi = qt.createQureg(2, env_local)
+    qt.hadamard(psi, 0)
+    qt.controlledNot(psi, 0, 1)
+    # half a Bell pair carries exactly 1 bit of entanglement entropy
+    assert qt.calcVonNeumannEntropy(psi, [0]) == pytest.approx(1.0, abs=1e-6)
+    # the full pure state carries none
+    assert qt.calcVonNeumannEntropy(psi) == pytest.approx(0.0, abs=1e-9)
+
+    ghz = qt.createQureg(4, env_local)
+    qt.hadamard(ghz, 0)
+    for i in range(3):
+        qt.controlledNot(ghz, i, i + 1)
+    # any bipartition of a GHZ state has entropy 1 bit
+    assert qt.calcVonNeumannEntropy(ghz, [0, 1]) == pytest.approx(1.0, abs=1e-6)
+    assert qt.calcVonNeumannEntropy(ghz, [2]) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_entropy_mixed_density(env_local):
+    rho = qt.createDensityQureg(2, env_local)
+    # maximally mixed 2-qubit state: entropy 2 bits; each qubit 1 bit
+    set_dm(rho, np.eye(4) / 4)
+    assert qt.calcVonNeumannEntropy(rho) == pytest.approx(2.0, abs=1e-9)
+    assert qt.calcVonNeumannEntropy(rho, [1]) == pytest.approx(1.0, abs=1e-9)
+    # natural-log units
+    assert qt.calcVonNeumannEntropy(rho, base=np.e) == pytest.approx(
+        2.0 * np.log(2.0), abs=1e-9)
+
+
+def test_entropy_pure_statevector_subsets_match_complement(env_local):
+    """For a pure state, S(A) == S(complement of A)."""
+    psi = qt.createQureg(4, env_local)
+    vec = random_statevector(4)
+    set_sv(psi, vec)
+    sa = qt.calcVonNeumannEntropy(psi, [0, 3])
+    sb = qt.calcVonNeumannEntropy(psi, [1, 2])
+    assert sa == pytest.approx(sb, abs=1e-8)
