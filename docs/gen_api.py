@@ -65,7 +65,8 @@ GROUPS = [
                                "compile_circuit", "apply_circuit", "random_circuit",
                                "qft_circuit"]),
     ("Differentiable simulation", ["Param", "ParamCircuit", "build_param_circuit",
-                                   "state_fn", "expectation_fn"]),
+                                   "state_fn", "expectation_fn",
+                                   "adjoint_gradient_fn"]),
 ]
 
 

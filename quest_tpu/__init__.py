@@ -21,7 +21,7 @@ from .api import (_amps_buffer, _hamil_buffers,  # C-shim helpers  # noqa: F401
 from .circuit import (Circuit, compile_circuit, apply_circuit,  # noqa: F401
                       random_circuit, qft_circuit)
 from .autodiff import (Param, ParamCircuit, build as build_param_circuit,  # noqa: F401
-                       expectation_fn, state_fn)
+                       adjoint_gradient_fn, expectation_fn, state_fn)
 
 __version__ = "0.1.0"
 __all__ = list(_api_all) + [
@@ -29,5 +29,5 @@ __all__ = list(_api_all) + [
     "Circuit", "compile_circuit", "apply_circuit", "random_circuit",
     "qft_circuit",
     "Param", "ParamCircuit", "build_param_circuit", "expectation_fn",
-    "state_fn",
+    "state_fn", "adjoint_gradient_fn",
 ]

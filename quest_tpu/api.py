@@ -1174,8 +1174,8 @@ def calcExpecPauliProd(qureg: Qureg, targets, codes, num_targets=None,
 
 def _pauli_sum_masks(codes: np.ndarray):
     """Per-term bit masks of a (terms, n) Pauli-code array: x = mask(X|Y),
-    zy = mask(Z|Y), yc = #Y mod 4 — the static structure of the fused
-    Pauli-sum kernels (ops/calc.py)."""
+    zy = mask(Z|Y), yc = #Y mod 4 — the traced-mask form used by the
+    density-matrix Pauli-sum kernel (ops/calc.py)."""
     codes = np.asarray(codes, dtype=np.int64)
     weights = (np.uint64(1) << np.arange(codes.shape[1], dtype=np.uint64))
     x = ((codes == PauliOpType.PAULI_X) | (codes == PauliOpType.PAULI_Y)) @ weights
@@ -1183,6 +1183,25 @@ def _pauli_sum_masks(codes: np.ndarray):
     yc = (codes == PauliOpType.PAULI_Y).sum(axis=1) % 4
     return (jnp.asarray(x, dtype=jnp.uint64), jnp.asarray(zy, dtype=jnp.uint64),
             jnp.asarray(yc, dtype=jnp.int32))
+
+
+def _pauli_sum_terms(codes: np.ndarray) -> tuple:
+    """STATIC ((x, zy, yc), ...) term tuple for the structured statevector
+    Pauli-sum kernels (ops/calc.py) — masks as Python ints so each term
+    lowers to static layout moves instead of a dynamic gather."""
+    codes = np.asarray(codes, dtype=np.int64)
+    out = []
+    for row in codes:
+        x = zy = yc = 0
+        for q, c in enumerate(row):
+            if c in (PauliOpType.PAULI_X, PauliOpType.PAULI_Y):
+                x |= 1 << q
+            if c in (PauliOpType.PAULI_Z, PauliOpType.PAULI_Y):
+                zy |= 1 << q
+            if c == PauliOpType.PAULI_Y:
+                yc += 1
+        out.append((x, zy, yc % 4))
+    return tuple(out)
 
 
 def calcExpecPauliSum(qureg: Qureg, all_codes, term_coeffs, num_sum_terms=None,
@@ -1211,11 +1230,11 @@ def calcExpecPauliSum(qureg: Qureg, all_codes, term_coeffs, num_sum_terms=None,
         # parity with the reference: the workspace ends up holding the last
         # term's Pauli product (QuEST_common.c:488 leaves it so)
         workspace.amps = _apply_pauli_prod(qureg.amps, tuple(range(n)), codes[-1])
-    xm, zym, yc = _pauli_sum_masks(codes)
     cf = jnp.asarray(coeffs)
     if qureg.is_density_matrix:
+        xm, zym, yc = _pauli_sum_masks(codes)
         return float(_calc.expec_pauli_sum_densmatr(qureg.amps, xm, zym, yc, cf, n))
-    return float(_calc.expec_pauli_sum_statevec(qureg.amps, xm, zym, yc, cf))
+    return float(_calc.expec_pauli_sum_statevec(qureg.amps, _pauli_sum_terms(codes), cf))
 
 
 def calcExpecPauliHamil(qureg: Qureg, hamil: PauliHamil, workspace=None) -> float:
@@ -1355,7 +1374,7 @@ def mixDensityMatrix(qureg: Qureg, prob: float, other: Qureg) -> None:
 
 def applyPauliSum(in_qureg: Qureg, all_codes, term_coeffs, num_sum_terms,
                   out_qureg: Qureg) -> None:
-    """out = Σ_t c_t P_t |in> as ONE compiled scan over the stacked term masks
+    """out = Σ_t c_t P_t |in> as ONE compiled program, one structured pass per term
     (ref: statevec_applyPauliSum, QuEST_common.c:493-515, which clones and
     accumulates per term; row-side products on density quregs, as there)."""
     V.validate_matching_qureg_types(in_qureg, out_qureg, "applyPauliSum")
@@ -1366,9 +1385,9 @@ def applyPauliSum(in_qureg: Qureg, all_codes, term_coeffs, num_sum_terms,
     coeffs = np.asarray(term_coeffs, dtype=np.float64).ravel()[:int(num_sum_terms)]
     V.validate_num_pauli_sum_terms(len(codes), "applyPauliSum")
     V.validate_pauli_codes(codes.ravel(), codes.size, "applyPauliSum")
-    xm, zym, yc = _pauli_sum_masks(codes)
     out_qureg.amps = _calc.apply_pauli_sum(
-        in_qureg.amps, xm, zym, yc, jnp.asarray(coeffs)).astype(out_qureg.dtype)
+        in_qureg.amps, _pauli_sum_terms(codes),
+        jnp.asarray(coeffs)).astype(out_qureg.dtype)
 
 
 def applyPauliHamil(in_qureg: Qureg, hamil: PauliHamil, out_qureg: Qureg) -> None:

@@ -42,7 +42,8 @@ from .ops import calc as _calc
 from .ops import decoherence as _dec
 from . import precision as _prec
 
-__all__ = ["Param", "ParamCircuit", "build", "state_fn", "expectation_fn"]
+__all__ = ["Param", "ParamCircuit", "build", "state_fn", "expectation_fn",
+           "adjoint_gradient_fn"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -218,12 +219,18 @@ def _apply_mrp(state, theta, targets, codes, conj):
     return _multi_rotate_pauli_statevec(state, targets, codes, theta, conj)
 
 
-def _apply_param_op(state, op: ParamOp, params, shadow_n: int | None):
+def _apply_param_op(state, op: ParamOp, params, shadow_n: int | None,
+                    invert: bool = False):
     """Apply one parametric op; if ``shadow_n`` is set (density mode), also
     apply the conjugated column-side twin on targets/controls + n.  The
     conjugate of exp(-iθG/2) is the same gate at -θ for real generators
-    (rx, rz, phase, mrz) and at +θ for ry (imaginary generator)."""
+    (rx, rz, phase, mrz) and at +θ for ry (imaginary generator).
+    ``invert=True`` applies the gate's inverse (every parametric kind is a
+    rotation, so the inverse is the same kind at -θ; statevector only)."""
     theta = _angle(op.param, params)
+    if invert:
+        assert shadow_n is None and op.kind not in _NOISE_KINDS
+        theta = -theta
     t, c, cs = op.targets, op.controls, op.control_states
     dt = state.dtype
 
@@ -333,28 +340,186 @@ def _resolve_init(pc, init, density):
     return jnp.asarray(init), density
 
 
-def expectation_fn(pc: ParamCircuit, hamil, init=None, density: bool = False):
+def expectation_fn(pc: ParamCircuit, hamil, init=None, density: bool = False,
+                   coeffs_arg: bool = False):
     """Jitted ``params -> <H>``: run the circuit from ``init`` and evaluate
     the PauliHamil expectation with the fused one-pass Pauli-sum kernel
-    (ops/calc.py — no workspace clone, one lax.scan over terms).  This is the
+    (ops/calc.py — no workspace clone, one structured pass per term).  This is the
     VQE/QAOA objective: compose with ``jax.value_and_grad`` for energy and
     full gradient in one forward+adjoint program, or ``jax.vmap`` for
-    batched multi-start optimisation."""
-    from .api import _pauli_sum_masks  # lazy: api imports circuit at import time
+    batched multi-start optimisation.
 
-    xm, zym, yc = _pauli_sum_masks(np.asarray(hamil.pauli_codes))
+    ``coeffs_arg=True`` returns ``(params, coeffs) -> <H(coeffs)>`` instead:
+    the term coefficients become a traced argument (the Pauli strings stay
+    static), so ``jax.grad`` also differentiates through the HAMILTONIAN —
+    the Hamiltonian-learning/fitting idiom (∂<H>/∂c_t is just <P_t>, and the
+    adjoint pass delivers the whole vector at once)."""
+    from .api import _pauli_sum_masks, _pauli_sum_terms  # lazy: api is the upper layer
+
+    codes = np.asarray(hamil.pauli_codes)
     cf = jnp.asarray(np.asarray(hamil.term_coeffs, dtype=np.float64))
     init, density = _resolve_init(pc, init, density)
     run = _runner(pc, density)
     n = pc.num_qubits
+    if density:
+        xm, zym, yc = _pauli_sum_masks(codes)
+    else:
+        terms = _pauli_sum_terms(codes)
 
-    @jax.jit
-    def energy(params):
+    def _energy(params, coeffs):
         state = (_zero_state(n, density, _prec.CONFIG.real_dtype)
                  if init is None else init)
         state = run(params, state)
         if density:
-            return _calc.expec_pauli_sum_densmatr(state, xm, zym, yc, cf, n)
-        return _calc.expec_pauli_sum_statevec(state, xm, zym, yc, cf)
+            return _calc.expec_pauli_sum_densmatr(state, xm, zym, yc, coeffs, n)
+        return _calc.expec_pauli_sum_statevec(state, terms, coeffs)
 
-    return energy
+    if coeffs_arg:
+        return jax.jit(lambda params, coeffs: _energy(params, jnp.asarray(coeffs)))
+    return jax.jit(lambda params: _energy(params, cf))
+
+
+# ---------------------------------------------------------------------------
+# adjoint-mode differentiation: O(1)-memory gradients of unitary circuits
+#
+# jax.grad of expectation_fn tapes every intermediate state (depth x 2^n
+# memory) for the reverse pass.  A unitary circuit needs none of that: the
+# reverse pass can UNCOMPUTE states by applying gate inverses, holding only
+# |psi_k> and the adjoint state |lambda> = H|psi> (the adjoint-differentiation
+# method of quantum simulation).  For each parametric gate U_k = exp(-i th
+# G/2), dE/dth = 2 Re<lambda| dU_k |psi_{k-1}> = Im<lambda| G |psi_k>, so the
+# sweep applies the (projected) generator G to a scratch copy, takes one
+# inner product, then uncomputes both states — three live statevectors for
+# ANY depth, where taped reverse-mode holds depth+1.
+# ---------------------------------------------------------------------------
+
+_Z_DIAG = np.stack([np.array([1.0, -1.0]), np.zeros(2)])
+
+
+def _inverse_gate_op(op: GateOp) -> GateOp:
+    """Host-side inverse of a static gate record (adjoint method requires
+    the circuit to be unitary; diagonals invert by reciprocal so any
+    unit-modulus diagonal is exact)."""
+    if op.kind in ("x", "y", "swap"):
+        return op  # self-inverse
+    p = op.payload()
+    if op.kind == "matrix":
+        inv = np.stack([p[0].T, -p[1].T])  # conjugate transpose
+    elif op.kind == "diagonal":
+        mag2 = p[0] ** 2 + p[1] ** 2
+        inv = np.stack([p[0] / mag2, -p[1] / mag2])
+    else:
+        raise ValueError(f"adjoint method cannot invert gate kind {op.kind!r}")
+    return GateOp(op.kind, op.targets, op.controls, op.control_states,
+                  tuple(inv.ravel()), op.shape)
+
+
+def _gen_inner_im(lam, psi, op: ParamOp):
+    """Im<lambda| P_c G |psi> for the op's generator, plus the kind's
+    prefactor: rotations exp(-i th G/2) contribute Im(.), the phase gate
+    exp(+i th P) contributes -2 Im(.)."""
+    cs = op.control_states or (1,) * len(op.controls)
+    mult = 1.0
+    chi = psi
+    if op.kind == "rx":
+        chi = _ap.apply_pauli_x(chi, op.targets[0], (), ())
+    elif op.kind == "ry":
+        chi = _ap.apply_pauli_y(chi, op.targets[0], (), ())
+    elif op.kind == "rz":
+        chi = _ap.apply_diagonal(chi, jnp.asarray(_Z_DIAG, dtype=chi.dtype),
+                                 op.targets)
+    elif op.kind == "phase":
+        proj1 = np.stack([np.array([0.0, 1.0]), np.zeros(2)])
+        chi = _ap.apply_diagonal(chi, jnp.asarray(proj1, dtype=chi.dtype),
+                                 op.targets)
+        mult = -2.0
+    elif op.kind == "mrz":
+        k = len(op.targets)
+        par = np.array([1.0 - 2.0 * (bin(i).count("1") % 2)
+                        for i in range(1 << k)])
+        base = np.stack([par, np.zeros_like(par)])
+        chi = _ap.apply_diagonal(chi, jnp.asarray(base, dtype=chi.dtype),
+                                 op.targets)
+    elif op.kind == "mrp":
+        for t, code in zip(op.targets, op.codes):
+            if code == 1:
+                chi = _ap.apply_pauli_x(chi, t, (), ())
+            elif code == 2:
+                chi = _ap.apply_pauli_y(chi, t, (), ())
+            elif code == 3:
+                chi = _ap.apply_diagonal(chi, jnp.asarray(_Z_DIAG, dtype=chi.dtype),
+                                         (t,))
+    else:
+        raise ValueError(f"adjoint method cannot differentiate {op.kind!r}")
+    if op.controls:
+        # projector over the controls: a 0/1 diagonal with a single 1 at the
+        # all-controls-match index (the P_c in the controlled generator
+        # P_c (x) G) — one convention for every parametric kind
+        full = np.zeros((2, 1 << len(op.controls)))
+        idx = sum(int(s) << i for i, s in enumerate(cs))
+        full[0, idx] = 1.0
+        chi = _ap.apply_diagonal(chi, jnp.asarray(full, dtype=chi.dtype),
+                                 op.controls)
+    # Im<lam|chi> in the STATE dtype: the f64-accumulating inner product
+    # materialises 2x-size converted copies, which is what pushed the
+    # 28-qubit adjoint program over HBM (16.08 of 15.75 GiB)
+    return mult * jnp.sum(lam[0] * chi[1] - lam[1] * chi[0])
+
+
+def adjoint_gradient_fn(pc: ParamCircuit, hamil, init=None):
+    """Jitted ``params -> (energy, gradient)`` by the adjoint method —
+    bit-identical gradients to ``jax.grad(expectation_fn(...))`` at THREE
+    live statevectors for any circuit depth (taped reverse-mode holds
+    depth+1 intermediate states, which is what OOMs deep large-n circuits).
+
+    Requires a unitary statevector circuit (no noise ops; any recorded
+    static matrix must be unitary — its inverse is taken as the conjugate
+    transpose).  TPU-native extension; no reference analogue."""
+    from .api import _pauli_sum_terms
+
+    if any(isinstance(op, ParamOp) and op.kind in _NOISE_KINDS for op in pc.ops):
+        raise ValueError("adjoint_gradient_fn: noise channels are not "
+                         "unitary; use jax.grad(expectation_fn(..., "
+                         "density=True)) for noisy gradients")
+    terms = _pauli_sum_terms(np.asarray(hamil.pauli_codes))
+    cf = jnp.asarray(np.asarray(hamil.term_coeffs, dtype=np.float64))
+    init, density = _resolve_init(pc, init, False)
+    if density:
+        raise ValueError("adjoint_gradient_fn: statevector circuits only")
+    ops = tuple(pc.ops)
+    inv_static = {id(op): _inverse_gate_op(op)
+                  for op in ops if isinstance(op, GateOp)}
+    n = pc.num_qubits
+    num_params = pc.num_params
+
+    @jax.jit
+    def value_and_grad(params):
+        params = jnp.asarray(params)
+        if not jnp.issubdtype(params.dtype, jnp.floating):
+            params = params.astype(_prec.CONFIG.real_dtype)
+        psi = (_zero_state(n, False, _prec.CONFIG.real_dtype)
+               if init is None else init)
+        for op in ops:  # forward, no taping
+            psi = (_apply_one(psi, op) if isinstance(op, GateOp)
+                   else _apply_param_op(psi, op, params, None))
+        lam = _calc.apply_pauli_sum(psi, terms, cf)
+        energy = jnp.sum(psi[0] * lam[0] + psi[1] * lam[1])
+        grads = jnp.zeros(num_params, dtype=params.dtype)
+        for op in reversed(ops):
+            if isinstance(op, GateOp):
+                inv = inv_static[id(op)]
+                psi = _apply_one(psi, inv)
+                lam = _apply_one(lam, inv)
+            else:
+                if isinstance(op.param, Param):
+                    contrib = _gen_inner_im(lam, psi, op) * op.param.scale
+                    grads = grads.at[op.param.index].add(
+                        contrib.astype(params.dtype))
+                psi = _apply_param_op(psi, op, params, None, invert=True)
+                lam = _apply_param_op(lam, op, params, None, invert=True)
+            # pin the schedule: without the barrier XLA may hold many
+            # uncompute steps' buffers live at once (observed HBM OOM at 28q)
+            psi, lam = jax.lax.optimization_barrier((psi, lam))
+        return energy, grads.astype(params.dtype)
+
+    return value_and_grad

@@ -13,10 +13,11 @@ partial sums + psum, exactly the reference's MPI_Allreduce pattern
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .measure import densmatr_diagonal
 
@@ -108,48 +109,98 @@ def expec_diagonal_op_densmatr(state: jax.Array, diag: jax.Array, num_qubits: in
 # with a workspace clone per term (ref: statevec_calcExpecPauliSum,
 # QuEST_common.c:480-515).  Here each term is ONE pass: a Pauli product
 # P = ⊗ P_q maps |k> -> i^{#Y} (-1)^{popcount((k^x) & zy)} |k ^ x| with
-# x = mask(X|Y), zy = mask(Z|Y) — so its action is a single XOR-gather plus a
-# parity phase, and the whole sum is a lax.scan over the stacked mask arrays:
-# one compiled program, no per-term Python dispatch, no workspace clone.
+# x = mask(X|Y), zy = mask(Z|Y).  The statevector kernels unroll over STATIC
+# term masks so each term's |k ^ x> movement lowers to structured layout ops
+# (a static lane permutation / sublane take / prefix-axis flips — the same
+# moves as the f64 gather engine, apply.py _dense_gather) and the parity
+# phase to tiny broadcast sign vectors.  A dynamic (traced-mask) gather is
+# NOT an option at scale: one 2^25-amp dynamic gather measured ~1.5 s on the
+# v5e, and a 49-term scan of them blew the remote worker's program watchdog
+# (observed as a "TPU worker crashed" kernel fault).  The density kernel
+# keeps traced masks — its per-term gather touches only the 2^n diagonal
+# band, far below the hazard size.
 # ---------------------------------------------------------------------------
 
 _PHASE_RE = jnp.asarray([1.0, 0.0, -1.0, 0.0])   # Re(i^yc)
 _PHASE_IM = jnp.asarray([0.0, 1.0, 0.0, -1.0])   # Im(i^yc)
 
-
-def _pauli_term_amps(state, k, xm, zym, yc):
-    """(re, im) of (P ψ)_k = i^yc (-1)^par(k^x) ψ_{k^x}, accumulated f64."""
-    idx = k ^ xm
-    par = (jax.lax.population_count(idx & zym) & 1).astype(_ACC)
-    sign = 1.0 - 2.0 * par
-    ar = state[0][idx].astype(_ACC) * sign
-    ai = state[1][idx].astype(_ACC) * sign
-    pr = _PHASE_RE.astype(_ACC)[yc]
-    pi = _PHASE_IM.astype(_ACC)[yc]
-    return ar * pr - ai * pi, ar * pi + ai * pr
+_I_POW = ((1.0, 0.0), (0.0, 1.0), (-1.0, 0.0), (0.0, -1.0))  # i^yc
 
 
-def _amp_iota(num_amps: int):
-    dt = jnp.uint32 if num_amps <= (1 << 32) else jnp.uint64
-    return jax.lax.iota(dt, num_amps)
+@lru_cache(maxsize=None)
+def _parity_sign_np(width: int, mask: int):
+    """(-1)^popcount(k & mask) over k in [0, 2^width) as a host vector."""
+    v = np.arange(1 << width) & mask
+    p = np.zeros_like(v)
+    while v.any():
+        p ^= v & 1
+        v >>= 1
+    return 1.0 - 2.0 * p.astype(np.float64)
 
 
-@jax.jit
-def expec_pauli_sum_statevec(state: jax.Array, x_masks: jax.Array,
-                             zy_masks: jax.Array, y_phases: jax.Array,
+def _structured_term(state: jax.Array, x: int, zy: int, yc: int):
+    """One static Pauli-product pass: returns the state view t and the moved,
+    signed, i^yc-phased term amplitudes (tr, ti) in the same view shape."""
+    from .apply import _blocks, _gather_plan, num_qubits_of
+
+    n = num_qubits_of(state)
+    lane_w = _blocks(n)[0]  # lane bits need no axis of their own
+    wires = tuple(q for q in range(n) if ((x | zy) >> q) & 1 and q >= lane_w)
+    dims, axis_of, sub_axis, lane_axis, l, s = _gather_plan(n, wires)
+    t = state.reshape((2,) + dims)
+    g = t
+    lane_x = x & ((1 << l) - 1)
+    sub_x = (x >> l) & ((1 << s) - 1) if s else 0
+    if lane_x:
+        g = g[..., np.arange(1 << l) ^ lane_x]
+    if sub_x:
+        g = jnp.take(g, np.arange(1 << s) ^ sub_x, axis=1 + sub_axis)
+    for q in range(l + s, n):
+        if (x >> q) & 1:
+            g = jnp.flip(g, axis=1 + axis_of[q])
+    # parity sign over OUTPUT bits in zy; par((k^x)&zy) = par(k&zy) ^ par(x&zy)
+    body_rank = len(dims)
+    const = 1.0 - 2.0 * (bin(x & zy).count("1") & 1)
+    pr, pi = _I_POW[yc % 4]
+    pr *= const
+    pi *= const
+    sign = None
+
+    def factor(vec, axis):
+        shape = [1] * body_rank
+        shape[axis] = len(vec)
+        return jnp.asarray(vec.reshape(shape), dtype=state.dtype)
+
+    lane_z = zy & ((1 << l) - 1)
+    if lane_z:
+        sign = factor(_parity_sign_np(l, lane_z), lane_axis)
+    sub_z = (zy >> l) & ((1 << s) - 1) if s else 0
+    if sub_z:
+        f = factor(_parity_sign_np(s, sub_z), sub_axis)
+        sign = f if sign is None else sign * f
+    for q in range(l + s, n):
+        if (zy >> q) & 1:
+            f = factor(np.array([1.0, -1.0]), axis_of[q])
+            sign = f if sign is None else sign * f
+    tr = pr * g[0] - pi * g[1]
+    ti = pr * g[1] + pi * g[0]
+    if sign is not None:
+        tr = tr * sign
+        ti = ti * sign
+    return t, tr, ti
+
+
+@partial(jax.jit, static_argnames=("terms",))
+def expec_pauli_sum_statevec(state: jax.Array, terms: tuple,
                              coeffs: jax.Array) -> jax.Array:
-    """Re Σ_t c_t <ψ|P_t|ψ> in one compiled scan over the stacked term masks."""
-    k = _amp_iota(state.shape[1])
-    re, im = state[0].astype(_ACC), state[1].astype(_ACC)
-
-    def body(acc, term):
-        xm, zym, yc, c = term
-        tr, ti = _pauli_term_amps(state, k, xm.astype(k.dtype),
-                                  zym.astype(k.dtype), yc)
-        return acc + c * jnp.sum(re * tr + im * ti), None
-
-    acc, _ = jax.lax.scan(body, jnp.zeros((), _ACC),
-                          (x_masks, zy_masks, y_phases, coeffs.astype(_ACC)))
+    """Re Σ_t c_t <ψ|P_t|ψ>, one fused structured pass per static term
+    (``terms`` = ((x, zy, yc), ...)); accumulation in float64."""
+    coeffs = coeffs.astype(_ACC)
+    acc = jnp.zeros((), _ACC)
+    for i, (x, zy, yc) in enumerate(terms):
+        t, tr, ti = _structured_term(state, x, zy, yc)
+        acc = acc + coeffs[i] * jnp.sum(t[0].astype(_ACC) * tr.astype(_ACC)
+                                        + t[1].astype(_ACC) * ti.astype(_ACC))
     return acc
 
 
@@ -276,20 +327,21 @@ def statevec_partial_trace(state: jax.Array, keep: tuple) -> jax.Array:
     return jnp.stack([rr.T.reshape(-1), ri.T.reshape(-1)]).astype(state.dtype)
 
 
-@jax.jit
-def apply_pauli_sum(state: jax.Array, x_masks: jax.Array, zy_masks: jax.Array,
-                    y_phases: jax.Array, coeffs: jax.Array) -> jax.Array:
-    """out = Σ_t c_t P_t ψ as one compiled scan (ref: statevec_applyPauliSum,
-    QuEST_common.c:493-515, which clones + applies + accumulates per term)."""
-    k = _amp_iota(state.shape[1])
-
-    def body(acc, term):
-        xm, zym, yc, c = term
-        tr, ti = _pauli_term_amps(state, k, xm.astype(k.dtype),
-                                  zym.astype(k.dtype), yc)
-        return (acc[0] + c * tr, acc[1] + c * ti), None
-
-    zero = jnp.zeros(state.shape[1], _ACC)
-    (out_re, out_im), _ = jax.lax.scan(
-        body, (zero, zero), (x_masks, zy_masks, y_phases, coeffs.astype(_ACC)))
-    return jnp.stack([out_re, out_im]).astype(state.dtype)
+@partial(jax.jit, static_argnames=("terms",))
+def apply_pauli_sum(state: jax.Array, terms: tuple,
+                    coeffs: jax.Array) -> jax.Array:
+    """out = Σ_t c_t P_t ψ, one fused structured pass per static term
+    (ref: statevec_applyPauliSum, QuEST_common.c:493-515, which clones +
+    applies + accumulates per term).  The accumulator stays in the state
+    dtype: a state-sized f64 carry costs 4x HBM traffic on an f32 state, and
+    the sum has only `terms` addends."""
+    out = None
+    coeffs = coeffs.astype(state.dtype)
+    for i, (x, zy, yc) in enumerate(terms):
+        _, tr, ti = _structured_term(state, x, zy, yc)
+        piece = coeffs[i] * jnp.stack([tr, ti]).reshape(2, -1)
+        out = piece if out is None else out + piece
+        # without the barrier XLA is free to materialise many terms' moved
+        # copies concurrently — observed RESOURCE_EXHAUSTED at 26q f32
+        out = jax.lax.optimization_barrier(out)
+    return out.astype(state.dtype)

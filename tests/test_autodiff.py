@@ -210,6 +210,76 @@ def test_param_affine_transform(env_local):
     np.testing.assert_allclose(sa, sb, atol=SV_TOL)
 
 
+def test_adjoint_gradient_matches_jax_grad(env):
+    """The O(1)-memory adjoint method must agree with taped reverse-mode to
+    machine precision on every parametric kind, controls, shared affine
+    params, and static gates."""
+    from quest_tpu.autodiff import adjoint_gradient_fn
+
+    pc = _mixed_circuit()
+    pc.x(1, (3,)).swap(0, 4).s(2).z(3, (1,))
+    # controlled parametric gates placed so their gradients are NONZERO (a
+    # zero-gradient controlled gate once masked an inverted control
+    # projector in the generator path)
+    ex = pc.params(3)
+    pc.ry(0, 0.7)
+    pc.phase_shift(1, ex[0], controls=(0,))
+    pc.rz(2, ex[1])  # rz after entanglement: generator test via Z
+    pc.ry(3, ex[2])
+    pc.h(1)
+    h = _hamil()
+    psi = qt.createQureg(N, env)  # sharded init under dist8
+    params = jnp.asarray(np.random.default_rng(21).uniform(-1.5, 1.5, pc.num_params))
+    v0, g0 = jax.value_and_grad(qt.expectation_fn(pc, h, init=psi))(params)
+    v1, g1 = adjoint_gradient_fn(pc, h, init=psi)(params)
+    tol = 1e-3 if ON_ACCELERATOR else 1e-10
+    assert abs(float(v0 - v1)) < tol
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), atol=tol)
+    # guard the guard: the controlled-phase parameter really contributes
+    assert abs(float(g0[ex[0].index])) > 1e-4, float(g0[ex[0].index])
+
+
+def test_adjoint_gradient_qaoa_shared_params(env_local):
+    from quest_tpu.autodiff import adjoint_gradient_fn
+
+    edges = [(i, (i + 1) % 5) for i in range(5)]
+    pc = qaoa_maxcut_circuit(5, edges, p=2)
+    h = maxcut_hamiltonian(5, edges)
+    params = jnp.asarray([0.3, -0.2, 0.5, 0.1])
+    v0, g0 = jax.value_and_grad(qt.expectation_fn(pc, h))(params)
+    v1, g1 = adjoint_gradient_fn(pc, h)(params)
+    tol = 1e-3 if ON_ACCELERATOR else 1e-10
+    assert abs(float(v0 - v1)) < tol
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), atol=tol)
+
+
+def test_coeffs_gradient_is_per_term_expectation(env_local):
+    """With coeffs_arg=True, d<H>/dc_t must equal <P_t> by linearity."""
+    pc = _mixed_circuit()
+    h = _hamil()
+    e2 = qt.expectation_fn(pc, h, coeffs_arg=True)
+    params = jnp.asarray(np.random.default_rng(31).uniform(-1, 1, pc.num_params))
+    coeffs = jnp.asarray(np.asarray(h.term_coeffs))
+    gc = jax.grad(e2, argnums=1)(params, coeffs)
+    # independent per-term check through the eager API
+    psi = qt.createQureg(N, env_local)
+    state = qt.state_fn(pc)(params)
+    psi.set_amps_array(state)
+    for t in range(h.num_sum_terms):
+        want = qt.calcExpecPauliProd(psi, list(range(N)), list(h.pauli_codes[t]), N,
+                                     qt.createQureg(N, env_local))
+        assert float(gc[t]) == pytest.approx(want, abs=1e-10)
+
+
+def test_adjoint_gradient_rejects_noise(env_local):
+    from quest_tpu.autodiff import adjoint_gradient_fn
+
+    pc = qt.ParamCircuit(2)
+    pc.h(0).damp(0, pc.param())
+    with pytest.raises(ValueError, match="noise"):
+        adjoint_gradient_fn(pc, tfim_hamiltonian(2))
+
+
 def test_integer_params_do_not_truncate_constants(env_local):
     """A non-float parameter vector must not drag constant angles (recorded
     as ParamOp floats, e.g. multi_rotate_z with a bound angle) to ints."""

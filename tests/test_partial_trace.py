@@ -7,10 +7,14 @@ import numpy as np
 import pytest
 
 import quest_tpu as qt
+from conftest import ON_ACCELERATOR
 from oracle import (DM_TOL, NUM_QUBITS, dm, random_density_matrix,
                     random_statevector, set_dm, set_sv)
 
 N = NUM_QUBITS
+# entropies pass through an eigendecomposition of f32-computed amplitudes on
+# the accelerator platform; exact-arithmetic tolerances only hold at f64
+ENT_TOL = 1e-4 if ON_ACCELERATOR else 1e-9
 
 
 def _oracle_ptrace(rho: np.ndarray, n: int, keep) -> np.ndarray:
@@ -88,28 +92,28 @@ def test_entropy_bell_and_ghz(env_local):
     qt.hadamard(psi, 0)
     qt.controlledNot(psi, 0, 1)
     # half a Bell pair carries exactly 1 bit of entanglement entropy
-    assert qt.calcVonNeumannEntropy(psi, [0]) == pytest.approx(1.0, abs=1e-6)
+    assert qt.calcVonNeumannEntropy(psi, [0]) == pytest.approx(1.0, abs=max(1e-6, ENT_TOL))
     # the full pure state carries none
-    assert qt.calcVonNeumannEntropy(psi) == pytest.approx(0.0, abs=1e-9)
+    assert qt.calcVonNeumannEntropy(psi) == pytest.approx(0.0, abs=ENT_TOL)
 
     ghz = qt.createQureg(4, env_local)
     qt.hadamard(ghz, 0)
     for i in range(3):
         qt.controlledNot(ghz, i, i + 1)
     # any bipartition of a GHZ state has entropy 1 bit
-    assert qt.calcVonNeumannEntropy(ghz, [0, 1]) == pytest.approx(1.0, abs=1e-6)
-    assert qt.calcVonNeumannEntropy(ghz, [2]) == pytest.approx(1.0, abs=1e-6)
+    assert qt.calcVonNeumannEntropy(ghz, [0, 1]) == pytest.approx(1.0, abs=max(1e-6, ENT_TOL))
+    assert qt.calcVonNeumannEntropy(ghz, [2]) == pytest.approx(1.0, abs=max(1e-6, ENT_TOL))
 
 
 def test_entropy_mixed_density(env_local):
     rho = qt.createDensityQureg(2, env_local)
     # maximally mixed 2-qubit state: entropy 2 bits; each qubit 1 bit
     set_dm(rho, np.eye(4) / 4)
-    assert qt.calcVonNeumannEntropy(rho) == pytest.approx(2.0, abs=1e-9)
-    assert qt.calcVonNeumannEntropy(rho, [1]) == pytest.approx(1.0, abs=1e-9)
+    assert qt.calcVonNeumannEntropy(rho) == pytest.approx(2.0, abs=ENT_TOL)
+    assert qt.calcVonNeumannEntropy(rho, [1]) == pytest.approx(1.0, abs=ENT_TOL)
     # natural-log units
     assert qt.calcVonNeumannEntropy(rho, base=np.e) == pytest.approx(
-        2.0 * np.log(2.0), abs=1e-9)
+        2.0 * np.log(2.0), abs=ENT_TOL)
 
 
 def test_entropy_pure_statevector_subsets_match_complement(env_local):
@@ -119,4 +123,4 @@ def test_entropy_pure_statevector_subsets_match_complement(env_local):
     set_sv(psi, vec)
     sa = qt.calcVonNeumannEntropy(psi, [0, 3])
     sb = qt.calcVonNeumannEntropy(psi, [1, 2])
-    assert sa == pytest.approx(sb, abs=1e-8)
+    assert sa == pytest.approx(sb, abs=max(1e-8, ENT_TOL))
