@@ -279,15 +279,12 @@ def _apply_param_op(state, op: ParamOp, params, shadow_n: int | None,
 # program construction
 # ---------------------------------------------------------------------------
 
-def _runner(pc: ParamCircuit, density: bool):
+def _runner(pc: ParamCircuit, density: bool, remat_every: int = 0):
     ops = tuple(pc.ops)
     n = pc.num_qubits
 
-    def run(params, state):
-        params = jnp.asarray(params)
-        if not jnp.issubdtype(params.dtype, jnp.floating):
-            params = params.astype(_prec.CONFIG.real_dtype)
-        for op in ops:
+    def apply_ops(block, params, state):
+        for op in block:
             if isinstance(op, GateOp):
                 state = _apply_one(state, op)
                 if density:
@@ -297,18 +294,40 @@ def _runner(pc: ParamCircuit, density: bool):
                                         n if density else None)
         return state
 
+    def run(params, state):
+        params = jnp.asarray(params)
+        if not jnp.issubdtype(params.dtype, jnp.floating):
+            params = params.astype(_prec.CONFIG.real_dtype)
+        if remat_every and remat_every > 0:
+            # rematerialise per block: jax.grad then tapes one state per
+            # BLOCK (recomputing each block's interior in the backward
+            # sweep) instead of one per op — the memory control for noisy
+            # circuits, where the adjoint method's uncompute cannot apply
+            for i in range(0, len(ops), remat_every):
+                block = ops[i:i + remat_every]
+                state = jax.checkpoint(
+                    lambda p, s, _b=block: apply_ops(_b, p, s))(params, state)
+            return state
+        return apply_ops(ops, params, state)
+
     return run
 
 
-def build(pc: ParamCircuit, density: bool = False):
+def build(pc: ParamCircuit, density: bool = False, remat_every: int = 0):
     """Compile to a jitted pure ``(params, state) -> state``.
 
     ``state`` is the usual (2, 2^m) SoA real pair (m = n for statevectors,
     2n Choi-flattened for ``density=True``) and may be sharded over a device
     mesh; ``params`` is a flat real vector of ``pc.num_params`` entries.
     The result differentiates (``jax.grad`` w.r.t. params or state) and
-    vmaps (batched params and/or states)."""
-    return jax.jit(_runner(pc, density))
+    vmaps (batched params and/or states).
+
+    ``remat_every=K`` wraps every K ops in ``jax.checkpoint`` so reverse-mode
+    tapes one state per block instead of one per op (forward recompute in
+    the backward sweep) — use for gradients of DEEP noisy/density circuits;
+    unitary statevector circuits should prefer :func:`adjoint_gradient_fn`,
+    which needs no taping at all."""
+    return jax.jit(_runner(pc, density, remat_every))
 
 
 def _zero_state(num_qubits: int, density: bool, dtype):
@@ -341,7 +360,7 @@ def _resolve_init(pc, init, density):
 
 
 def expectation_fn(pc: ParamCircuit, hamil, init=None, density: bool = False,
-                   coeffs_arg: bool = False):
+                   coeffs_arg: bool = False, remat_every: int = 0):
     """Jitted ``params -> <H>``: run the circuit from ``init`` and evaluate
     the PauliHamil expectation with the fused one-pass Pauli-sum kernel
     (ops/calc.py — no workspace clone, one structured pass per term).  This is the
@@ -359,7 +378,7 @@ def expectation_fn(pc: ParamCircuit, hamil, init=None, density: bool = False,
     codes = np.asarray(hamil.pauli_codes)
     cf = jnp.asarray(np.asarray(hamil.term_coeffs, dtype=np.float64))
     init, density = _resolve_init(pc, init, density)
-    run = _runner(pc, density)
+    run = _runner(pc, density, remat_every)
     n = pc.num_qubits
     if density:
         xm, zym, yc = _pauli_sum_masks(codes)

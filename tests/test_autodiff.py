@@ -253,6 +253,25 @@ def test_adjoint_gradient_qaoa_shared_params(env_local):
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), atol=tol)
 
 
+def test_remat_gradient_matches_plain(env_local):
+    """remat_every blocks must not change values or gradients — only the
+    taping schedule (one checkpoint per block, forward recompute in the
+    backward sweep)."""
+    pc = qt.ParamCircuit(3)
+    t = pc.params(3)
+    pc.h(0).cnot(0, 1).rx(1, t[0])
+    pc.damp(0, t[1])
+    pc.ry(2, t[2]).depolarise(2, 0.1)
+    h = tfim_hamiltonian(3)
+    params = jnp.asarray([0.4, 0.12, -0.8])
+    e_plain = qt.expectation_fn(pc, h, density=True)
+    e_remat = qt.expectation_fn(pc, h, density=True, remat_every=2)
+    assert float(e_plain(params)) == pytest.approx(float(e_remat(params)), abs=1e-12)
+    g0 = jax.grad(e_plain)(params)
+    g1 = jax.grad(e_remat)(params)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), atol=1e-11)
+
+
 def test_coeffs_gradient_is_per_term_expectation(env_local):
     """With coeffs_arg=True, d<H>/dc_t must equal <P_t> by linearity."""
     pc = _mixed_circuit()
