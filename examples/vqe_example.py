@@ -117,6 +117,25 @@ def trainable_noise():
           f"(loss {float(loss(p)):.2e})")
 
 
+def adjoint_method():
+    """O(1)-memory gradients: a 14-qubit, 112-parameter ansatz differentiated
+    by uncomputing through gate inverses (three live statevectors at any
+    depth — on a TPU this scales to 27 qubits, where taped reverse-mode
+    cannot run at all)."""
+    from quest_tpu.autodiff import adjoint_gradient_fn
+
+    n = 14
+    ansatz = hardware_efficient_ansatz(n, layers=3)
+    hamil = tfim_hamiltonian(n)
+    fn = adjoint_gradient_fn(ansatz, hamil)
+    params = jnp.asarray(np.random.default_rng(3).normal(0, 0.1, ansatz.num_params))
+    energy, grad = fn(params)
+    v0, g0 = jax.value_and_grad(qt.expectation_fn(ansatz, hamil))(params)
+    print(f"  {ansatz.num_params} params: E = {float(energy):+.6f}  "
+          f"(taped reverse-mode agrees to "
+          f"{float(jnp.max(jnp.abs(grad - g0))):.1e})")
+
+
 if __name__ == "__main__":
     print("VQE: 6-qubit critical TFIM, 8 parallel starts (vmap)")
     vqe_tfim()
@@ -124,3 +143,5 @@ if __name__ == "__main__":
     qaoa_ring()
     print("Trainable noise: fitting a damping rate by gradient descent")
     trainable_noise()
+    print("Adjoint method: taping-free full gradient of a 14-qubit ansatz")
+    adjoint_method()
