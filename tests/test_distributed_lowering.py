@@ -232,3 +232,47 @@ def test_comm_plan_matches_partitioner(sharding, env_dist):
         expected_comm = plan.comm != "none"
         assert has_comm == expected_comm, \
             (plan, _count_comm(text))
+
+
+def test_pauli_expec_z_terms_comm_free_scalar_reduce(sharding):
+    """A Z-string expectation through the structured static-term kernel is
+    sign-multiply + reduce: NO state-sized communication, just the scalar
+    all-reduce of the partial sum (the reference's MPI_Allreduce)."""
+    def f(state):
+        # Z on a sharded and a local qubit
+        return _calc.expec_pauli_sum_statevec(state, ((0, (1 << (N - 1)) | 1, 0),),
+                                              jnp.asarray([1.0]))
+
+    state = jnp.zeros((2, 1 << N), jnp.float64)
+    text = _compiled_text(f, state, sharding=sharding)
+    counts = _count_comm(text)
+    assert not counts, f"state-sized comm in a diagonal-term expectation: {counts}"
+
+
+def test_pauli_expec_sharded_x_term_uses_exchange(sharding):
+    """An X on a SHARDED qubit makes the term's |k^x> move a cross-shard
+    flip — the partitioner must spell it as a collective exchange, exactly
+    the reference's pairwise MPI_Sendrecv for a high-qubit pauliX
+    (ref: QuEST_cpu_distributed.c:1018-1040)."""
+    def f(state):
+        return _calc.expec_pauli_sum_statevec(state, ((1 << (N - 1), 0, 0),),
+                                              jnp.asarray([1.0]))
+
+    state = jnp.zeros((2, 1 << N), jnp.float64)
+    text = _compiled_text(f, state, sharding=sharding)
+    counts = _count_comm(text)
+    assert counts, "expected a cross-shard exchange for the sharded X flip"
+
+
+def test_apply_pauli_sum_local_terms_comm_free(sharding):
+    """apply_pauli_sum with every mask inside the local block keeps all term
+    movement shard-local (lane/sublane moves never cross shards)."""
+    terms = ((3, 0, 0), (0, 5, 0))  # X-flips and Z-signs on minor qubits
+
+    def f(state):
+        return _calc.apply_pauli_sum(state, terms, jnp.asarray([0.5, 0.5]))
+
+    state = jnp.zeros((2, 1 << N), jnp.float64)
+    text = _compiled_text(f, state, sharding=sharding, pin_out=True)
+    counts = _count_comm(text)
+    assert not counts, f"unexpected comm for minor-block terms: {counts}"
