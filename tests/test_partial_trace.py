@@ -77,6 +77,29 @@ def test_partial_trace_product_state(env_local):
     np.testing.assert_allclose(dm(red), expect, atol=DM_TOL)
 
 
+def test_partial_trace_wide_traced_block(env_local):
+    """Tracing >= 7 qubits exercises the identity-contraction branch (the
+    default-suite circuits only reach the small-t slice branch)."""
+    n = 9
+    psi = qt.createQureg(n, env_local)
+    vec = random_statevector(n)
+    set_sv(psi, vec)
+    keep = [1, 8]
+    red = qt.calcPartialTrace(psi, [q for q in range(n) if q not in keep])
+    np.testing.assert_allclose(
+        dm(red), _oracle_ptrace(np.outer(vec, vec.conj()), n, keep),
+        atol=10 * DM_TOL)
+    rho_q = qt.createDensityQureg(n, env_local)
+    qt.hadamard(rho_q, 1)
+    qt.controlledNot(rho_q, 1, 8)
+    qt.mixDephasing(rho_q, 8, 0.2)
+    red2 = qt.calcPartialTrace(rho_q, [q for q in range(n) if q not in keep])
+    assert qt.calcTotalProb(red2) == pytest.approx(1.0, abs=10 * DM_TOL)
+    # dephasing shrinks the off-diagonal Bell coherence by 1-2p
+    amp = qt.getDensityAmp(red2, 0, 3)
+    assert amp.real == pytest.approx(0.5 * (1 - 2 * 0.2), abs=10 * DM_TOL)
+
+
 def test_partial_trace_validation(env_local):
     psi = qt.createQureg(3, env_local)
     with pytest.raises(qt.QuESTError):
