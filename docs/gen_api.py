@@ -43,7 +43,8 @@ GROUPS = [
                    "controlledMultiQubitUnitary", "multiControlledMultiQubitUnitary"]),
     ("Operators", ["applyMatrix2", "applyMatrix4", "applyMatrixN",
                    "applyMultiControlledMatrixN", "applyPauliSum", "applyPauliHamil",
-                   "applyTrotterCircuit", "applyDiagonalOp"]),
+                   "applyTrotterCircuit", "applyDiagonalOp",
+                   "applyQFT", "applyFullQFT"]),
     ("Decoherence", ["mixDephasing", "mixTwoQubitDephasing", "mixDepolarising",
                      "mixTwoQubitDepolarising", "mixDamping", "mixPauli",
                      "mixDensityMatrix", "mixKrausMap", "mixTwoQubitKrausMap",

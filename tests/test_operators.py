@@ -7,10 +7,10 @@ import numpy as np
 import pytest
 
 import quest_tpu as qt
-from oracle import (NUM_QUBITS, apply_to_sv, assert_dm, assert_sv, dm,
-                    full_operator, left_apply_to_dm, pauli_sum_matrix,
-                    random_density_matrix, random_statevector, random_unitary,
-                    set_dm, set_sv, sv)
+from oracle import (DM_TOL, NUM_QUBITS, SV_TOL, apply_to_sv, assert_dm,
+                    assert_sv, dm, full_operator, left_apply_to_dm,
+                    pauli_sum_matrix, random_density_matrix,
+                    random_statevector, random_unitary, set_dm, set_sv, sv)
 
 N = NUM_QUBITS
 DIM = 1 << N
@@ -165,3 +165,50 @@ def test_applyDiagonalOp(env, loaded):
     # density: rho -> D rho (left multiplication by the diagonal)
     qt.applyDiagonalOp(dq, op)
     assert_dm(dq, np.diag(elems) @ rho)
+
+
+# --- QFT API (TPU-native extension; names per QuEST v3.5) -------------------
+
+def _dft(dim: int) -> np.ndarray:
+    w = np.exp(2j * np.pi / dim)
+    return np.array([[w ** (x * y) for x in range(dim)]
+                     for y in range(dim)]) / np.sqrt(dim)
+
+
+def test_apply_full_qft_statevector(env):
+    vec = random_statevector(N)
+    psi = qt.createQureg(N, env)
+    set_sv(psi, vec)
+    qt.applyFullQFT(psi)
+    np.testing.assert_allclose(sv(psi), _dft(1 << N) @ vec, atol=SV_TOL)
+
+
+@pytest.mark.parametrize("qubits", [[2], [0, 3], [4, 1, 2]])
+def test_apply_qft_subset(env, qubits):
+    """QFT on a sub-register equals the dense DFT embedded on those wires
+    (qubits[0] least significant)."""
+    vec = random_statevector(N)
+    psi = qt.createQureg(N, env)
+    set_sv(psi, vec)
+    qt.applyQFT(psi, qubits)
+    op = full_operator(N, qubits, _dft(1 << len(qubits)))
+    np.testing.assert_allclose(sv(psi), op @ vec, atol=SV_TOL)
+
+
+def test_apply_qft_density(env):
+    rho = random_density_matrix(3)
+    rho_q = qt.createDensityQureg(3, env)
+    set_dm(rho_q, rho)
+    qt.applyQFT(rho_q, [0, 1, 2])
+    f = _dft(8)
+    np.testing.assert_allclose(dm(rho_q), f @ rho @ f.conj().T, atol=DM_TOL)
+    assert qt.calcTotalProb(rho_q) == pytest.approx(1.0, abs=DM_TOL)
+
+
+
+def test_apply_qft_validation(env_local):
+    psi = qt.createQureg(3, env_local)
+    with pytest.raises(qt.QuESTError):
+        qt.applyQFT(psi, [0, 3])
+    with pytest.raises(qt.QuESTError):
+        qt.applyQFT(psi, [1, 1])
