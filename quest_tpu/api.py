@@ -950,10 +950,10 @@ def calcPartialTrace(qureg: Qureg, trace_qubits) -> Qureg:
     remaining qubits (kept qubit i of the result = i-th smallest kept index).
 
     TPU-native extension (no v3.2 analogue; QuEST added calcPartialTrace in
-    a later major version).  Density input: one fused flat segment-sum pass
-    — no reshape, shard-safe.  Pure-state input: the reduced matrix is the
-    Gram matrix of 2^t-amp slices — one pair of MXU matmuls, never the 4^n
-    outer product."""
+    a later major version).  Density input: bit-routing swaps + a
+    block-trace contraction (ops/calc.py densmatr_partial_trace).
+    Pure-state input: the reduced matrix is the Gram matrix of 2^t-amp
+    slices — one pair of MXU matmuls, never the 4^n outer product."""
     trace_qubits = _ts(trace_qubits)
     V.validate_multi_targets(qureg, trace_qubits, "calcPartialTrace")
     n = qureg.num_qubits_represented
@@ -987,21 +987,21 @@ def calcVonNeumannEntropy(qureg: Qureg, keep_qubits=None, base: float = 2.0) -> 
     keep_qubits = _ts(keep_qubits)
     V.validate_multi_targets(qureg, keep_qubits, "calcVonNeumannEntropy")
     keep = tuple(sorted(keep_qubits))
-    if not qureg.is_density_matrix and len(keep) > n - len(keep):
-        # S(A) = S(complement) for pure states: always diagonalise the
-        # SMALLER side (keeping 16 of 20 qubits would otherwise mean a
-        # 2^16-dim eigenproblem where the complement needs a 16-dim one)
-        keep = tuple(q for q in range(n) if q not in keep)
-    if len(keep) == n or (not keep and not qureg.is_density_matrix):
-        if not qureg.is_density_matrix:
+    if not qureg.is_density_matrix:
+        if len(keep) == n:
             return 0.0  # a pure state has zero entropy
+        if len(keep) > n - len(keep):
+            # S(A) = S(complement) for pure states: always diagonalise the
+            # SMALLER side (keeping 16 of 20 qubits would otherwise mean a
+            # 2^16-dim eigenproblem where the complement needs a 16-dim one)
+            keep = tuple(q for q in range(n) if q not in keep)
+        amps = _calc.statevec_partial_trace(qureg.amps, keep)
+        m = len(keep)
+    elif len(keep) == n:
         amps = qureg.amps
         m = n
     else:
-        if qureg.is_density_matrix:
-            amps = _calc.densmatr_partial_trace(qureg.amps, keep, n)
-        else:
-            amps = _calc.statevec_partial_trace(qureg.amps, keep)
+        amps = _calc.densmatr_partial_trace(qureg.amps, keep, n)
         m = len(keep)
     a = np.asarray(amps)
     dim = 1 << m
@@ -1017,8 +1017,8 @@ def calcProbOfAllOutcomes(qureg: Qureg, qubits) -> np.ndarray:
 
     TPU-native extension (the reference's v3.2 surface only queries one
     qubit at a time, calcProbOfOutcome; the name and index convention match
-    the function QuEST added in v3.4).  One fused device pass: a
-    segment-sum over an iota outcome key — no per-outcome dispatch."""
+    the function QuEST added in v3.4).  One fused device pass — a grouped
+    structured reduction, no per-outcome dispatch (ops/measure.py)."""
     qubits = _ts(qubits)
     V.validate_multi_targets(qureg, qubits, "calcProbOfAllOutcomes")
     if qureg.is_density_matrix:
@@ -1207,8 +1207,8 @@ def _pauli_sum_terms(codes: np.ndarray) -> tuple:
 
 def calcExpecPauliSum(qureg: Qureg, all_codes, term_coeffs, num_sum_terms=None,
                       workspace=None) -> float:
-    """Σ_t c_t <P_t> as ONE compiled program — a lax.scan over stacked term
-    masks with no per-term dispatch or workspace clone (SURVEY §3.5; the
+    """Σ_t c_t <P_t> as ONE compiled program — one structured pass per term
+    with no per-term dispatch or workspace clone (SURVEY §3.5; the
     reference makes O(terms·n) full-state passes, QuEST_common.c:480-492)."""
     if workspace is None and not isinstance(num_sum_terms, (int, np.integer, type(None))):
         workspace = num_sum_terms

@@ -320,22 +320,12 @@ def statevec_partial_trace(state: jax.Array, keep: tuple) -> jax.Array:
     view of a large state (that fallback is only hit with small m, or in
     the impractical corner of keeping nearly all qubits of a large state,
     where the 2^m-dim output is itself exponential)."""
-    from .apply import num_qubits_of, swap_qubit_amps
+    from .apply import num_qubits_of
 
     n = num_qubits_of(state)
     m = len(keep)
     t = n - m
-    # route keep[i] -> position t + i, tracking displaced qubits
-    at = list(range(n))       # at[pos] = current occupant
-    pos = {q: q for q in range(n)}
-    for i, q in enumerate(keep):
-        tgt = t + i
-        p = pos[q]
-        if p != tgt:
-            other = at[tgt]
-            state = swap_qubit_amps(state, p, tgt)
-            at[p], at[tgt] = other, q
-            pos[other], pos[q] = p, tgt
+    state = _route_bits(state, {q: t + i for i, q in enumerate(keep)})
     t_dim, m_dim = 1 << t, 1 << m
     if m >= 3 and (t >= 7 or n <= 14):
         x = state.reshape(2, m_dim, t_dim).astype(_ACC)  # trailing >= (8,128)

@@ -118,11 +118,12 @@ def _group_probs(weights: jax.Array, n: int, targets: tuple) -> jax.Array:
     reduction at any size."""
     if tuple(targets) == tuple(range(n)):
         return weights  # identity grouping: the histogram IS the weight vector
-    from .apply import _gather_plan
+    from .apply import _blocks, _gather_plan
 
     k = len(targets)
+    lane_w = _blocks(n)[0]  # lane bits need no axis of their own
     dims, axis_of, sub_axis, lane_axis, l, s = _gather_plan(
-        n, tuple(sorted(q for q in targets if q >= l_of(n))))
+        n, tuple(sorted(q for q in targets if q >= lane_w)))
     lane_ts = tuple((i, q) for i, q in enumerate(targets) if q < l)
     sub_ts = tuple((i, q) for i, q in enumerate(targets) if l <= q < l + s)
     pre_ts = tuple((i, q) for i, q in enumerate(targets) if q >= l + s)
@@ -166,11 +167,6 @@ def _group_probs(weights: jax.Array, n: int, targets: tuple) -> jax.Array:
         perm[o] = (p * a_w + a) * b_w + b
     return res[jnp.asarray(perm)]
 
-
-def l_of(n: int) -> int:
-    from .apply import _blocks
-
-    return _blocks(n)[0]
 
 
 @partial(jax.jit, static_argnames=("targets",))
