@@ -68,6 +68,8 @@ GROUPS = [
     ("Differentiable simulation", ["Param", "ParamCircuit", "build_param_circuit",
                                    "state_fn", "expectation_fn",
                                    "adjoint_gradient_fn"]),
+    ("Trajectory simulation", ["trajectory_state_fn",
+                               "trajectory_expectation_fn"]),
 ]
 
 

@@ -22,6 +22,8 @@ from .circuit import (Circuit, compile_circuit, apply_circuit,  # noqa: F401
                       random_circuit, qft_circuit)
 from .autodiff import (Param, ParamCircuit, build as build_param_circuit,  # noqa: F401
                        adjoint_gradient_fn, expectation_fn, state_fn)
+from .trajectories import (trajectory_expectation_fn,  # noqa: F401
+                           trajectory_state_fn)
 
 __version__ = "0.1.0"
 __all__ = list(_api_all) + [
@@ -30,4 +32,5 @@ __all__ = list(_api_all) + [
     "qft_circuit",
     "Param", "ParamCircuit", "build_param_circuit", "expectation_fn",
     "state_fn", "adjoint_gradient_fn",
+    "trajectory_state_fn", "trajectory_expectation_fn",
 ]

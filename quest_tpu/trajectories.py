@@ -1,0 +1,188 @@
+"""Quantum-trajectory (Monte-Carlo wavefunction) noise simulation.
+
+No reference analogue: the reference simulates noise only as density
+matrices (4^n amplitudes).  The trajectory unraveling runs noisy circuits
+as STOCHASTIC PURE STATES (2^n amplitudes): at each channel, one Kraus
+branch is sampled and applied, and averaging over trajectories converges to
+the density-matrix result — E_traj[⟨ψ_k|H|ψ_k⟩] → Tr(Hρ).  That halves the
+exponent of the memory/compute cost, so a 20-qubit noisy circuit costs a
+20-qubit statevector per trajectory instead of a 40-qubit Choi vector, and
+`jax.vmap` over trajectory keys batches the whole ensemble into one device
+program (the batching capability measured at ~29x device utilisation gain
+for small states — bench `vmap_batch32_16q_f32`).
+
+TPU-first design: branch selection must be traced (no data-dependent Python
+control flow), so each channel draws a uniform from a per-trajectory
+`jax.random` key and selects its Kraus branch with `lax.switch` over the
+statically-known branch set.  The mixing channels (dephasing, depolarising)
+have UNITARY Kraus branches with state-independent probabilities — selection
+is a constant-probability switch and needs no renormalisation; amplitude
+damping is the state-dependent case (jump probability p·P(|1⟩)) and
+renormalises the chosen branch, the standard MCWF step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .autodiff import (GateOp, ParamCircuit, ParamOp, _NOISE_KINDS, _Z_DIAG,
+                       _angle, _apply_one, _apply_param_op, _resolve_init,
+                       _zero_state)
+from .ops import apply as _ap
+from .ops import calc as _calc
+from .ops import measure as _meas
+from . import precision as _prec
+
+__all__ = ["trajectory_state_fn", "trajectory_expectation_fn"]
+
+
+def _mix3_edges(prob):
+    """Cumulative branch edges for a {1-p, p/3, p/3, p/3} Kraus mixture."""
+    return jnp.stack([1.0 - prob, 1.0 - 2.0 * prob / 3.0, 1.0 - prob / 3.0])
+
+
+def _resolve_pure_init(pc, init):
+    """Unwrap an init array or statevector Qureg (trajectories are pure)."""
+    init, density = _resolve_init(pc, init, False)
+    if density:
+        raise ValueError("trajectory simulation runs pure states; pass a "
+                         "statevector init (noise enters via the channels)")
+    return init
+
+
+def _apply_noise_trajectory(state, op: ParamOp, params, u):
+    """One sampled Kraus branch of a channel, chosen by uniform ``u``."""
+    prob = _angle(op.param, params)
+    t = op.targets
+
+    if op.kind == "dephase":
+        # {sqrt(1-p) I, sqrt(p) Z}: unitary branches, fixed probabilities
+        branches = [lambda s: s,
+                    lambda s: _ap.apply_diagonal(
+                        s, jnp.asarray(_Z_DIAG, dtype=s.dtype), (t[0],))]
+        edges = jnp.stack([1.0 - prob])
+    elif op.kind == "dephase2":
+        # {sqrt(1-p) I, sqrt(p/3) Z1, sqrt(p/3) Z2, sqrt(p/3) Z1Z2}
+        def z_on(*qs):
+            def f(s):
+                for q in qs:
+                    s = _ap.apply_diagonal(s, jnp.asarray(_Z_DIAG, dtype=s.dtype),
+                                           (q,))
+                return s
+            return f
+        branches = [lambda s: s, z_on(t[0]), z_on(t[1]), z_on(t[0], t[1])]
+        edges = _mix3_edges(prob)
+    elif op.kind == "depolarise":
+        # {sqrt(1-p) I, sqrt(p/3) X, sqrt(p/3) Y, sqrt(p/3) Z}
+        branches = [
+            lambda s: s,
+            lambda s: _ap.apply_pauli_x(s, t[0], (), ()),
+            lambda s: _ap.apply_pauli_y(s, t[0], (), ()),
+            lambda s: _ap.apply_diagonal(s, jnp.asarray(_Z_DIAG, dtype=s.dtype),
+                                         (t[0],)),
+        ]
+        edges = _mix3_edges(prob)
+    elif op.kind == "damp":
+        # state-dependent jump: P(jump) = p * P(|1>).  no-jump branch applies
+        # K0 = diag(1, sqrt(1-p)) / sqrt(p0); jump branch K1 = sqrt(p)|0><1|
+        # / sqrt(p1) — the canonical MCWF step
+        p1_state = 1.0 - _meas.prob_of_zero(state, t[0]).astype(state.dtype)
+        p_jump = prob.astype(state.dtype) * p1_state
+
+        def no_jump(s):
+            keep = jnp.sqrt(1.0 - prob.astype(s.dtype))
+            d = jnp.stack([jnp.stack([jnp.ones((), s.dtype), keep]),
+                           jnp.zeros(2, s.dtype)])
+            s = _ap.apply_diagonal(s, d, (t[0],))
+            norm = jnp.sqrt(jnp.maximum(1.0 - p_jump, 1e-30))
+            return s / norm
+
+        def jump(s):
+            # sqrt(p)|0><1|: project onto |1>, flip to |0>, renormalise
+            proj = jnp.stack([jnp.stack([jnp.zeros((), s.dtype),
+                                         jnp.ones((), s.dtype)]),
+                              jnp.zeros(2, s.dtype)])
+            s = _ap.apply_diagonal(s, proj, (t[0],))
+            s = _ap.apply_pauli_x(s, t[0], (), ())
+            norm = jnp.sqrt(jnp.maximum(p_jump / prob.astype(s.dtype), 1e-30))
+            return s / norm
+
+        return jax.lax.cond(u < p_jump, jump, no_jump, state)
+    else:
+        raise ValueError(f"unknown noise kind {op.kind!r}")
+
+    idx = jnp.searchsorted(edges, u.astype(edges.dtype), side="right")
+    return jax.lax.switch(idx, branches, state)
+
+
+def _trajectory_runner(pc: ParamCircuit):
+    ops = tuple(pc.ops)
+    n = pc.num_qubits
+    noise_count = sum(1 for op in ops
+                      if isinstance(op, ParamOp) and op.kind in _NOISE_KINDS)
+
+    def run(key, params, state):
+        params = jnp.asarray(params)
+        if not jnp.issubdtype(params.dtype, jnp.floating):
+            params = params.astype(_prec.CONFIG.real_dtype)
+        draws = jax.random.uniform(key, (max(noise_count, 1),),
+                                   dtype=jnp.float32)
+        d = 0
+        for op in ops:
+            if isinstance(op, GateOp):
+                state = _apply_one(state, op)
+            elif op.kind in _NOISE_KINDS:
+                state = _apply_noise_trajectory(state, op, params, draws[d])
+                d += 1
+            else:
+                state = _apply_param_op(state, op, params, None)
+        return state
+
+    return run, n
+
+
+def _initial(n, init):
+    return (_zero_state(n, False, _prec.CONFIG.real_dtype)
+            if init is None else init)
+
+
+def trajectory_state_fn(pc: ParamCircuit, init=None):
+    """Jitted ``(key, params) -> state``: ONE stochastic trajectory of the
+    noisy circuit as a pure 2^n statevector.  ``jax.vmap`` over split keys
+    runs an ensemble in one batched program; averaging outer products (or
+    any observable) over trajectories converges to the density-matrix
+    result at statevector cost."""
+    run, n = _trajectory_runner(pc)
+    init = _resolve_pure_init(pc, init)
+
+    @jax.jit
+    def fn(key, params):
+        return run(key, params, _initial(n, init))
+
+    return fn
+
+
+def trajectory_expectation_fn(pc: ParamCircuit, hamil, trajectories: int,
+                              init=None):
+    """Jitted ``(key, params) -> <H>`` averaged over ``trajectories``
+    vmapped stochastic unravelings — the statevector-cost estimator of the
+    density-matrix expectation (standard error ~ 1/sqrt(trajectories))."""
+    from .api import _pauli_sum_terms
+
+    terms = _pauli_sum_terms(np.asarray(hamil.pauli_codes))
+    cf = jnp.asarray(np.asarray(hamil.term_coeffs, dtype=np.float64))
+    run, n = _trajectory_runner(pc)
+    init = _resolve_pure_init(pc, init)
+
+    @jax.jit
+    def fn(key, params):
+        def one(k):
+            state = run(k, params, _initial(n, init))
+            return _calc.expec_pauli_sum_statevec(state, terms, cf)
+
+        keys = jax.random.split(key, trajectories)
+        return jnp.mean(jax.vmap(one)(keys))
+
+    return fn

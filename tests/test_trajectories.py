@@ -1,0 +1,123 @@
+"""Quantum-trajectory noise simulation (quest_tpu/trajectories.py).
+
+No reference analogue — the reference simulates noise only as density
+matrices.  The independent check is exactly that: trajectory averages must
+converge to the density-matrix result computed by the (oracle-validated)
+density path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu.models import tfim_hamiltonian
+from quest_tpu.trajectories import (trajectory_expectation_fn,
+                                    trajectory_state_fn)
+from conftest import ON_ACCELERATOR
+
+
+def _noisy_circuit():
+    pc = qt.ParamCircuit(3)
+    t = pc.params(3)
+    pc.h(0).cnot(0, 1).rx(2, t[0])
+    pc.dephase(0, 0.15)
+    pc.depolarise(1, t[1])
+    pc.damp(2, t[2])
+    pc.two_qubit_dephase(0, 1, 0.1)
+    pc.ry(1, 0.4)
+    return pc
+
+
+PARAMS = jnp.asarray([0.7, 0.2, 0.3])
+
+
+def test_trajectory_average_matches_density(env_local):
+    """E_traj[<psi|H|psi>] -> Tr(H rho): the statistical estimator agrees
+    with the exact density evolution within a few standard errors."""
+    pc = _noisy_circuit()
+    h = tfim_hamiltonian(3)
+    exact = float(qt.expectation_fn(pc, h, density=True)(PARAMS))
+    est = float(trajectory_expectation_fn(pc, h, trajectories=4000)(
+        jax.random.PRNGKey(0), PARAMS))
+    assert est == pytest.approx(exact, abs=0.06)
+
+
+def test_trajectory_density_reconstruction(env_local):
+    """Averaged trajectory outer products reconstruct the full density
+    matrix, not just one observable."""
+    pc = _noisy_circuit()
+    run = trajectory_state_fn(pc)
+    shots = 3000
+    keys = jax.random.split(jax.random.PRNGKey(7), shots)
+
+    def outer(k):
+        s = run(k, PARAMS)
+        v_re, v_im = s[0], s[1]
+        rr = jnp.outer(v_re, v_re) + jnp.outer(v_im, v_im)
+        ri = jnp.outer(v_im, v_re) - jnp.outer(v_re, v_im)
+        return rr, ri
+
+    rr, ri = jax.vmap(outer)(keys)
+    rho_est = np.asarray(jnp.mean(rr, 0)) + 1j * np.asarray(jnp.mean(ri, 0))
+
+    rho_q = qt.createDensityQureg(3, qt.createQuESTEnv(1))
+    state = qt.build_param_circuit(pc, density=True)(PARAMS, rho_q.amps)
+    a = np.asarray(state)
+    rho_exact = (a[0] + 1j * a[1]).reshape(8, 8).T
+    assert np.abs(rho_est - rho_exact).max() < 0.05
+
+
+def test_trajectory_norms_are_one(env_local):
+    """Every sampled trajectory is a normalised pure state (the damping
+    branches renormalise)."""
+    pc = _noisy_circuit()
+    run = trajectory_state_fn(pc)
+    keys = jax.random.split(jax.random.PRNGKey(3), 64)
+    states = jax.vmap(lambda k: run(k, PARAMS))(keys)
+    norms = np.asarray(jnp.sum(states[:, 0] ** 2 + states[:, 1] ** 2, axis=1))
+    np.testing.assert_allclose(norms, 1.0, atol=1e-4 if ON_ACCELERATOR else 1e-10)
+
+
+def test_unitary_trajectories_are_deterministic(env_local):
+    pc = qt.ParamCircuit(3)
+    t = pc.param()
+    pc.h(0).cnot(0, 1).rz(2, t)
+    run = trajectory_state_fn(pc)
+    p = jnp.asarray([0.3])
+    s1 = np.asarray(run(jax.random.PRNGKey(1), p))
+    s2 = np.asarray(qt.state_fn(pc)(p))
+    np.testing.assert_allclose(s1, s2, atol=1e-4 if ON_ACCELERATOR else 1e-12)
+
+
+def test_qureg_init_accepted_density_rejected(env_local):
+    """init follows the sibling state_fn contract: a statevector Qureg's
+    amplitudes are unwrapped; a density Qureg is rejected."""
+    pc = qt.ParamCircuit(2)
+    pc.dephase(0, 0.1)
+    env = qt.createQuESTEnv(1)
+    psi = qt.createQureg(2, env)
+    qt.pauliX(psi, 1)
+    run = trajectory_state_fn(pc, init=psi)
+    s = np.asarray(run(jax.random.PRNGKey(0), jnp.zeros(0)))
+    assert abs(s[0, 2]) == pytest.approx(1.0, abs=1e-6)  # still |10> up to phase
+    with pytest.raises(ValueError, match="pure"):
+        trajectory_state_fn(pc, init=qt.createDensityQureg(2, env))
+
+
+def test_damping_jump_statistics(env_local):
+    """Pure |1> under damping: the jump branch fires with probability p and
+    leaves |0>; no-jump leaves |1>."""
+    pc = qt.ParamCircuit(1)
+    pc.x(0)
+    pc.damp(0, 0.3)
+    run = trajectory_state_fn(pc)
+    keys = jax.random.split(jax.random.PRNGKey(11), 2000)
+    states = jax.vmap(lambda k: run(k, jnp.zeros(0)))(keys)
+    p0 = np.asarray(states[:, 0, 0] ** 2 + states[:, 1, 0] ** 2)
+    # each trajectory is either |0> (jump) or |1>
+    frac_jumped = float(np.mean(p0 > 0.5))
+    assert frac_jumped == pytest.approx(0.3, abs=0.04)
