@@ -165,16 +165,26 @@ def trajectory_state_fn(pc: ParamCircuit, init=None):
 
 
 def trajectory_expectation_fn(pc: ParamCircuit, hamil, trajectories: int,
-                              init=None):
+                              init=None, batch: int | None = None):
     """Jitted ``(key, params) -> <H>`` averaged over ``trajectories``
-    vmapped stochastic unravelings — the statevector-cost estimator of the
-    density-matrix expectation (standard error ~ 1/sqrt(trajectories))."""
+    stochastic unravelings — the statevector-cost estimator of the
+    density-matrix expectation (standard error ~ 1/sqrt(trajectories)).
+
+    The ensemble runs as ``lax.map`` over chunks of ``batch`` vmapped
+    trajectories (default 32, clipped to the total): a single full-width
+    vmap batches every intermediate of every trajectory, which at 20 qubits
+    x 256 trajectories measured a 56 GiB compile-time footprint — chunking
+    caps live memory at one batch while keeping the device filled.
+    ``trajectories`` is rounded UP to a whole number of chunks."""
     from .api import _pauli_sum_terms
 
     terms = _pauli_sum_terms(np.asarray(hamil.pauli_codes))
     cf = jnp.asarray(np.asarray(hamil.term_coeffs, dtype=np.float64))
     run, n = _trajectory_runner(pc)
     init = _resolve_pure_init(pc, init)
+    if batch is None:
+        batch = min(32, trajectories)
+    chunks = -(-trajectories // batch)  # ceil
 
     @jax.jit
     def fn(key, params):
@@ -182,7 +192,9 @@ def trajectory_expectation_fn(pc: ParamCircuit, hamil, trajectories: int,
             state = run(k, params, _initial(n, init))
             return _calc.expec_pauli_sum_statevec(state, terms, cf)
 
-        keys = jax.random.split(key, trajectories)
-        return jnp.mean(jax.vmap(one)(keys))
+        keys = jax.random.split(key, chunks * batch)
+        keys = keys.reshape(chunks, batch, *keys.shape[1:])
+        chunk_means = jax.lax.map(lambda ks: jnp.mean(jax.vmap(one)(ks)), keys)
+        return jnp.mean(chunk_means)
 
     return fn
