@@ -340,6 +340,49 @@ def bench_vmap_batch(n=16, batch=32, depth=20, seed=11):
     return value, cfg
 
 
+def bench_trajectories(n=20, trajectories=256, batch=16, seed=3):
+    """Monte-Carlo wavefunction ensemble of a NOISY circuit (one Haar-ish ry
+    layer + CNOT ladder + per-qubit depolarising + damping) — noise at
+    statevector cost (quest_tpu/trajectories.py).  The exact density
+    representation of this 20-qubit system is a 40-qubit Choi vector (8 TB):
+    this workload exists on one chip ONLY through the unraveling."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import quest_tpu as qt
+    from quest_tpu.models import tfim_hamiltonian
+
+    pc = qt.ParamCircuit(n)
+    t = pc.params(n)
+    for q in range(n):
+        pc.ry(q, t[q])
+    for q in range(0, n - 1, 2):
+        pc.cnot(q, q + 1)
+    for q in range(n):
+        pc.depolarise(q, 0.02)
+    pc.damp(0, 0.05)
+    gates = n + n // 2 + n + 1  # rotations + ladder + channels
+    h = tfim_hamiltonian(n)
+    params = jnp.asarray(np.random.default_rng(seed).normal(0.3, 0.2, n),
+                         dtype=jnp.float32)
+    fn = qt.trajectory_expectation_fn(pc, h, trajectories, batch=batch)
+    key = jax.random.PRNGKey(0)
+    e = float(fn(key, params))  # compile + warm
+    assert np.isfinite(e) and abs(e) < 2 * n, e
+    best = None
+    for _ in range(2):
+        t0 = time.perf_counter()
+        e = float(fn(key, params))
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    value = trajectories * (1 << n) * gates / best
+    cfg = {"qubits": n, "trajectories": trajectories, "batch": batch,
+           "gates_per_trajectory": gates, "precision": 1, "seconds": best,
+           "expectation": e}
+    cfg.update(_roofline(trajectories << n, 1, gates, best))
+    return value, cfg
+
+
 def bench_density(n=14, depth=5, precision=2, seed=7):
     """Density-matrix layer on the Choi-flattened 2n-qubit vector: Haar 1q
     gate + shadow, then mixDamping and mixDepolarising per qubit pair
@@ -655,6 +698,7 @@ def main() -> None:
         if platform != "cpu":
             add("pauli_expec_26q_f32", bench_pauli_expec)
             add("vmap_batch32_16q_f32", bench_vmap_batch)
+            add("trajectories_20q_noisy_f32", bench_trajectories)
         add("densmatr_14q_damping_depol_f32", bench_density, 14, 5, 1)
         # f64 at this size needs the gather engine + per-step donation to fit
         # HBM; depth 3 amortises the 42 per-op dispatches (~5 s/layer on the
