@@ -240,12 +240,24 @@ def getStaticComplexMatrixN(real, imag) -> np.ndarray:
 
 
 def seedQuEST(seed_array, num_seeds: int | None = None):
+    """Seed the global MT19937 (ref: seedQuEST, QuEST_common.c:209-214).
+
+    Multi-process contract: in a multi-host run EVERY process must call this
+    with the SAME seed array (the reference requires the same: its seedQuEST
+    is rank-local and only the *default* path broadcasts).  Identical seeds
+    keep every rank's measurement-outcome stream in lockstep, which a shared
+    sharded state depends on.  ``seedQuESTDefault`` handles the broadcast
+    automatically."""
     if num_seeds is not None:
         seed_array = list(seed_array)[:num_seeds]
     rng.seed_quest(seed_array)
 
 
 def seedQuESTDefault():
+    """Default seeding from [msec-time, pid], broadcast from process 0 to all
+    processes in a multi-host run so all ranks draw identical outcomes
+    (ref: QuEST_common.c:182-204 + MPI_Bcast at
+    QuEST_cpu_distributed.c:1318-1329)."""
     rng.seed_quest_default()
 
 
@@ -1052,7 +1064,7 @@ def sampleOutcomes(qureg: Qureg, num_samples: int, qubits=None) -> np.ndarray:
     total = cdf[-1]
     if not np.isfinite(total) or total <= 0:
         raise ValueError(f"sampleOutcomes: unnormalisable state (sum {total})")
-    draws = np.array([rng.rand_real1() for _ in range(num_samples)])
+    draws = rng.rand_real1_batch(num_samples)
     outcomes = np.searchsorted(cdf, draws * total, side="right")
     # genrand_real1 is inclusive of 1.0 (2^-32 per draw): clamp endpoint
     # overshoot to the LAST POSITIVE-probability outcome, never a zero one

@@ -137,14 +137,20 @@ class ParamCircuit(Circuit):
         return self._p("phase", (target,), angle, tuple(controls))
 
     def multi_rotate_z(self, targets, angle):
-        """exp(-i angle/2 Z⊗..⊗Z) on ``targets`` (ref: multiRotateZ)."""
+        """exp(-i angle/2 Z⊗..⊗Z) on ``targets`` (ref: multiRotateZ).
+        Non-Param angles take the static diagonal path (fusable)."""
+        if not isinstance(angle, Param):
+            return super().multi_rotate_z(targets, angle)
         return self._p("mrz", tuple(targets), angle)
 
     def multi_rotate_pauli(self, targets, paulis, angle):
         """exp(-i angle/2 P⊗..) for a Pauli string (ref: multiRotatePauli,
-        QuEST_common.c:411-448 — basis-change to Z, parity rotation, undo)."""
+        QuEST_common.c:411-448 — basis-change to Z, parity rotation, undo).
+        Non-Param angles take the static gate path (fusable)."""
         codes = tuple(int(p) for p in paulis)
         assert len(codes) == len(tuple(targets))
+        if not isinstance(angle, Param):
+            return super().multi_rotate_pauli(targets, codes, angle)
         return self._p("mrp", tuple(targets), angle, codes=codes)
 
     # --- parametric noise channels (density mode only) ---------------------
@@ -421,6 +427,9 @@ def _inverse_gate_op(op: GateOp) -> GateOp:
     unit-modulus diagonal is exact)."""
     if op.kind in ("x", "y", "swap"):
         return op  # self-inverse
+    if op.kind == "mrz":
+        return GateOp("mrz", op.targets, op.controls, op.control_states,
+                      (-op.matrix[0],), None)
     p = op.payload()
     if op.kind == "matrix":
         inv = np.stack([p[0].T, -p[1].T])  # conjugate transpose
@@ -460,6 +469,11 @@ def _gen_inner_im(lam, psi, op: ParamOp):
         chi = _ap.apply_diagonal(chi, jnp.asarray(base, dtype=chi.dtype),
                                  op.targets)
     elif op.kind == "mrp":
+        if not any(op.codes):
+            # all-identity string: the forward applies NOTHING (reference
+            # convention, QuEST_common.c:436-437), so dU/dtheta = 0 — without
+            # this skip chi = psi would contribute a spurious Im<lam|psi>
+            return jnp.zeros((), dtype=psi.dtype)
         for t, code in zip(op.targets, op.codes):
             if code == 1:
                 chi = _ap.apply_pauli_x(chi, t, (), ())

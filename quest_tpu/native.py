@@ -96,14 +96,7 @@ def _unpack(buf: bytes):
     return ops
 
 
-def fuse_ops(ops, max_pack: int = 7):
-    """Run the native fusion pass over a GateOp list; returns the (possibly
-    shorter) equivalent list, or the input unchanged if the library is
-    unavailable.  ``max_pack`` is the kron-packing width: 7 qubits = 128
-    basis states = one f32 MXU tile (pass 1 to disable packing)."""
-    lib = _ensure_lib()
-    if lib is None or not ops:
-        return list(ops)
+def _fuse_segment(ops, lib, max_pack: int):
     packed = _pack(ops)
     out_len = ctypes.c_int64()
     ptr = lib.quest_fuse_circuit(packed, len(packed), ctypes.byref(out_len),
@@ -113,6 +106,33 @@ def fuse_ops(ops, max_pack: int = 7):
     finally:
         lib.quest_free_buffer(ptr)
     return _unpack(data)
+
+
+def fuse_ops(ops, max_pack: int = 7):
+    """Run the native fusion pass over a GateOp list; returns the (possibly
+    shorter) equivalent list, or the input unchanged if the library is
+    unavailable.  ``max_pack`` is the kron-packing width: 7 qubits = 128
+    basis states = one f32 MXU tile (pass 1 to disable packing).
+
+    Kinds outside the fusion ABI (e.g. wide ``mrz`` parity rotations, whose
+    payload is an angle, not a matrix) act as barriers: the runs between
+    them fuse independently and the op itself passes through untouched."""
+    lib = _ensure_lib()
+    if lib is None or not ops:
+        return list(ops)
+    out: list = []
+    seg: list = []
+    for op in ops:
+        if op.kind in _KINDS:
+            seg.append(op)
+        else:
+            if seg:
+                out.extend(_fuse_segment(seg, lib, max_pack))
+                seg = []
+            out.append(op)
+    if seg:
+        out.extend(_fuse_segment(seg, lib, max_pack))
+    return out
 
 
 def available() -> bool:

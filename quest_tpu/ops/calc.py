@@ -191,10 +191,8 @@ def _structured_term(state: jax.Array, x: int, zy: int, yc: int):
 
 
 @partial(jax.jit, static_argnames=("terms",))
-def expec_pauli_sum_statevec(state: jax.Array, terms: tuple,
-                             coeffs: jax.Array) -> jax.Array:
-    """Re Σ_t c_t <ψ|P_t|ψ>, one fused structured pass per static term
-    (``terms`` = ((x, zy, yc), ...)); accumulation in float64."""
+def _expec_pauli_sum_statevec_unrolled(state: jax.Array, terms: tuple,
+                                       coeffs: jax.Array) -> jax.Array:
     coeffs = coeffs.astype(_ACC)
     acc = jnp.zeros((), _ACC)
     for i, (x, zy, yc) in enumerate(terms):
@@ -202,6 +200,65 @@ def expec_pauli_sum_statevec(state: jax.Array, terms: tuple,
         acc = acc + coeffs[i] * jnp.sum(t[0].astype(_ACC) * tr.astype(_ACC)
                                         + t[1].astype(_ACC) * ti.astype(_ACC))
     return acc
+
+
+# Above this many terms the unrolled structured path's compile time and
+# program size (one pass per term, retraced per distinct term tuple) swamp
+# its runtime win; the traced-mask scan is O(1)-trace.  The scan's dynamic
+# k^x gather is only safe BELOW the measured hazard size (a single 2^25-amp
+# dynamic gather ran ~1.5 s on v5e and a 49-term scan of them killed the
+# worker), so huge many-term states stay on the unrolled path.
+_SCAN_TERM_LIMIT = 32
+_SCAN_AMPS_LIMIT = 1 << 24
+
+
+def _term_mask_arrays(terms: tuple):
+    x = jnp.asarray([t[0] for t in terms], jnp.uint64)
+    zy = jnp.asarray([t[1] for t in terms], jnp.uint64)
+    yc = jnp.asarray([t[2] % 4 for t in terms], jnp.int32)
+    return x, zy, yc
+
+
+@jax.jit
+def _expec_pauli_sum_statevec_scan(state: jax.Array, x_masks: jax.Array,
+                                   zy_masks: jax.Array, y_phases: jax.Array,
+                                   coeffs: jax.Array) -> jax.Array:
+    """Traced-mask twin of the unrolled kernel: one lax.scan over the term
+    masks, so trace/compile cost is O(1) in term count (the molecular-
+    Hamiltonian regime: thousands of terms on a moderate state)."""
+    n_amps = state.shape[1]
+    dt = jnp.uint32 if n_amps <= (1 << 31) else jnp.uint64
+    k = jax.lax.iota(dt, n_amps)
+    re, im = state[0].astype(_ACC), state[1].astype(_ACC)
+
+    def body(acc, term):
+        xm, zym, yc, c = term
+        xm = xm.astype(dt)
+        zym = zym.astype(dt)
+        sign = 1.0 - 2.0 * (jax.lax.population_count(k & zym) & 1).astype(_ACC)
+        sx = 1.0 - 2.0 * (jax.lax.population_count(xm & zym) & 1).astype(_ACC)
+        pr = _PHASE_RE.astype(_ACC)[yc] * sx
+        pi = _PHASE_IM.astype(_ACC)[yc] * sx
+        flat = k ^ xm
+        gr = state[0][flat].astype(_ACC) * sign
+        gi = state[1][flat].astype(_ACC) * sign
+        t = jnp.sum(re * (pr * gr - pi * gi) + im * (pr * gi + pi * gr))
+        return acc + c * t, None
+
+    acc, _ = jax.lax.scan(body, jnp.zeros((), _ACC),
+                          (x_masks, zy_masks, y_phases, coeffs.astype(_ACC)))
+    return acc
+
+
+def expec_pauli_sum_statevec(state: jax.Array, terms: tuple,
+                             coeffs: jax.Array) -> jax.Array:
+    """Re Σ_t c_t <ψ|P_t|ψ> (``terms`` = ((x, zy, yc), ...)); accumulation in
+    float64.  Few terms: one fused structured pass per static term.  Many
+    terms on a below-hazard state: a traced-mask scan (O(1) trace size)."""
+    if len(terms) > _SCAN_TERM_LIMIT and state.shape[1] <= _SCAN_AMPS_LIMIT:
+        x, zy, yc = _term_mask_arrays(terms)
+        return _expec_pauli_sum_statevec_scan(state, x, zy, yc, coeffs)
+    return _expec_pauli_sum_statevec_unrolled(state, terms, coeffs)
 
 
 @partial(jax.jit, static_argnames=("num_qubits",))
@@ -351,10 +408,51 @@ def statevec_partial_trace(state: jax.Array, keep: tuple) -> jax.Array:
     return jnp.stack([rr.T.reshape(-1), ri.T.reshape(-1)]).astype(state.dtype)
 
 
-@partial(jax.jit, static_argnames=("terms",))
+@jax.jit
+def _apply_pauli_sum_scan(state: jax.Array, x_masks: jax.Array,
+                          zy_masks: jax.Array, y_phases: jax.Array,
+                          coeffs: jax.Array) -> jax.Array:
+    """Traced-mask twin of apply_pauli_sum for many-term sums on
+    below-hazard states (see _SCAN_TERM_LIMIT)."""
+    n_amps = state.shape[1]
+    dt = jnp.uint32 if n_amps <= (1 << 31) else jnp.uint64
+    k = jax.lax.iota(dt, n_amps)
+    sdt = state.dtype
+
+    def body(acc, term):
+        xm, zym, yc, c = term
+        xm = xm.astype(dt)
+        zym = zym.astype(dt)
+        sign = (1.0 - 2.0 * (jax.lax.population_count(k & zym) & 1)).astype(sdt)
+        sx = (1.0 - 2.0 * (jax.lax.population_count(xm & zym) & 1)).astype(sdt)
+        pr = _PHASE_RE.astype(sdt)[yc] * sx
+        pi = _PHASE_IM.astype(sdt)[yc] * sx
+        gr = state[0][k ^ xm] * sign
+        gi = state[1][k ^ xm] * sign
+        piece = c.astype(sdt) * jnp.stack([pr * gr - pi * gi,
+                                           pr * gi + pi * gr])
+        return acc + piece, None
+
+    out, _ = jax.lax.scan(body, jnp.zeros_like(state),
+                          (x_masks, zy_masks, y_phases, coeffs))
+    return out
+
+
 def apply_pauli_sum(state: jax.Array, terms: tuple,
                     coeffs: jax.Array) -> jax.Array:
-    """out = Σ_t c_t P_t ψ, one fused structured pass per static term
+    """out = Σ_t c_t P_t ψ — dispatcher twin of expec_pauli_sum_statevec:
+    traced-mask scan for many terms on below-hazard states, unrolled
+    structured passes otherwise."""
+    if len(terms) > _SCAN_TERM_LIMIT and state.shape[1] <= _SCAN_AMPS_LIMIT:
+        x, zy, yc = _term_mask_arrays(terms)
+        return _apply_pauli_sum_scan(state, x, zy, yc, coeffs)
+    return _apply_pauli_sum_unrolled(state, terms, coeffs)
+
+
+@partial(jax.jit, static_argnames=("terms",))
+def _apply_pauli_sum_unrolled(state: jax.Array, terms: tuple,
+                              coeffs: jax.Array) -> jax.Array:
+    """one fused structured pass per static term
     (ref: statevec_applyPauliSum, QuEST_common.c:493-515, which clones +
     applies + accumulates per term).  The accumulator stays in the state
     dtype: a state-sized f64 carry costs 4x HBM traffic on an f32 state, and

@@ -50,6 +50,12 @@ def comm_plan(circuit, num_devices: int, bytes_per_amp: int = 8) -> list:
         # + all-reduce); the select style masks elementwise — zero collectives
         ctrl_comm = bool(cross_c) and _control_style() == "slice"
 
+        if op.kind == "mrz":
+            # parity-phase rotation: iota+popcount elementwise multiply
+            # (ops/apply.py apply_multi_rotate_z) — comm-free on any sharding
+            plans.append(GatePlan(i, op.kind, op.targets, True, "none", 0))
+            continue
+
         if op.kind == "diagonal":
             # diagonal gates are broadcast multiplies — comm-free — and the
             # engine absorbs controls into the factor only while

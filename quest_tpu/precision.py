@@ -26,6 +26,8 @@ _REAL_EPS = {1: 1e-5, 2: 1e-13, 4: 1e-14}
 
 _DEFAULT_PRECISION = int(os.environ.get("QUEST_TPU_PRECISION", "2"))
 
+_WARNED_PREC4 = False
+
 
 class PrecisionConfig:
     """Mutable global default precision; per-Qureg dtype can override."""
@@ -36,6 +38,16 @@ class PrecisionConfig:
     def set(self, precision: int) -> None:
         if precision not in (1, 2, 4):
             raise ValueError(f"precision must be 1, 2 or 4, got {precision}")
+        if precision == 4:
+            global _WARNED_PREC4
+            if not _WARNED_PREC4:
+                _WARNED_PREC4 = True
+                import warnings
+                warnings.warn(
+                    "precision 4 (long double, QuEST_precision.h:51-66) has no "
+                    "TPU equivalent; mapping to precision 2 (float64). REAL_EPS "
+                    "uses the long-double table entry (1e-14).",
+                    RuntimeWarning, stacklevel=3)
         self.precision = precision
         self.real_eps = _REAL_EPS[precision]
         if precision == 1:

@@ -87,6 +87,70 @@ class MT19937:
         """Uniform on [0,1] with 32-bit resolution (matches reference draws)."""
         return self.genrand_int32() * (1.0 / 4294967295.0)
 
+    # -- batched draws (vectorized twist, draw-for-draw identical stream) --
+
+    def _twist_block_np(self, mt):
+        """One full MT19937 twist, vectorized.  ``mt`` is a uint32 ndarray of
+        length N, updated in place to the next block of raw state words.
+
+        Data-dependence: every y for kk < N-1 reads only pre-twist words
+        (each mt[kk] is written strictly after its y is formed); the second
+        loop's mt[kk-(N-M)] is a lag-(N-M) recurrence on already-twisted
+        words, resolved here in chunks of N-M; the final word reads new
+        mt[0]/mt[M-1].
+        """
+        import numpy as np
+        old = mt.copy()
+        y = (old[:-1] & _UPPER_MASK) | (old[1:] & _LOWER_MASK)
+        mag = np.where(y & 1, np.uint32(_MATRIX_A), np.uint32(0))
+        lag = _N - _M
+        mt[:lag] = old[_M:] ^ (y[:lag] >> 1) ^ mag[:lag]
+        start = lag
+        while start < _N - 1:
+            end = min(start + lag, _N - 1)
+            mt[start:end] = (mt[start - lag:end - lag]
+                             ^ (y[start:end] >> 1) ^ mag[start:end])
+            start = end
+        y_last = (int(old[_N - 1]) & _UPPER_MASK) | (int(mt[0]) & _LOWER_MASK)
+        mt[_N - 1] = int(mt[_M - 1]) ^ (y_last >> 1) ^ (_MATRIX_A if y_last & 1 else 0)
+
+    @staticmethod
+    def _temper_np(y):
+        y = y.copy()
+        y ^= y >> 11
+        y ^= (y << 7) & 0x9D2C5680
+        y ^= (y << 15) & 0xEFC60000
+        y ^= y >> 18
+        return y
+
+    def genrand_int32_batch(self, n: int):
+        """``n`` consecutive draws as a uint32 ndarray — the identical stream
+        ``genrand_int32`` would produce with ``n`` scalar calls, at numpy
+        speed (one vectorized twist per 624 outputs)."""
+        import numpy as np
+        n = int(n)
+        out = np.empty(n, np.uint32)
+        if n == 0:
+            return out
+        if self.mti == _N + 1:  # never seeded (scalar path parity)
+            self.init_genrand(5489)
+        mt = np.array(self.mt, np.uint32)
+        filled = 0
+        while filled < n:
+            if self.mti >= _N:
+                self._twist_block_np(mt)
+                self.mti = 0
+            take = min(_N - self.mti, n - filled)
+            out[filled:filled + take] = self._temper_np(
+                mt[self.mti:self.mti + take])
+            self.mti += take
+            filled += take
+        self.mt = [int(w) for w in mt]
+        return out
+
+    def genrand_real1_batch(self, n: int):
+        return self.genrand_int32_batch(n) * (1.0 / 4294967295.0)
+
 
 # The process-global generator, mirroring the reference's single static MT
 # state shared by all Quregs.
@@ -98,12 +162,37 @@ def seed_quest(seed_array) -> None:
     _GLOBAL.init_by_array([int(s) & _U32 for s in seed_array])
 
 
+def default_seed_array() -> list:
+    """This process's candidate default seeds: [msec-time, pid]
+    (ref: QuEST_common.c:182-204)."""
+    return [int(time.time() * 1000) & _U32, os.getpid() & _U32]
+
+
 def seed_quest_default() -> None:
-    """Default seeding by [msec-time, pid], ref: QuEST_common.c:182-204."""
-    msecs = int(time.time() * 1000)
-    pid = os.getpid()
-    seed_quest([msecs, pid])
+    """Default seeding by [msec-time, pid], ref: QuEST_common.c:182-204.
+
+    Multi-process contract: the reference broadcasts rank 0's seed array to
+    every rank before seeding (MPI_Bcast, QuEST_cpu_distributed.c:1318-1329)
+    so all ranks draw the identical measurement-outcome stream.  We reproduce
+    that with ``broadcast_one_to_all`` from process 0 whenever JAX runs
+    multi-process; without it two hosts would pick different collapse
+    outcomes and silently corrupt a shared sharded state.
+    """
+    seeds = default_seed_array()
+    import jax
+    if jax.process_count() > 1:
+        import numpy as np
+        from jax.experimental import multihost_utils
+        seeds = [int(s) for s in
+                 multihost_utils.broadcast_one_to_all(np.asarray(seeds, np.uint32))]
+    seed_quest(seeds)
 
 
 def rand_real1() -> float:
     return _GLOBAL.genrand_real1()
+
+
+def rand_real1_batch(n: int):
+    """``n`` draws from the global stream, vectorized (same stream order as
+    ``n`` calls to ``rand_real1``)."""
+    return _GLOBAL.genrand_real1_batch(n)

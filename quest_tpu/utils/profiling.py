@@ -69,7 +69,7 @@ def circuit_stats(circuit, num_qubits: int | None = None,
     dense = diag = cross = 0
     for op in circuit.ops:
         wires = tuple(op.targets) + tuple(op.controls)
-        if op.kind == "diagonal":
+        if op.kind in ("diagonal", "mrz"):  # mrz: elementwise parity phase
             diag += 1
         else:
             dense += 1

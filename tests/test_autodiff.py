@@ -321,3 +321,44 @@ def test_optimize_guard(env_local):
     pc.h(0).rx(1, pc.param())
     with pytest.raises(ValueError, match="static"):
         pc.optimize()
+
+
+def test_static_angle_mrz_mrp_record_static_gates(env_local):
+    """Non-Param angles in multi_rotate_z / multi_rotate_pauli take the
+    static GateOp path: the circuit stays fusable (optimize() accepts it)
+    and matches the eager API."""
+    from quest_tpu.autodiff import ParamOp
+
+    pc = qt.ParamCircuit(4)
+    pc.h(0).h(1).h(2).h(3)
+    pc.multi_rotate_z((0, 2), 0.41)
+    pc.multi_rotate_pauli((0, 1, 3), (1, 2, 3), -0.73)
+    pc.multi_rotate_pauli((1, 2), (0, 0), 0.5)  # all-identity: records nothing
+    assert not any(isinstance(op, ParamOp) for op in pc.ops)
+    pc.optimize()  # must not raise (ADVICE r4: static circuits stay fusable)
+
+    got = np.asarray(qt.state_fn(pc)(jnp.zeros(0)))
+    psi = qt.createQureg(4, env_local)
+    for t in range(4):
+        qt.hadamard(psi, t)
+    qt.multiRotateZ(psi, [0, 2], 0.41)
+    qt.multiRotatePauli(psi, [0, 1, 3], [1, 2, 3], -0.73)
+    qt.multiRotatePauli(psi, [1, 2], [0, 0], 0.5)
+    want = np.stack([np.asarray(psi.amps[0]), np.asarray(psi.amps[1])])
+    np.testing.assert_allclose(got, want, atol=SV_TOL)
+
+
+def test_adjoint_gradient_identity_pauli_string(env_local):
+    """An all-identity multiRotatePauli applies nothing (reference
+    convention), so its adjoint-method gradient must be exactly zero and
+    agree with jax.grad (ADVICE r4)."""
+    pc = qt.ParamCircuit(3)
+    t = pc.params(2)
+    pc.h(0).ry(1, t[0])
+    pc.multi_rotate_pauli((0, 1, 2), (0, 0, 0), t[1])  # all PAULI_I
+    h = tfim_hamiltonian(3, field=0.5)
+    params = jnp.asarray([0.37, 1.21])
+    e_adj, g_adj = qt.adjoint_gradient_fn(pc, h)(params)
+    g_jax = jax.grad(qt.expectation_fn(pc, h))(params)
+    np.testing.assert_allclose(np.asarray(g_adj), np.asarray(g_jax), atol=PS_TOL)
+    assert abs(float(g_adj[1])) < PS_TOL  # identity string: dE/dtheta == 0

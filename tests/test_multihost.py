@@ -76,6 +76,78 @@ print("WORKER" + str(pid) + " OK local_shards=" + str(nshards))
 """
 
 
+SEED_WORKER = r"""
+import os, sys
+pid = int(sys.argv[1]); port = sys.argv[2]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ.pop("JAX_PLATFORMS", None)
+sys.path.insert(0, @REPO@)
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address="127.0.0.1:" + port,
+                           num_processes=2, process_id=pid)
+
+import quest_tpu as qt
+
+# DEFAULT seeding only: createQuESTEnv broadcasts process 0's [msec, pid]
+# seed to every process (ref: MPI_Bcast, QuEST_cpu_distributed.c:1318-1329).
+# Neither worker calls seedQuEST.  Without the broadcast the two processes
+# would seed from their own distinct PIDs and diverge.
+env = qt.createQuESTEnv(num_devices=8)
+n = 8
+q = qt.createQureg(n, env)
+qt.initPlusState(q)
+outcomes = [qt.measure(q, t) for t in range(n)]
+assert abs(qt.calcTotalProb(q) - 1.0) < 1e-10
+
+# a fresh register and more draws: streams must stay in lockstep
+q2 = qt.createQureg(n, env)
+qt.initPlusState(q2)
+for t in range(n):
+    qt.hadamard(q2, t)
+qt.initPlusState(q2)
+outcomes += [qt.measure(q2, t) for t in range(0, n, 2)]
+assert abs(qt.calcTotalProb(q2) - 1.0) < 1e-10
+print("SEEDWORKER" + str(pid) + " OUTCOMES=" + "".join(map(str, outcomes)))
+"""
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="needs local TCP coordinator")
+def test_two_process_default_seed_broadcast(tmp_path):
+    """Both processes, seeded only by the DEFAULT path, must draw identical
+    measurement outcomes — the reference's seed-broadcast contract."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    src = tmp_path / "seed_worker.py"
+    src.write_text(SEED_WORKER.replace("@REPO@", repr(REPO)))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen([sys.executable, str(src), str(pid), str(port)],
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True, cwd=REPO, env=env)
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process workers timed out (coordinator hang?)")
+        outs.append((p.returncode, out, err))
+    seqs = []
+    for pid, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"worker {pid} failed\nstdout:\n{out}\nstderr:\n{err[-2000:]}"
+        line = [l for l in out.splitlines() if l.startswith(f"SEEDWORKER{pid}")]
+        assert line, out
+        seqs.append(line[0].split("OUTCOMES=")[1])
+    assert seqs[0] == seqs[1], f"divergent outcome streams: {seqs}"
+
+
 @pytest.mark.skipif(sys.platform != "linux", reason="needs local TCP coordinator")
 def test_two_process_distributed_checkpoint(tmp_path):
     with socket.socket() as s:
