@@ -103,7 +103,14 @@ def bench_random(n, depth, precision, fuse, seed=11, best_of=1):
     dtype = jnp.float32 if precision == 1 else jnp.float64
     circuit = random_circuit(n, depth=1, seed=seed)
     if fuse:
-        circuit.optimize()
+        # f64 pack policy: 2-qubit packs route through the gather engine
+        # (4 partner moves/pass — measured 1.54x the 7-wide packs' chunked
+        # emulated matmuls at 24q).  Wider f64 packs are ALSO blocked by an
+        # XLA:TPU X64-rewriter miscompilation: a 3q-pack program computes a
+        # wrong norm on-chip while the identical ops pass on CPU (see
+        # docs/DESIGN.md "f64 on TPU").  f32 keeps the full 7-qubit MXU
+        # packs.
+        circuit.optimize(max_pack=7 if precision == 1 else 2)
     ops = circuit.key()
 
     def layer(s):
@@ -388,10 +395,11 @@ def bench_density(n=14, depth=5, precision=2, seed=7):
     gate + shadow, then mixDamping and mixDepolarising per qubit pair
     (BASELINE config 4).
 
-    f32 runs the whole layer as one fused fori_loop program; f64 runs
-    per-qubit jitted steps with buffer donation — a 42-op f64 program at
-    2^28 amps exceeds HBM from scheduler liveness even with the engine's
-    chunked matmuls, while the per-step chain peaks at ~10 GiB."""
+    f32 runs the whole layer as one fused fori_loop program; f64 runs ONE
+    barriered donating program per layer (the barriers stop XLA from
+    overlapping two ops' state-sized temporaries, which is what pushed an
+    unbarriered 42-op f64 program over HBM; r04's per-op-program fallback
+    was dispatch-bound at ~0.24 s per tunnel round-trip)."""
     import numpy as np
     import jax.numpy as jnp
     from quest_tpu.ops import apply as _ap
@@ -474,41 +482,43 @@ def bench_density(n=14, depth=5, precision=2, seed=7):
             dt = time.perf_counter() - t0
         compute = max(dt - overhead, 1e-9)
     else:
-        # one DONATING program per op: at 4 GiB state even a 3-op f64
-        # program exceeds HBM from inter-op liveness; donation reuses the
-        # state allocation in place, keeping each single-op program at
-        # ~10 GiB peak (state + output alias + the engine's chunked-matmul
-        # temporaries) and implicitly serialising the chain
-        mk = partial(jax.jit, donate_argnums=(0,))
+        # ONE donating program per LAYER (42 ops), each op bounded by an
+        # optimization_barrier so XLA's scheduler cannot overlap two ops'
+        # state-sized temporaries (unbarriered, a 42-op f64 program exceeds
+        # HBM from inter-op liveness; r04 worked around it with one program
+        # per OP, which made the row dispatch-bound at ~0.24 s per tunnel
+        # round-trip x 126 ops).  Gates route through the engine's chunked
+        # fast-1q f64 kernel (_dense_1q_f64); the trace assert below guards
+        # the X64-rewriter miscompile classes documented in docs/DESIGN.md
+        # (plane-pair/multi-op variants of this layer compute wrong norms
+        # on-chip while passing on CPU).
+        @partial(jax.jit, donate_argnums=(0,))
+        def layer_f64(s):
+            for q, up, upc in gates:
+                s = _ap.apply_matrix(s, jnp.asarray(up, dtype=s.dtype), (q,))
+                s = jax.lax.optimization_barrier(s)
+                s = _ap.apply_matrix(s, jnp.asarray(upc, dtype=s.dtype),
+                                     (q + n,))
+                s = jax.lax.optimization_barrier(s)
+            for q in range(0, n, 2):
+                s = _deco.mix_damping(s, jnp.asarray(0.02, jnp.float64), q, n)
+                s = jax.lax.optimization_barrier(s)
+            for q in range(1, n, 2):
+                s = _deco.mix_depolarising(s, jnp.asarray(0.02, jnp.float64),
+                                           q, n)
+                s = jax.lax.optimization_barrier(s)
+            return s
 
-        steps = []
-        for q, up, upc in gates:
-            steps.append(mk(lambda s, up=up, q=q: _ap.apply_matrix(
-                s, jnp.asarray(up, dtype=s.dtype), (q,))))
-            steps.append(mk(lambda s, upc=upc, q=q: _ap.apply_matrix(
-                s, jnp.asarray(upc, dtype=s.dtype), (q + n,))))
-        for q in range(0, n, 2):
-            steps.append(mk(lambda s, q=q: _deco.mix_damping(
-                s, jnp.asarray(0.02, jnp.float64), q, n)))
-        for q in range(1, n, 2):
-            steps.append(mk(lambda s, q=q: _deco.mix_depolarising(
-                s, jnp.asarray(0.02, jnp.float64), q, n)))
-
-        s = fresh()
-        for f in steps:  # compile + warm every per-op program
-            s = f(s)
+        s = layer_f64(fresh())  # compile + warm
         float(trace_of(s))
         del s
-        # best of 2 timed passes: this config sits nearest the 1e8 target
-        # and its 42 sequential dispatches amplify tunnel-noise windows
-        # (observed 82 s vs 280 s for identical work)
+        # best of 2 timed passes against tunnel-noise windows
         dt = None
         for _ in range(2):
             s = fresh()
             t0 = time.perf_counter()
             for _ in range(depth):
-                for f in steps:
-                    s = f(s)
+                s = layer_f64(s)
             trace = float(trace_of(s))
             run_dt = time.perf_counter() - t0
             dt = run_dt if dt is None else min(dt, run_dt)
