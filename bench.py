@@ -571,6 +571,40 @@ def bench_qft_inplace(n, bit_reversal):
     return value, cfg
 
 
+def bench_qft30_api(n=30):
+    """The 30-qubit QFT through the PUBLIC API front door: a plane-storage
+    Qureg (qureg.py PLANE_STORAGE_MIN_BYTES) whose buffers the in-place
+    engine consumes directly; applyFullQFT defers the trailing bit-reversal
+    into the register's qubit_map, and the correctness probe reads the
+    logical amplitude THROUGH the map (getAmp translates indices)."""
+    import quest_tpu as qt
+
+    env = qt.createQuESTEnv(num_devices=1)
+    q = qt.createQureg(n, env, dtype="float32")
+    assert q.uses_plane_storage(), "expected plane-pair storage at 30q f32"
+    qt.initPlusState(q)
+    qt.applyFullQFT(q)  # compile + warm
+    assert q.qubit_map is not None  # deferred bit-reversal recorded
+    a0 = qt.getAmp(q, 0)
+    assert abs(a0.real - 1.0) < 1e-3, f"QFT(|+..+>) != |0..0>: amp0={a0}"
+    best = None
+    for _ in range(2):
+        qt.initPlusState(q)
+        t0 = time.perf_counter()
+        qt.applyFullQFT(q)
+        a0 = qt.getAmp(q, 0)  # device->host scalar bounds the timing
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    assert abs(a0.real - 1.0) < 1e-3, a0
+    gates = n + n * (n - 1) // 2
+    value = (1 << n) * gates / best
+    cfg = {"qubits": n, "precision": 1, "gates": gates, "seconds": best,
+           "engine": "pallas_inplace", "via": "public API (plane Qureg)",
+           "bit_reversed_output": True}
+    cfg.update(_roofline(1 << n, 1, 2 * (n - 17) + 1, best))
+    return value, cfg
+
+
 def bench_qft(n, precision=1, devices=None):
     """Full QFT pass: H + controlled-phase ladder + reversal swaps — the
     diagonal-gate + swap routing path (BASELINE config 5).  With ``devices``
@@ -718,6 +752,7 @@ def main() -> None:
         if platform != "cpu":
             add("qft_28q_f32_inplace_ordered", bench_qft_inplace, 28, True)
             add("qft_30q_f32_unordered", bench_qft_inplace, 30, False)
+            add("qft_30q_f32_public_api", bench_qft30_api)
         try:
             cpu = jax.devices("cpu")[:_N_VIRT]
         except RuntimeError:

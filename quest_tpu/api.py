@@ -38,6 +38,7 @@ from .ops import decoherence as _deco
 from .ops import init as _init
 from .ops import measure as _meas
 from .precision import real_eps
+from . import qureg as _qureg_mod
 from .qureg import (Qureg, create_clone_qureg, create_density_qureg,
                     create_qureg, destroy_qureg)
 from . import validation as V
@@ -145,6 +146,9 @@ def _apply_unitary(qureg: Qureg, u, targets, controls=(), control_states=()):
     (2, d, d) real pairs.  Density matrices dispatch ONE fused program for
     gate + shadow (apply_matrix_density) instead of two."""
     up = _ap.mat_pair(u)
+    if qureg._planes is not None and qureg.uses_plane_storage():
+        _apply_unitary_planes(qureg, up, tuple(targets), tuple(controls))
+        return
     if qureg.is_density_matrix:
         qureg.amps = _ap.apply_matrix_density(
             qureg.amps, up, tuple(targets), tuple(controls),
@@ -152,6 +156,22 @@ def _apply_unitary(qureg: Qureg, u, targets, controls=(), control_states=()):
     else:
         qureg.amps = _ap.apply_matrix(qureg.amps, up, targets, controls,
                                       control_states)
+
+
+def _apply_unitary_planes(qureg: Qureg, up, targets, controls):
+    """Plane-storage gate path (the 30q single-chip ceiling): single-qubit
+    dense gates run through the in-place Pallas engine
+    (ops/pallas_layer.apply_1q_gate_planes, one donated HBM pass); anything
+    wider needs the stacked engine, whose extra state copy is exactly what
+    this regime cannot hold."""
+    from .ops import pallas_layer as _pl
+
+    if len(targets) != 1 or controls:
+        V._throw("E_PLANE_ONLY_1Q", "applyUnitary")
+    target = qureg.logical_to_physical(targets[0])
+    re, im = qureg.take_planes()
+    re, im = _pl.apply_1q_gate_planes(re, im, up, target)
+    qureg.set_planes(re, im, qureg.qubit_map)
 
 
 def _diag_pair(diag) -> np.ndarray:
@@ -162,6 +182,13 @@ def _diag_pair(diag) -> np.ndarray:
 def _apply_diag(qureg: Qureg, diag, targets, controls=(), control_states=()):
     _maybe_clear_caches()
     dp = _diag_pair(diag)
+    if qureg._planes is not None and qureg.uses_plane_storage():
+        # a 1q diagonal is a dense 2x2; reuse the plane-mode gate path
+        if len(dp[0]) != 2 or len(targets) != 1 or controls:
+            V._throw("E_PLANE_ONLY_1Q", "applyDiagonal")
+        up = np.stack([np.diag(dp[0]), np.diag(dp[1])])
+        _apply_unitary_planes(qureg, up, tuple(targets), ())
+        return
     if qureg.is_density_matrix:
         qureg.amps = _ap.apply_diagonal_density(
             qureg.amps, dp, tuple(targets), tuple(controls),
@@ -284,12 +311,22 @@ def reportQuregParams(qureg: Qureg) -> None:
 # ---------------------------------------------------------------------------
 
 def initBlankState(qureg: Qureg) -> None:
-    qureg.set_amps_array(_init.blank_state(qureg.num_amps_total, qureg.dtype))
+    if qureg.uses_plane_storage():
+        qureg._planes = None  # free the old planes BEFORE allocating new
+        qureg.set_planes(*_init.blank_state_planes(qureg.num_amps_total,
+                                                   qureg.dtype))
+    else:
+        qureg.set_amps_array(_init.blank_state(qureg.num_amps_total, qureg.dtype))
     qureg.qasm.record_comment("Here, the register was initialised to an unphysical all-zero-amplitudes state.")
 
 
 def initZeroState(qureg: Qureg) -> None:
-    qureg.set_amps_array(_init.zero_state(qureg.num_amps_total, qureg.dtype))
+    if qureg.uses_plane_storage():
+        qureg._planes = None  # free the old planes BEFORE allocating new
+        qureg.set_planes(*_init.zero_state_planes(qureg.num_amps_total,
+                                                  qureg.dtype))
+    else:
+        qureg.set_amps_array(_init.zero_state(qureg.num_amps_total, qureg.dtype))
     qureg.qasm.record_init_zero()
 
 
@@ -297,6 +334,10 @@ def initPlusState(qureg: Qureg) -> None:
     if qureg.is_density_matrix:
         qureg.set_amps_array(_init.densmatr_plus_state(
             qureg.num_qubits_represented, qureg.dtype))
+    elif qureg.uses_plane_storage():
+        qureg._planes = None  # free the old planes BEFORE allocating new
+        qureg.set_planes(*_init.plus_state_planes(qureg.num_amps_total,
+                                                  qureg.dtype))
     else:
         qureg.set_amps_array(_init.plus_state(qureg.num_amps_total, qureg.dtype))
     qureg.qasm.record_init_plus()
@@ -307,6 +348,10 @@ def initClassicalState(qureg: Qureg, state_ind: int) -> None:
     if qureg.is_density_matrix:
         qureg.set_amps_array(_init.densmatr_classical_state(
             qureg.num_qubits_represented, int(state_ind), qureg.dtype))
+    elif qureg.uses_plane_storage():
+        qureg._planes = None  # free the old planes BEFORE allocating new
+        qureg.set_planes(*_init.classical_state_planes(
+            qureg.num_amps_total, int(state_ind), qureg.dtype))
     else:
         qureg.set_amps_array(_init.classical_state(
             qureg.num_amps_total, int(state_ind), qureg.dtype))
@@ -389,6 +434,10 @@ def compareStates(a: Qureg, b: Qureg, precision: float) -> bool:
 # ---------------------------------------------------------------------------
 
 def _amp_at(qureg: Qureg, index: int) -> complex:
+    if qureg._planes is not None:
+        idx = qureg.permute_amp_index(int(index))
+        re, im = qureg.planes
+        return complex(float(re[idx]), float(im[idx]))
     pair = np.asarray(qureg.amps[:, int(index)], dtype=np.float64)
     return complex(pair[0], pair[1])
 
@@ -567,6 +616,11 @@ _HADAMARD = np.array([[1, 1], [1, -1]], dtype=np.complex128) / math.sqrt(2)
 
 def pauliX(qureg: Qureg, target: int) -> None:
     V.validate_target(qureg, target, "pauliX")
+    if qureg._planes is not None and qureg.uses_plane_storage():
+        _apply_unitary_planes(qureg, _ap.mat_pair(np.array([[0, 1], [1, 0]])),
+                              (int(target),), ())
+        qureg.qasm.record_gate("sigma_x", (), int(target))
+        return
     amps = _ap.apply_pauli_x(qureg.amps, int(target))
     if qureg.is_density_matrix:
         amps = _ap.apply_pauli_x(amps, int(target) + qureg.num_qubits_represented)
@@ -576,6 +630,11 @@ def pauliX(qureg: Qureg, target: int) -> None:
 
 def pauliY(qureg: Qureg, target: int) -> None:
     V.validate_target(qureg, target, "pauliY")
+    if qureg._planes is not None and qureg.uses_plane_storage():
+        _apply_unitary_planes(qureg, _ap.mat_pair(np.array([[0, -1j], [1j, 0]])),
+                              (int(target),), ())
+        qureg.qasm.record_gate("sigma_y", (), int(target))
+        return
     amps = _ap.apply_pauli_y(qureg.amps, int(target))
     if qureg.is_density_matrix:
         # shadow is conj(Y) = -Y
@@ -925,6 +984,10 @@ def _prob_of_zero(qureg: Qureg, target: int) -> float:
     if qureg.is_density_matrix:
         return float(_meas.densmatr_prob_of_zero(
             qureg.amps, int(target), qureg.num_qubits_represented))
+    if qureg._planes is not None:
+        re, im = qureg.planes
+        return float(_meas.prob_of_zero_planes(
+            re, im, qureg.logical_to_physical(int(target))))
     return float(_meas.prob_of_zero(qureg.amps, int(target)))
 
 
@@ -936,6 +999,13 @@ def calcProbOfOutcome(qureg: Qureg, target: int, outcome: int) -> float:
 
 
 def _collapse(qureg: Qureg, target: int, outcome: int, prob: float) -> None:
+    if qureg._planes is not None:
+        t = qureg.logical_to_physical(int(target))
+        re, im = qureg.take_planes()
+        re, im = _meas.collapse_planes(re, im, t, int(outcome),
+                                       jnp.float64(prob))
+        qureg.set_planes(re, im, qureg.qubit_map)
+        return
     if qureg.is_density_matrix:
         qureg.amps = _meas.densmatr_collapse_to_outcome(
             qureg.amps, int(target), int(outcome), jnp.float64(prob),
@@ -1106,6 +1176,8 @@ def measure(qureg: Qureg, target: int) -> int:
 def calcTotalProb(qureg: Qureg) -> float:
     if qureg.is_density_matrix:
         return float(_calc.total_prob_densmatr(qureg.amps, qureg.num_qubits_represented))
+    if qureg._planes is not None:
+        return float(_meas.total_prob_planes(*qureg.planes))
     return float(_calc.total_prob_statevec(qureg.amps))
 
 
@@ -1493,27 +1565,52 @@ def applyQFT(qureg: Qureg, qubits, num_qubits=None) -> None:
         f"Here, a QFT was applied to {len(qubits)} qubits.")
 
 
+# At/above this qubit count the QFT engine's trailing bit-reversal cannot
+# fit (it needs a second copy of each plane in flight on the 15.75 GiB
+# chip), so applyFullQFT runs the transform UNORDERED and records the
+# reversal in the register's logical->physical qubit_map instead of paying
+# the data movement — the API translates through the map, so callers see
+# the ordered result.  Tests patch this down to exercise the deferred-map
+# path at small sizes.
+_QFT_UNORDERED_MIN_QUBITS = 30
+
+
 def applyFullQFT(qureg: Qureg) -> None:
     """QFT on every qubit of the register (QuEST v3.5's applyFullQFT name).
 
-    Statevector registers on an accelerator at f32 with n >= 17 route
-    through the in-place Pallas QFT engine (ops/qft_inplace.py — ~2(n-17)+1
-    HBM passes instead of n²/2 gates; measured 2.7e11 amps/s at 30q);
-    everything else takes the fused circuit program.  NOTE: the engine path
-    here stages the SoA planes, so peak memory is ~2 state copies — callers
-    at the 30-qubit single-chip ceiling should use
-    quest_tpu.ops.qft_inplace.qft_planes directly on plane storage."""
+    Statevector f32 registers with n >= 17 on an accelerator — and every
+    plane-storage register (the 30q single-chip ceiling) — route through
+    the in-place Pallas QFT engine (ops/qft_inplace.py — ~2(n-17)+1 HBM
+    passes instead of n²/2 gates; measured 2.7e11 amps/s at 30q), consuming
+    the register's own buffers (donated planes, one state copy of peak
+    HBM).  At n >= 30 the transform is stored bit-reversed with the
+    reversal deferred into ``qureg.qubit_map`` (see
+    _QFT_UNORDERED_MIN_QUBITS); everything else takes the fused circuit
+    program."""
     n = qureg.num_qubits_represented
     from .ops import qft_inplace as _qi
 
-    if (not qureg.is_density_matrix and qureg.dtype == jnp.float32
-            and _qi.layer_supported(n)
-            and (qureg.env is None or qureg.env.sharding is None)
-            and jax.default_backend() != "cpu"):
-        re, im = _qi.qft_planes(qureg.amps[0], qureg.amps[1])
-        qureg.amps = jnp.stack([re, im])
+    engine_ok = (not qureg.is_density_matrix
+                 and qureg.dtype == jnp.dtype(jnp.float32)
+                 and _qi.layer_supported(n)
+                 and (qureg.env is None or qureg.env.sharding is None)
+                 and (qureg._planes is not None
+                      or jax.default_backend() != "cpu"))
+    if engine_ok:
+        if qureg.qubit_map is not None:
+            # the engine assumes physical == logical order; reconcile the
+            # deferred permutation first (possible only below the ceiling)
+            if 2 * qureg.dtype.itemsize * qureg.num_amps_total >= _qureg_mod.PLANE_MATERIALIZE_LIMIT_BYTES:
+                V._throw(V.ErrorCode.PLANE_ONLY, "applyFullQFT")
+            qureg.materialize_stacked()  # reconciles the map
+        ordered = n < _QFT_UNORDERED_MIN_QUBITS
+        re, im = qureg.take_planes()
+        re, im = _qi.qft_planes(re, im, bit_reversal=ordered)
+        qureg.set_planes(re, im,
+                         None if ordered else tuple(range(n - 1, -1, -1)))
         qureg.qasm.record_comment(
-            f"Here, a full QFT was applied to {n} qubits (in-place engine).")
+            f"Here, a full QFT was applied to {n} qubits (in-place engine"
+            f"{'' if ordered else ', deferred bit-reversal'}).")
         return
     applyQFT(qureg, list(range(n)))
 

@@ -96,3 +96,30 @@ def weighted_qureg(fac1, state1, fac2, state2, fac_out, state_out) -> jax.Array:
         fr, fi = f[0].astype(s.dtype), f[1].astype(s.dtype)
         return jnp.stack([fr * s[0] - fi * s[1], fr * s[1] + fi * s[0]])
     return term(fac1, state1) + term(fac2, state2) + term(fac_out, state_out)
+
+
+# --- plane-pair initialisers (huge single-device registers; qureg.py) ------
+
+@partial(jax.jit, static_argnames=("num_amps", "dtype"))
+def zero_state_planes(num_amps: int, dtype):
+    return (jnp.zeros((num_amps,), dtype=dtype).at[0].set(1.0),
+            jnp.zeros((num_amps,), dtype=dtype))
+
+
+@partial(jax.jit, static_argnames=("num_amps", "dtype"))
+def blank_state_planes(num_amps: int, dtype):
+    return (jnp.zeros((num_amps,), dtype=dtype),
+            jnp.zeros((num_amps,), dtype=dtype))
+
+
+@partial(jax.jit, static_argnames=("num_amps", "dtype"))
+def plus_state_planes(num_amps: int, dtype):
+    norm = 1.0 / jnp.sqrt(jnp.asarray(num_amps, dtype=dtype))
+    return (jnp.full((num_amps,), norm, dtype=dtype),
+            jnp.zeros((num_amps,), dtype=dtype))
+
+
+@partial(jax.jit, static_argnames=("num_amps", "dtype"))
+def classical_state_planes(num_amps: int, state_ind, dtype):
+    return (jnp.zeros((num_amps,), dtype=dtype).at[state_ind].set(1.0),
+            jnp.zeros((num_amps,), dtype=dtype))

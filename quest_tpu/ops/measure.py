@@ -184,3 +184,35 @@ def densmatr_prob_all_outcomes(state: jax.Array, targets: tuple,
     """Joint outcome distribution from the density-matrix diagonal."""
     diag = densmatr_diagonal(state, num_qubits)[0].astype(_ACC)
     return _group_probs(diag, num_qubits, targets)
+
+
+# --- plane-pair twins (huge single-device registers; qureg.py) -------------
+
+@partial(jax.jit, static_argnames=("target",))
+def prob_of_zero_planes(re: jax.Array, im: jax.Array, target: int) -> jax.Array:
+    """P(bit ``target`` = 0) on plane-pair storage.  Products stay in the
+    plane dtype and only the REDUCTION accumulates in f64: an .astype(f64)
+    of a 4 GiB f32 plane would materialise the one extra state copy this
+    regime cannot hold."""
+    n = int(re.shape[0]).bit_length() - 1
+    mask = _bit_mask(n, int(target), 0)
+    return (jnp.sum(jnp.where(mask, re * re, 0), dtype=jnp.float64)
+            + jnp.sum(jnp.where(mask, im * im, 0), dtype=jnp.float64))
+
+
+@partial(jax.jit, static_argnames=("target", "outcome"), donate_argnums=(0, 1))
+def collapse_planes(re: jax.Array, im: jax.Array, target: int, outcome: int,
+                    outcome_prob: jax.Array):
+    """Collapse + renormalise on plane-pair storage — elementwise, so the
+    donated planes alias their outputs (in-place at the memory ceiling)."""
+    n = int(re.shape[0]).bit_length() - 1
+    mask = _bit_mask(n, int(target), int(outcome))
+    s = (1.0 / jnp.sqrt(outcome_prob)).astype(re.dtype)
+    zero = jnp.zeros((), re.dtype)
+    return jnp.where(mask, re * s, zero), jnp.where(mask, im * s, zero)
+
+
+@jax.jit
+def total_prob_planes(re: jax.Array, im: jax.Array) -> jax.Array:
+    return (jnp.sum(re * re, dtype=jnp.float64)
+            + jnp.sum(im * im, dtype=jnp.float64))

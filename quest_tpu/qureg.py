@@ -53,12 +53,35 @@ def _repin(value: jax.Array, sharding) -> jax.Array:
         return jax.device_put(value, sharding)
 
 
+# Statevectors at or above this size keep PLANE-PAIR storage (separate re
+# and im arrays) instead of the stacked (2, 2^n) array: the in-place Pallas
+# engines donate plane buffers, and at the 30-qubit f32 single-chip ceiling
+# (8 GiB state on a 15.75 GiB chip) the one extra state-sized transient a
+# plane<->stack conversion costs is exactly what does not fit.  Tests patch
+# this down to exercise plane mode at small sizes.
+PLANE_STORAGE_MIN_BYTES = 8 << 30
+
+# Materialising the stacked array from planes costs one extra state-sized
+# transient; at/above this state size that transient exceeds the chip, so
+# the amps getter refuses (separate knob so tests can run plane STORAGE at
+# small sizes while still exercising materialisation).
+PLANE_MATERIALIZE_LIMIT_BYTES = 8 << 30
+
+
 class Qureg:
     """Mutable shell over an immutable amplitude array (functional core,
     imperative surface — the QuEST API mutates, jnp does not).
 
     ``amps`` has shape (2, 2^n): stacked (re, im) real parts — see
-    ops/apply.py for why complex dtypes are avoided on TPU."""
+    ops/apply.py for why complex dtypes are avoided on TPU.
+
+    Huge single-device f32 statevectors instead hold ``planes`` (re, im as
+    separate arrays, see PLANE_STORAGE_MIN_BYTES) plus a logical->physical
+    ``qubit_map``: in-place engines that end in a qubit permutation (the
+    unordered 30q QFT's trailing bit reversal) record the permutation in
+    the map instead of paying the data movement, and the API translates
+    targets/amplitude indices through it (SURVEY §7.5's deferred-layout
+    table, single-device regime)."""
 
     def __init__(self, num_qubits: int, env: QuESTEnv,
                  is_density_matrix: bool = False, dtype=None):
@@ -68,9 +91,64 @@ class Qureg:
         self.env = env
         self.dtype = storage_dtype(dtype if dtype is not None else CONFIG.real_dtype)
         self._amps: jax.Array | None = None
+        self._planes: tuple | None = None
+        # qubit_map[logical] = physical amplitude-index bit; identity unless
+        # a plane-mode engine deferred a permutation
+        self.qubit_map: tuple | None = None
         self.qasm = QASMLogger(num_qubits)
         if env is not None and hasattr(env, "_register"):
             env._register(self)  # weak: lets syncQuESTEnv barrier this env
+
+    # --- plane-pair storage ------------------------------------------------
+    def uses_plane_storage(self) -> bool:
+        """True for single-device f32 statevectors at/above the plane
+        threshold (the regime served by the in-place Pallas engines)."""
+        return (not self.is_density_matrix
+                and self.dtype == jnp.dtype(jnp.float32)
+                and (self.env is None or self.env.sharding is None)
+                and 2 * 4 * self.num_amps_total >= PLANE_STORAGE_MIN_BYTES)
+
+    @property
+    def planes(self):
+        """(re, im) plane pair.  Plane-mode registers return their storage
+        directly; stacked registers return transient views."""
+        if self._planes is not None:
+            return self._planes
+        if self._amps is not None:
+            return (self._amps[0], self._amps[1])
+        return None
+
+    def set_planes(self, re: jax.Array, im: jax.Array,
+                   qubit_map: tuple | None = None) -> None:
+        """Install plane-pair amplitude storage (drops any stacked array).
+        ``qubit_map`` records a pending logical->physical bit permutation."""
+        self._planes = (re, im)
+        self._amps = None
+        self.qubit_map = qubit_map
+
+    def take_planes(self):
+        """Remove and return (re, im) for DONATION into an in-place engine:
+        the register drops its references so the engine may alias the
+        buffers.  Callers must set_planes() the result back."""
+        if self._planes is not None:
+            planes = self._planes
+            self._planes = None
+            return planes
+        amps = self._amps
+        self._amps = None
+        return (amps[0], amps[1])
+
+    def logical_to_physical(self, q: int) -> int:
+        return q if self.qubit_map is None else self.qubit_map[q]
+
+    def permute_amp_index(self, index: int) -> int:
+        """Map a logical amplitude index to its physical location."""
+        if self.qubit_map is None:
+            return index
+        out = 0
+        for logical, physical in enumerate(self.qubit_map):
+            out |= ((index >> logical) & 1) << physical
+        return out
 
     # --- ref-compatible aliases -------------------------------------------
     @property
@@ -88,6 +166,46 @@ class Qureg:
     # --- amplitude management ---------------------------------------------
     @property
     def amps(self) -> jax.Array | None:
+        if self._planes is not None:
+            if self.uses_plane_storage():
+                # plane-mode registers never silently convert: an implicit
+                # plane->stacked materialisation costs one extra state-sized
+                # transient (does not fit at the plane threshold) and would
+                # quietly route engines' workloads off the in-place path
+                from .validation import ErrorCode, _throw
+                _throw(ErrorCode.PLANE_ONLY)
+            # sub-threshold registers (an in-place engine handed back plane
+            # buffers, e.g. applyFullQFT at 17-29q) convert transparently
+            return self.materialize_stacked()
+        return self._amps
+
+    def materialize_stacked(self) -> jax.Array:
+        """Explicitly convert plane storage to the stacked (2, 2^n) array,
+        reconciling any deferred qubit permutation.  Costs one extra
+        state-sized transient — refused at/above the ceiling."""
+        if self._planes is not None:
+            if 2 * self.dtype.itemsize * self.num_amps_total >= PLANE_MATERIALIZE_LIMIT_BYTES:
+                from .validation import ErrorCode, _throw
+                _throw(ErrorCode.PLANE_ONLY, "materialize_stacked")
+            re, im = self._planes
+            self._planes = None
+            st = jnp.stack([re, im])
+            del re, im
+            if self.qubit_map is not None:
+                # reconcile the deferred permutation physically: pairwise
+                # swaps until every logical bit sits at its own position
+                # (callers of the stacked array assume physical == logical)
+                from .ops.apply import swap_qubit_amps
+                pos = list(self.qubit_map)
+                for logical in range(len(pos)):
+                    p = pos[logical]
+                    if p == logical:
+                        continue
+                    other = pos.index(logical)
+                    st = swap_qubit_amps(st, p, logical)
+                    pos[other], pos[logical] = p, logical
+                self.qubit_map = None
+            self._amps = st
         return self._amps
 
     @amps.setter
@@ -104,6 +222,11 @@ class Qureg:
                 and getattr(value, "sharding", None) != self.env.sharding):
             value = _repin(value, self.env.sharding)
         self._amps = value
+        # installing ANY value (including None — destroyQureg's eager free)
+        # supersedes plane storage; keeping the planes would leak the 8 GiB
+        # pair in exactly the regime plane storage exists for
+        self._planes = None
+        self.qubit_map = None
 
     def set_amps_array(self, amps: jax.Array) -> None:
         """Install a new amplitude array, preserving the Qureg's sharding."""
@@ -126,7 +249,10 @@ def create_qureg(num_qubits: int, env: QuESTEnv, dtype=None) -> Qureg:
     validate_create_num_qubits(num_qubits, env, "createQureg")
     from .ops import init as init_ops
     q = Qureg(num_qubits, env, is_density_matrix=False, dtype=dtype)
-    q.set_amps_array(init_ops.zero_state(q.num_amps_total, q.dtype))
+    if q.uses_plane_storage():
+        q.set_planes(*init_ops.zero_state_planes(q.num_amps_total, q.dtype))
+    else:
+        q.set_amps_array(init_ops.zero_state(q.num_amps_total, q.dtype))
     return q
 
 

@@ -95,6 +95,8 @@ class ErrorCode:
     INVALID_TROTTER_REPS = "E_INVALID_TROTTER_REPS"
     MISMATCHING_QUREG_DIAGONAL_OP_SIZE = "E_MISMATCHING_QUREG_DIAGONAL_OP_SIZE"
     DIAGONAL_OP_NOT_INITIALISED = "E_DIAGONAL_OP_NOT_INITIALISED"
+    PLANE_ONLY_1Q = "E_PLANE_ONLY_1Q"
+    PLANE_ONLY = "E_PLANE_ONLY"
 
 
 # Human-readable messages; tests substring-match these, mirroring the
@@ -166,6 +168,8 @@ MESSAGES = {
     ErrorCode.INVALID_TROTTER_REPS: "The number of Trotter repetitions must be >=1.",
     ErrorCode.MISMATCHING_QUREG_DIAGONAL_OP_SIZE: "The qureg must represent an equal number of qubits as that in the applied diagonal operator.",
     ErrorCode.DIAGONAL_OP_NOT_INITIALISED: "The diagonal operator has not been initialised through createDiagonalOperator().",
+    ErrorCode.PLANE_ONLY_1Q: "This register uses plane-pair storage (the single-chip memory ceiling); only single-qubit uncontrolled gates are supported at this size. Apply multi-qubit/controlled gates on a register below the plane-storage threshold.",
+    ErrorCode.PLANE_ONLY: "This register uses plane-pair storage (the single-chip memory ceiling); the requested operation needs the stacked amplitude array, which cannot be materialised at this size. Supported in plane mode: init*, single-qubit gates, applyFullQFT, measure/collapse, probabilities, amplitude reads.",
 }
 
 

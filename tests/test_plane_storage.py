@@ -1,0 +1,187 @@
+"""Plane-pair Qureg storage + deferred qubit-map (the 30q single-chip path).
+
+At the memory ceiling (PLANE_STORAGE_MIN_BYTES, default 8 GiB = 30 qubits
+f32) a Qureg holds separate (re, im) planes so the in-place Pallas engines
+can consume its buffers directly, and an unordered applyFullQFT records its
+trailing bit-reversal in ``qubit_map`` instead of paying the data movement.
+These tests patch the thresholds down to exercise the whole plane regime at
+18 qubits on CPU (Pallas interpret mode), comparing every operation against
+an ordinary stacked register driven through the same public API.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import api as qapi
+from quest_tpu import qureg as qmod
+
+N = 18  # >= 17: the Pallas layer/QFT engine floor
+ATOL = 5e-6  # f32 engine-vs-engine tolerance (matches test_pallas_layer)
+
+
+@pytest.fixture
+def plane_env(monkeypatch):
+    """Single-device env with the plane threshold lowered so an 18q f32
+    register uses plane storage."""
+    monkeypatch.setattr(qmod, "PLANE_STORAGE_MIN_BYTES", 2 * 4 * (1 << N))
+    return qt.createQuESTEnv(num_devices=1)
+
+
+def _pair(q):
+    """(2, 2^n) numpy view of a register's state: direct plane reads when
+    the map is identity, explicit materialisation (reconciling a deferred
+    map) otherwise."""
+    if q._planes is not None and q.qubit_map is None:
+        re, im = q.planes
+        return np.stack([np.asarray(re), np.asarray(im)])
+    if q._planes is not None:
+        return np.asarray(q.materialize_stacked())
+    return np.asarray(q.amps)
+
+
+def test_plane_register_creation_and_init(plane_env):
+    q = qt.createQureg(N, plane_env, dtype=jnp.float32)
+    assert q.uses_plane_storage()
+    assert q._planes is not None and q._amps is None
+    assert qt.calcTotalProb(q) == pytest.approx(1.0, abs=1e-6)
+    assert qt.getAmp(q, 0) == pytest.approx(1.0)
+    qt.initPlusState(q)
+    assert q._planes is not None
+    assert qt.calcTotalProb(q) == pytest.approx(1.0, abs=1e-5)
+    assert qt.getAmp(q, 3).real == pytest.approx(1.0 / np.sqrt(1 << N), rel=1e-5)
+    qt.initClassicalState(q, 5)
+    assert qt.getAmp(q, 5) == pytest.approx(1.0)
+    qt.initBlankState(q)
+    assert qt.calcTotalProb(q) == pytest.approx(0.0, abs=1e-7)
+
+
+def test_plane_1q_gates_match_stacked_register(plane_env):
+    qp = qt.createQureg(N, plane_env, dtype=jnp.float32)
+    qs = qt.createQureg(N, plane_env, dtype=jnp.float32)
+    assert qp.uses_plane_storage() and qs._amps is None  # both plane-eligible
+    # force the reference register onto STACKED storage explicitly
+    qs.materialize_stacked()
+    assert qs._planes is None and qs._amps is not None
+
+    for f, args in [(qt.hadamard, (0,)), (qt.hadamard, (N - 1,)),
+                    (qt.pauliX, (3,)), (qt.pauliY, (8,)), (qt.pauliZ, (11,)),
+                    (qt.rotateX, (5, 0.3)), (qt.rotateY, (12, -0.7)),
+                    (qt.rotateZ, (N - 2, 1.1)), (qt.tGate, (2,)),
+                    (qt.sGate, (9,)), (qt.phaseShift, (4, 0.37))]:
+        f(qp, *args)
+        f(qs, *args)
+    assert qp._planes is not None  # never silently fell back to stacked
+    np.testing.assert_allclose(_pair(qp), _pair(qs), atol=ATOL)
+    # probabilities agree through the API
+    for t in (0, 5, N - 1):
+        assert qt.calcProbOfOutcome(qp, t, 1) == pytest.approx(
+            qt.calcProbOfOutcome(qs, t, 1), abs=1e-5)
+
+
+def test_plane_multi_qubit_gate_refused(plane_env):
+    q = qt.createQureg(N, plane_env, dtype=jnp.float32)
+    with pytest.raises(qt.QuESTError, match="plane-pair"):
+        qt.controlledNot(q, 0, 1)
+    with pytest.raises(qt.QuESTError, match="plane-pair"):
+        qt.twoQubitUnitary(q, 0, 1, np.eye(4))
+    # the register is still usable afterwards
+    qt.hadamard(q, 0)
+    assert qt.calcTotalProb(q) == pytest.approx(1.0, abs=1e-5)
+
+
+def test_plane_full_qft_ordered(plane_env):
+    qp = qt.createQureg(N, plane_env, dtype=jnp.float32)
+    qs = qt.createQureg(N, plane_env, dtype=jnp.float32)
+    qs.materialize_stacked()
+    for t in (0, 4, N - 1):
+        qt.hadamard(qp, t)
+        qt.hadamard(qs, t)
+    qt.rotateY(qp, 7, 0.4)
+    qt.rotateY(qs, 7, 0.4)
+    qt.applyFullQFT(qp)  # in-place engine, donated planes, ordered
+    qt.applyQFT(qs, list(range(N)))  # circuit program on the stacked twin
+    assert qp.qubit_map is None
+    np.testing.assert_allclose(_pair(qp), _pair(qs), atol=ATOL)
+
+
+def test_plane_full_qft_deferred_bit_reversal(plane_env, monkeypatch):
+    """The >=30q mode at test size: unordered engine + qubit_map records the
+    reversal; reads, gates, measurement and materialisation all translate
+    through the map."""
+    monkeypatch.setattr(qapi, "_QFT_UNORDERED_MIN_QUBITS", N)
+    qp = qt.createQureg(N, plane_env, dtype=jnp.float32)
+    qs = qt.createQureg(N, plane_env, dtype=jnp.float32)
+    qs.materialize_stacked()
+    qt.hadamard(qp, 2)
+    qt.hadamard(qs, 2)
+    qt.rotateZ(qp, 9, 0.21)
+    qt.rotateZ(qs, 9, 0.21)
+    qt.applyFullQFT(qp)
+    qt.applyQFT(qs, list(range(N)))
+    assert qp.qubit_map == tuple(range(N - 1, -1, -1))
+
+    # amplitude reads translate through the map
+    for idx in (0, 1, 5, (1 << N) - 1, 12345):
+        a, b = qt.getAmp(qp, idx), qt.getAmp(qs, idx)
+        assert a == pytest.approx(b, abs=ATOL), idx
+    # probabilities on LOGICAL targets
+    for t in (0, 3, N - 1):
+        assert qt.calcProbOfOutcome(qp, t, 1) == pytest.approx(
+            qt.calcProbOfOutcome(qs, t, 1), abs=1e-5)
+
+    # gates on logical targets route to the mapped physical bit
+    qt.hadamard(qp, 1)
+    qt.hadamard(qs, 1)
+    qt.phaseShift(qp, N - 3, 0.5)
+    qt.phaseShift(qs, N - 3, 0.5)
+    for idx in (7, 99, 54321):
+        assert qt.getAmp(qp, idx) == pytest.approx(qt.getAmp(qs, idx),
+                                                   abs=ATOL)
+
+    # a second QFT forces map reconciliation (fits below the ceiling) and
+    # still matches the circuit result
+    qt.applyFullQFT(qp)
+    qt.applyQFT(qs, list(range(N)))
+    np.testing.assert_allclose(_pair(qp), _pair(qs), atol=5 * ATOL)
+
+
+def test_plane_measure_collapse(plane_env):
+    qp = qt.createQureg(N, plane_env, dtype=jnp.float32)
+    qs = qt.createQureg(N, plane_env, dtype=jnp.float32)
+    qs.materialize_stacked()
+    qt.initPlusState(qp)
+    qt.initPlusState(qs)
+    qt.seedQuEST([42])
+    op = qt.measure(qp, 4)
+    qt.seedQuEST([42])
+    os_ = qt.measure(qs, 4)
+    assert op == os_
+    assert qp._planes is not None
+    assert qt.calcTotalProb(qp) == pytest.approx(1.0, abs=1e-5)
+    np.testing.assert_allclose(_pair(qp), _pair(qs), atol=ATOL)
+    # collapseToOutcome through the API
+    p = qt.collapseToOutcome(qp, 6, 1)
+    ps = qt.collapseToOutcome(qs, 6, 1)
+    assert p == pytest.approx(ps, abs=1e-6)
+    np.testing.assert_allclose(_pair(qp), _pair(qs), atol=ATOL)
+
+
+def test_plane_materialisation_reconciles_map(plane_env, monkeypatch):
+    """Asking for the stacked array on a mapped register applies the
+    deferred permutation physically (below the ceiling)."""
+    monkeypatch.setattr(qapi, "_QFT_UNORDERED_MIN_QUBITS", N)
+    qp = qt.createQureg(N, plane_env, dtype=jnp.float32)
+    qs = qt.createQureg(N, plane_env, dtype=jnp.float32)
+    qs.materialize_stacked()
+    qt.hadamard(qp, 0)
+    qt.hadamard(qs, 0)
+    qt.applyFullQFT(qp)
+    qt.applyQFT(qs, list(range(N)))
+    assert qp.qubit_map is not None
+    st = np.asarray(qp.materialize_stacked())  # reconciles the map
+    assert qp.qubit_map is None
+    np.testing.assert_allclose(st, _pair(qs), atol=ATOL)
