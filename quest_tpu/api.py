@@ -139,6 +139,18 @@ def _maybe_clear_caches() -> None:
             jax.clear_caches()
 
 
+def _pinned(qureg: Qureg, state, fn, dyn: tuple, statics: tuple):
+    """Dispatch one op program with the env sharding pinned inside it
+    (ops/apply.py constrained_op): the eager multi-device path then never
+    needs the Qureg setter's corrective resharding pass — `_repin` stays a
+    debug fallback (its invocation count is asserted zero by the
+    distributed tests)."""
+    sh = qureg.env.sharding if qureg.env is not None else None
+    if sh is None:
+        return fn(state, *dyn, *statics)
+    return _ap.constrained_op(state, tuple(dyn), fn, tuple(statics), sh)
+
+
 def _apply_unitary(qureg: Qureg, u, targets, controls=(), control_states=()):
     _maybe_clear_caches()
     """Gate + conjugated shadow on the column side for density matrices
@@ -150,12 +162,16 @@ def _apply_unitary(qureg: Qureg, u, targets, controls=(), control_states=()):
         _apply_unitary_planes(qureg, up, tuple(targets), tuple(controls))
         return
     if qureg.is_density_matrix:
-        qureg.amps = _ap.apply_matrix_density(
-            qureg.amps, up, tuple(targets), tuple(controls),
-            tuple(control_states), qureg.num_qubits_represented)
+        qureg.amps = _pinned(
+            qureg, qureg.amps, _ap.apply_matrix_density, (jnp.asarray(up),),
+            (tuple(targets), tuple(controls), tuple(control_states),
+             qureg.num_qubits_represented))
     else:
-        qureg.amps = _ap.apply_matrix(qureg.amps, up, targets, controls,
-                                      control_states)
+        # apply_matrix keeps the eager Pallas fast-path dispatch on a single
+        # device; traced inside constrained_op its Pallas branch self-skips
+        qureg.amps = _pinned(
+            qureg, qureg.amps, _ap.apply_matrix, (jnp.asarray(up),),
+            (tuple(targets), tuple(controls), tuple(control_states)))
 
 
 def _apply_unitary_planes(qureg: Qureg, up, targets, controls):
@@ -190,12 +206,14 @@ def _apply_diag(qureg: Qureg, diag, targets, controls=(), control_states=()):
         _apply_unitary_planes(qureg, up, tuple(targets), ())
         return
     if qureg.is_density_matrix:
-        qureg.amps = _ap.apply_diagonal_density(
-            qureg.amps, dp, tuple(targets), tuple(controls),
-            tuple(control_states), qureg.num_qubits_represented)
+        qureg.amps = _pinned(
+            qureg, qureg.amps, _ap.apply_diagonal_density, (jnp.asarray(dp),),
+            (tuple(targets), tuple(controls), tuple(control_states),
+             qureg.num_qubits_represented))
     else:
-        qureg.amps = _ap.apply_diagonal(qureg.amps, dp, targets, controls,
-                                        control_states)
+        qureg.amps = _pinned(
+            qureg, qureg.amps, _ap.apply_diagonal, (jnp.asarray(dp),),
+            (tuple(targets), tuple(controls), tuple(control_states)))
 
 
 def _rotation_matrix(angle: float, axis) -> np.ndarray:
@@ -310,13 +328,20 @@ def reportQuregParams(qureg: Qureg) -> None:
 # state initialisation
 # ---------------------------------------------------------------------------
 
+def _pinned_init(qureg: Qureg, fn, statics: tuple):
+    """Initial states generated directly in the env sharding (each device
+    fills only its own window; no separate placement pass)."""
+    sh = qureg.env.sharding if qureg.env is not None else None
+    return _init.build_state(fn, statics, sh)
+
+
 def initBlankState(qureg: Qureg) -> None:
     if qureg.uses_plane_storage():
         qureg._planes = None  # free the old planes BEFORE allocating new
         qureg.set_planes(*_init.blank_state_planes(qureg.num_amps_total,
                                                    qureg.dtype))
     else:
-        qureg.set_amps_array(_init.blank_state(qureg.num_amps_total, qureg.dtype))
+        qureg.set_amps_array(_pinned_init(qureg, _init.blank_state, (qureg.num_amps_total, qureg.dtype)))
     qureg.qasm.record_comment("Here, the register was initialised to an unphysical all-zero-amplitudes state.")
 
 
@@ -326,35 +351,39 @@ def initZeroState(qureg: Qureg) -> None:
         qureg.set_planes(*_init.zero_state_planes(qureg.num_amps_total,
                                                   qureg.dtype))
     else:
-        qureg.set_amps_array(_init.zero_state(qureg.num_amps_total, qureg.dtype))
+        qureg.set_amps_array(_pinned_init(qureg, _init.zero_state, (qureg.num_amps_total, qureg.dtype)))
     qureg.qasm.record_init_zero()
 
 
 def initPlusState(qureg: Qureg) -> None:
     if qureg.is_density_matrix:
-        qureg.set_amps_array(_init.densmatr_plus_state(
-            qureg.num_qubits_represented, qureg.dtype))
+        qureg.set_amps_array(_pinned_init(
+            qureg, _init.densmatr_plus_state,
+            (qureg.num_qubits_represented, qureg.dtype)))
     elif qureg.uses_plane_storage():
         qureg._planes = None  # free the old planes BEFORE allocating new
         qureg.set_planes(*_init.plus_state_planes(qureg.num_amps_total,
                                                   qureg.dtype))
     else:
-        qureg.set_amps_array(_init.plus_state(qureg.num_amps_total, qureg.dtype))
+        qureg.set_amps_array(_pinned_init(
+            qureg, _init.plus_state, (qureg.num_amps_total, qureg.dtype)))
     qureg.qasm.record_init_plus()
 
 
 def initClassicalState(qureg: Qureg, state_ind: int) -> None:
     V.validate_state_index(qureg, state_ind, "initClassicalState")
     if qureg.is_density_matrix:
-        qureg.set_amps_array(_init.densmatr_classical_state(
-            qureg.num_qubits_represented, int(state_ind), qureg.dtype))
+        qureg.set_amps_array(_pinned_init(
+            qureg, _init.densmatr_classical_state,
+            (qureg.num_qubits_represented, int(state_ind), qureg.dtype)))
     elif qureg.uses_plane_storage():
         qureg._planes = None  # free the old planes BEFORE allocating new
         qureg.set_planes(*_init.classical_state_planes(
             qureg.num_amps_total, int(state_ind), qureg.dtype))
     else:
-        qureg.set_amps_array(_init.classical_state(
-            qureg.num_amps_total, int(state_ind), qureg.dtype))
+        qureg.set_amps_array(_pinned_init(
+            qureg, _init.classical_state,
+            (qureg.num_amps_total, int(state_ind), qureg.dtype)))
     qureg.qasm.record_init_classical(int(state_ind))
 
 
@@ -621,9 +650,10 @@ def pauliX(qureg: Qureg, target: int) -> None:
                               (int(target),), ())
         qureg.qasm.record_gate("sigma_x", (), int(target))
         return
-    amps = _ap.apply_pauli_x(qureg.amps, int(target))
+    amps = _pinned(qureg, qureg.amps, _ap.apply_pauli_x, (), (int(target),))
     if qureg.is_density_matrix:
-        amps = _ap.apply_pauli_x(amps, int(target) + qureg.num_qubits_represented)
+        amps = _pinned(qureg, amps, _ap.apply_pauli_x, (),
+                       (int(target) + qureg.num_qubits_represented,))
     qureg.amps = amps
     qureg.qasm.record_gate("sigma_x", (), int(target))
 
@@ -635,11 +665,11 @@ def pauliY(qureg: Qureg, target: int) -> None:
                               (int(target),), ())
         qureg.qasm.record_gate("sigma_y", (), int(target))
         return
-    amps = _ap.apply_pauli_y(qureg.amps, int(target))
+    amps = _pinned(qureg, qureg.amps, _ap.apply_pauli_y, (), (int(target),))
     if qureg.is_density_matrix:
         # shadow is conj(Y) = -Y
-        amps = _ap.apply_pauli_y(amps, int(target) + qureg.num_qubits_represented,
-                                 conj_fac=-1)
+        amps = _pinned(qureg, amps, _ap.apply_pauli_y, (),
+                       (int(target) + qureg.num_qubits_represented, (), (), -1))
     qureg.amps = amps
     qureg.qasm.record_gate("sigma_y", (), int(target))
 
@@ -714,10 +744,12 @@ def multiControlledPhaseFlip(qureg: Qureg, qubits, num_qubits=None) -> None:
 
 def controlledNot(qureg: Qureg, control: int, target: int) -> None:
     V.validate_control_target(qureg, control, target, "controlledNot")
-    amps = _ap.apply_pauli_x(qureg.amps, int(target), _ts(control))
+    amps = _pinned(qureg, qureg.amps, _ap.apply_pauli_x, (),
+                   (int(target), _ts(control)))
     if qureg.is_density_matrix:
         n = qureg.num_qubits_represented
-        amps = _ap.apply_pauli_x(amps, int(target) + n, _ts(int(control) + n))
+        amps = _pinned(qureg, amps, _ap.apply_pauli_x, (),
+                       (int(target) + n, _ts(int(control) + n)))
     qureg.amps = amps
     qureg.qasm.record_gate("sigma_x", _ts(control), int(target))
 
@@ -735,10 +767,12 @@ def controlledPauliY(qureg: Qureg, control: int, target: int) -> None:
 
 def swapGate(qureg: Qureg, q1: int, q2: int) -> None:
     V.validate_unique_targets(qureg, q1, q2, "swapGate")
-    amps = _ap.swap_qubit_amps(qureg.amps, int(q1), int(q2))
+    amps = _pinned(qureg, qureg.amps, _ap.swap_qubit_amps, (),
+                   (int(q1), int(q2)))
     if qureg.is_density_matrix:
         n = qureg.num_qubits_represented
-        amps = _ap.swap_qubit_amps(amps, int(q1) + n, int(q2) + n)
+        amps = _pinned(qureg, amps, _ap.swap_qubit_amps, (),
+                       (int(q1) + n, int(q2) + n))
     qureg.amps = amps
     qureg.qasm.record_comment(
         f"Here, a swap gate was applied to qubits {int(q1)} and {int(q2)}")
@@ -765,10 +799,12 @@ def multiRotateZ(qureg: Qureg, qubits, num_qubits=None, angle=None) -> None:
         qubits = _ts(qubits)[:int(num_qubits)]
     qubits = _ts(qubits)
     V.validate_multi_targets(qureg, qubits, "multiRotateZ")
-    amps = _ap.apply_multi_rotate_z(qureg.amps, jnp.float64(angle), qubits)
+    amps = _pinned(qureg, qureg.amps, _ap.apply_multi_rotate_z,
+                   (jnp.float64(angle),), (qubits,))
     if qureg.is_density_matrix:
         n = qureg.num_qubits_represented
-        amps = _ap.apply_multi_rotate_z(amps, jnp.float64(-angle), _shift(qubits, n))
+        amps = _pinned(qureg, amps, _ap.apply_multi_rotate_z,
+                       (jnp.float64(-angle),), (_shift(qubits, n),))
     qureg.amps = amps
     qureg.qasm.record_comment(
         f"Here, a multiRotateZ of angle {float(angle):g} was applied.")
@@ -1007,12 +1043,24 @@ def _collapse(qureg: Qureg, target: int, outcome: int, prob: float) -> None:
         qureg.set_planes(re, im, qureg.qubit_map)
         return
     if qureg.is_density_matrix:
-        qureg.amps = _meas.densmatr_collapse_to_outcome(
-            qureg.amps, int(target), int(outcome), jnp.float64(prob),
-            qureg.num_qubits_represented)
+        qureg.amps = _pinned(
+            qureg, qureg.amps, _collapse_dm_fn, (jnp.float64(prob),),
+            (int(target), int(outcome), qureg.num_qubits_represented))
     else:
-        qureg.amps = _meas.collapse_to_outcome(
-            qureg.amps, int(target), int(outcome), jnp.float64(prob))
+        qureg.amps = _pinned(
+            qureg, qureg.amps, _collapse_sv_fn, (jnp.float64(prob),),
+            (int(target), int(outcome)))
+
+
+def _collapse_sv_fn(state, prob, target, outcome):
+    """collapse_to_outcome with prob as the leading dynamic operand (module-
+    level def: a stable identity for constrained_op's static-fn cache)."""
+    return _meas.collapse_to_outcome(state, target, outcome, prob)
+
+
+def _collapse_dm_fn(state, prob, target, outcome, num_qubits):
+    return _meas.densmatr_collapse_to_outcome(state, target, outcome, prob,
+                                              num_qubits)
 
 
 def collapseToOutcome(qureg: Qureg, target: int, outcome: int) -> float:
@@ -1337,8 +1385,9 @@ def mixDephasing(qureg: Qureg, target: int, prob: float) -> None:
     V.validate_density_matr_qureg(qureg, "mixDephasing")
     V.validate_target(qureg, target, "mixDephasing")
     V.validate_one_qubit_dephase_prob(prob, "mixDephasing")
-    qureg.amps = _deco.mix_dephasing(qureg.amps, jnp.float64(prob), int(target),
-                                     qureg.num_qubits_represented)
+    qureg.amps = _pinned(qureg, qureg.amps, _deco.mix_dephasing,
+                         (jnp.float64(prob),),
+                         (int(target), qureg.num_qubits_represented))
     qureg.qasm.record_comment(
         f"Here, a phase-damping channel of probability {prob:g} was applied to qubit {int(target)}")
 
@@ -1347,8 +1396,9 @@ def mixTwoQubitDephasing(qureg: Qureg, q1: int, q2: int, prob: float) -> None:
     V.validate_density_matr_qureg(qureg, "mixTwoQubitDephasing")
     V.validate_unique_targets(qureg, q1, q2, "mixTwoQubitDephasing")
     V.validate_two_qubit_dephase_prob(prob, "mixTwoQubitDephasing")
-    qureg.amps = _deco.mix_two_qubit_dephasing(
-        qureg.amps, jnp.float64(prob), int(q1), int(q2), qureg.num_qubits_represented)
+    qureg.amps = _pinned(qureg, qureg.amps, _deco.mix_two_qubit_dephasing,
+                         (jnp.float64(prob),),
+                         (int(q1), int(q2), qureg.num_qubits_represented))
     qureg.qasm.record_comment(
         f"Here, a two-qubit dephasing channel of probability {prob:g} was applied.")
 
@@ -1357,8 +1407,9 @@ def mixDepolarising(qureg: Qureg, target: int, prob: float) -> None:
     V.validate_density_matr_qureg(qureg, "mixDepolarising")
     V.validate_target(qureg, target, "mixDepolarising")
     V.validate_one_qubit_depol_prob(prob, "mixDepolarising")
-    qureg.amps = _deco.mix_depolarising(qureg.amps, jnp.float64(prob), int(target),
-                                        qureg.num_qubits_represented)
+    qureg.amps = _pinned(qureg, qureg.amps, _deco.mix_depolarising,
+                         (jnp.float64(prob),),
+                         (int(target), qureg.num_qubits_represented))
     qureg.qasm.record_comment(
         f"Here, a depolarising channel of probability {prob:g} was applied to qubit {int(target)}")
 
@@ -1367,8 +1418,9 @@ def mixDamping(qureg: Qureg, target: int, prob: float) -> None:
     V.validate_density_matr_qureg(qureg, "mixDamping")
     V.validate_target(qureg, target, "mixDamping")
     V.validate_one_qubit_damping_prob(prob, "mixDamping")
-    qureg.amps = _deco.mix_damping(qureg.amps, jnp.float64(prob), int(target),
-                                   qureg.num_qubits_represented)
+    qureg.amps = _pinned(qureg, qureg.amps, _deco.mix_damping,
+                         (jnp.float64(prob),),
+                         (int(target), qureg.num_qubits_represented))
     qureg.qasm.record_comment(
         f"Here, an amplitude damping channel of probability {prob:g} was applied to qubit {int(target)}")
 

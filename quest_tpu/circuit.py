@@ -224,11 +224,37 @@ def _shadow_op(op: GateOp, n: int) -> GateOp:
                   conj_matrix, op.shape)
 
 
+def _apply_one_routed(state: jax.Array, op: GateOp, perm: tuple):
+    """Apply one op under a deferred logical->physical bit permutation:
+    dense gates may extend the permutation instead of swapping back
+    (ops/apply.py apply_matrix_routed); every other kind is position-
+    agnostic and just translates its wires.  Returns (state, perm)."""
+    if op.kind == "matrix":
+        u = jnp.asarray(op.payload(), dtype=state.dtype)
+        return _ap.apply_matrix_routed(state, u, op.targets, op.controls,
+                                       op.control_states, perm)
+    t = tuple(perm[q] for q in op.targets)
+    c = tuple(perm[q] for q in op.controls)
+    if t != op.targets or c != op.controls:
+        op = GateOp(op.kind, t, c, op.control_states, op.matrix, op.shape)
+    return _apply_one(state, op), perm
+
+
+def _run_ops_routed(state: jax.Array, ops: tuple) -> jax.Array:
+    """Whole-program op chain with deferred routing: wide minor-block gates
+    swap INTO prefix positions once and the swap-back is paid once at the
+    end (reconcile) instead of per gate — on a sharded state each avoided
+    pair is two avoided all-to-alls (the reference's own unfixed TODO,
+    QuEST_cpu_distributed.c:1376-1379)."""
+    perm = tuple(range(_ap.num_qubits_of(state)))
+    for op in ops:
+        state, perm = _apply_one_routed(state, op, perm)
+    return _ap.reconcile_perm(state, perm)
+
+
 @partial(jax.jit, static_argnames=("ops",))
 def _run_ops(state: jax.Array, ops: tuple) -> jax.Array:
-    for op in ops:
-        state = _apply_one(state, op)
-    return state
+    return _run_ops_routed(state, ops)
 
 
 def compile_circuit(circuit: Circuit, donate: bool = False):
@@ -239,9 +265,7 @@ def compile_circuit(circuit: Circuit, donate: bool = False):
     if donate:
         @partial(jax.jit, donate_argnums=(0,))
         def run(state: jax.Array) -> jax.Array:
-            for op in ops:
-                state = _apply_one(state, op)
-            return state
+            return _run_ops_routed(state, ops)
         return run
 
     def run(state: jax.Array) -> jax.Array:

@@ -779,6 +779,79 @@ def apply_matrix(state: jax.Array, u: jax.Array, targets: tuple,
                              tuple(control_states))
 
 
+@partial(jax.jit, static_argnames=("fn", "statics", "out_sharding"))
+def constrained_op(state: jax.Array, dyn: tuple, fn, statics: tuple,
+                   out_sharding) -> jax.Array:
+    """Run ``fn(state, *dyn, *statics)`` with the result PINNED to
+    ``out_sharding`` inside the same compiled program.
+
+    The eager multi-device dispatch path: op programs jitted without output
+    constraints let GSPMD hand back a drifted layout (measured: cross-shard
+    gates and channels return replicated or re-partitioned states), which
+    the Qureg then corrected with a separate full-state resharding pass
+    (`qureg._repin`).  Folding a `with_sharding_constraint` into the op's
+    own program removes that corrective pass — the partitioner produces the
+    env layout directly.  Cached per (fn, statics, sharding, shapes)."""
+    out = fn(state, *dyn, *statics)
+    return jax.lax.with_sharding_constraint(out, out_sharding)
+
+
+def apply_matrix_routed(state: jax.Array, u: jax.Array, targets: tuple,
+                        controls: tuple, control_states: tuple, perm: tuple):
+    """Deferred-layout dense gate for compiled circuit programs: like
+    :func:`_apply_matrix_xla` but WITHOUT the post-gate swap-back — any
+    reroute swaps stay in place and update ``perm`` (logical->physical bit
+    positions), so consecutive wide gates share one routing instead of
+    paying the reference's swap-in/swap-out per gate (the TODO at
+    QuEST_cpu_distributed.c:1376-1379; SURVEY §7.5).  ``targets``/
+    ``controls`` are LOGICAL; returns (state, perm)."""
+    n = num_qubits_of(state)
+    targets = tuple(int(t) for t in targets)
+    controls = tuple(int(c) for c in controls)
+    if not control_states:
+        control_states = (1,) * len(controls)
+    control_states = tuple(int(s) for s in control_states)
+    phys_t = tuple(perm[q] for q in targets)
+    phys_c = tuple(perm[c] for c in controls)
+    if _use_gather(state.dtype, len(targets), None):
+        # the gather engine moves partners directly at any gate width — no
+        # reroute (and so nothing to defer); mirror _apply_matrix_xla's
+        # dispatch order, which checks this before planning
+        return (_apply_matrix_xla(state, u, phys_t, phys_c, control_states),
+                perm)
+    plan = _gate_plan(n, phys_t, phys_c, control_states, False)
+    if not plan.reroute:
+        return (_apply_matrix_xla(state, u, phys_t, phys_c, control_states),
+                perm)
+    mapping = dict(plan.reroute)
+    new_perm = list(perm)
+    for a, b in plan.reroute:
+        state = swap_qubit_amps(state, a, b)
+        for logical, p in enumerate(new_perm):
+            if p == a:
+                new_perm[logical] = b
+            elif p == b:
+                new_perm[logical] = a
+    state = _apply_matrix_xla(
+        state, u, tuple(mapping.get(q, q) for q in phys_t),
+        tuple(mapping.get(c, c) for c in phys_c), control_states)
+    return state, tuple(new_perm)
+
+
+def reconcile_perm(state: jax.Array, perm: tuple) -> jax.Array:
+    """Physically restore logical == physical bit order via pairwise swaps
+    (the lazy reconciliation at the end of a compiled program)."""
+    pos = list(perm)
+    for logical in range(len(pos)):
+        p = pos[logical]
+        if p == logical:
+            continue
+        other = pos.index(logical)
+        state = swap_qubit_amps(state, p, logical)
+        pos[other], pos[logical] = p, logical
+    return state
+
+
 @partial(jax.jit, static_argnames=("targets", "controls", "control_states"))
 def _apply_matrix_xla(state: jax.Array, u: jax.Array, targets: tuple,
                       controls: tuple = (), control_states: tuple = ()) -> jax.Array:

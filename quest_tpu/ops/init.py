@@ -123,3 +123,22 @@ def plus_state_planes(num_amps: int, dtype):
 def classical_state_planes(num_amps: int, state_ind, dtype):
     return (jnp.zeros((num_amps,), dtype=dtype).at[state_ind].set(1.0),
             jnp.zeros((num_amps,), dtype=dtype))
+
+
+def build_state(fn, statics: tuple, sharding=None) -> jax.Array:
+    """One dispatch point for initial-state construction: plain call on a
+    single device, sharding-pinned program on a mesh (each device generates
+    only its own window)."""
+    if sharding is None:
+        return fn(*statics)
+    return constrained_init(fn, tuple(statics), sharding)
+
+
+@partial(jax.jit, static_argnames=("fn", "statics", "out_sharding"))
+def constrained_init(fn, statics: tuple, out_sharding) -> jax.Array:
+    """Build an initial state directly IN the env sharding: each device
+    generates only its own window (the module docstring's claim, now true
+    for the eager create/init path too — unconstrained, the init programs
+    produce a single-device array that the Qureg then redistributed with a
+    separate placement pass)."""
+    return jax.lax.with_sharding_constraint(fn(*statics), out_sharding)

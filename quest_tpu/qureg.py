@@ -31,6 +31,9 @@ def _repin_fn(sharding):
     return jax.jit(lambda x: x, out_shardings=sharding)
 
 
+REPIN_COUNT = 0  # corrective reshards taken (should stay 0: see _repin)
+
+
 def _repin(value: jax.Array, sharding) -> jax.Array:
     """Re-lay ``value`` out as ``sharding``.
 
@@ -41,12 +44,14 @@ def _repin(value: jax.Array, sharding) -> jax.Array:
     asynchronously, and the trace is cached per sharding.  ``device_put``
     remains as the fallback should a sharding ever reject the jit route.
 
-    A drifted eager op pays one resharding pass here; the deeper fix —
-    pinning the layout inside each op's compiled program via
-    ``with_sharding_constraint`` (a static ``out_sharding`` argument on the
-    op layer) — would remove the corrective pass entirely and is the
-    natural next step if eager multi-device dispatch becomes a hot path
-    (compiled whole-circuit programs never take this branch)."""
+    This is now the DEBUG FALLBACK, not the mechanism: the eager API
+    dispatches ops with the env sharding pinned inside the compiled program
+    (api.py `_pinned` -> ops/apply.py `constrained_op`; init programs via
+    `constrained_init`), so this corrective pass should never run —
+    `REPIN_COUNT` tracks invocations and the distributed tests assert it
+    stays zero across eager sequences."""
+    global REPIN_COUNT
+    REPIN_COUNT += 1
     try:
         return _repin_fn(sharding)(value)
     except Exception:
@@ -252,7 +257,9 @@ def create_qureg(num_qubits: int, env: QuESTEnv, dtype=None) -> Qureg:
     if q.uses_plane_storage():
         q.set_planes(*init_ops.zero_state_planes(q.num_amps_total, q.dtype))
     else:
-        q.set_amps_array(init_ops.zero_state(q.num_amps_total, q.dtype))
+        q.set_amps_array(init_ops.build_state(
+            init_ops.zero_state, (q.num_amps_total, q.dtype),
+            env.sharding if env is not None else None))
     return q
 
 
@@ -261,7 +268,9 @@ def create_density_qureg(num_qubits: int, env: QuESTEnv, dtype=None) -> Qureg:
     validate_create_num_qubits(num_qubits, env, "createDensityQureg", factor=2)
     from .ops import init as init_ops
     q = Qureg(num_qubits, env, is_density_matrix=True, dtype=dtype)
-    q.set_amps_array(init_ops.zero_state(q.num_amps_total, q.dtype))
+    q.set_amps_array(init_ops.build_state(
+        init_ops.zero_state, (q.num_amps_total, q.dtype),
+        env.sharding if env is not None else None))
     return q
 
 

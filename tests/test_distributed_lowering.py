@@ -276,3 +276,86 @@ def test_apply_pauli_sum_local_terms_comm_free(sharding):
     text = _compiled_text(f, state, sharding=sharding, pin_out=True)
     counts = _count_comm(text)
     assert not counts, f"unexpected comm for minor-block terms: {counts}"
+
+
+def test_deferred_reroute_amortises_exchanges(sharding):
+    """SURVEY §7.5 / the reference's own TODO (QuEST_cpu_distributed.c:
+    1376-1379): a wide minor-block gate needs reroute swaps that are
+    all-to-all exchanges on a sharded state.  The compiled-circuit path
+    defers the swap-back, so a SECOND identical wide gate adds ZERO
+    state-sized exchanges (it reuses the routing), where the eager per-gate
+    path pays the full swap-in/swap-out again."""
+    from quest_tpu.circuit import Circuit, compile_circuit
+    from oracle import random_unitary
+
+    n = 14  # top 3 qubits sharded on the 8-device mesh
+    np.random.seed(9)
+    u3 = random_unitary(3)
+    mesh_sharding = sharding
+    shard_row = (1 << n) // 8
+
+    def counts_for(num_gates):
+        c = Circuit(n)
+        for _ in range(num_gates):
+            c.multi_qubit_unitary((0, 8, 10), u3)
+        fn = compile_circuit(c)
+        text = _compiled_text(fn, jnp.zeros((2, 1 << n), jnp.float32),
+                              sharding=mesh_sharding)
+        return sum(_count_comm(text, min_elems=shard_row // 2).values())
+
+    one, two, three = counts_for(1), counts_for(2), counts_for(3)
+    assert one > 0  # the routing genuinely communicates on this mesh
+    # marginal exchanges of each ADDITIONAL wide gate on the same wires: 0
+    assert two == one, (one, two)
+    assert three == one, (one, three)
+
+    # The EAGER dispatch path compiles one program per gate; each program
+    # pays its own routing exchanges and no cross-program cancellation is
+    # possible (within ONE program the partitioner does cancel adjacent
+    # swap-back/swap-in pairs — the deferred-perm path makes that guarantee
+    # structural instead of CSE-dependent).  Two eager programs therefore
+    # cost 2x the exchanges of the two-gate compiled circuit.
+    def eager_one_gate_count():
+        def fn(s):
+            return _ap._apply_matrix_xla(
+                s, jnp.asarray(_ap.mat_pair(u3), jnp.float32),
+                (0, 8, 10), (), ())
+        text = _compiled_text(fn, jnp.zeros((2, 1 << n), jnp.float32),
+                              sharding=mesh_sharding)
+        return sum(_count_comm(text, min_elems=shard_row // 2).values())
+
+    per_program = eager_one_gate_count()
+    assert per_program >= one  # one program >= the whole deferred circuit
+    assert 2 * per_program > two, (per_program, two)
+
+
+def test_eager_sequence_zero_corrective_reshards(env_dist):
+    """VERDICT r4 #5: the env sharding is pinned INSIDE each eager op's
+    compiled program (api._pinned / ops.apply.constrained_op), so the Qureg
+    setter's corrective resharding pass (`qureg._repin`) must never fire
+    across an eager create/init/gate/channel/measure sequence on a mesh."""
+    from quest_tpu import qureg as qmod
+
+    before = qmod.REPIN_COUNT
+    q = qt.createQureg(N, env_dist)
+    qt.initPlusState(q)
+    qt.hadamard(q, N - 1)
+    qt.controlledNot(q, 0, N - 1)
+    qt.pauliX(q, N - 2)
+    qt.tGate(q, N - 1)
+    qt.multiRotateZ(q, [0, 5, N - 1], 0.4)
+    qt.swapGate(q, 1, N - 1)
+    qt.collapseToOutcome(q, 4, 0)
+    qt.seedQuEST([7])
+    qt.measure(q, 3)
+    rho = qt.createDensityQureg(5, env_dist)
+    qt.hadamard(rho, 4)
+    qt.mixDamping(rho, 0, 0.1)
+    qt.mixDepolarising(rho, 4, 0.1)
+    qt.pauliY(rho, 4)
+    assert qmod.REPIN_COUNT == before, "corrective reshard fired"
+    # the states are still distributed and correct
+    assert q.amps.sharding == env_dist.sharding
+    assert rho.amps.sharding == env_dist.sharding
+    assert qt.calcTotalProb(q) == pytest.approx(1.0, abs=1e-10)
+    assert qt.calcTotalProb(rho) == pytest.approx(1.0, abs=1e-10)

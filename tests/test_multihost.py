@@ -53,6 +53,11 @@ qt.rotateY(q, n - 2, 0.37)
 total = qt.calcTotalProb(q)
 assert abs(total - 1.0) < 1e-10, total
 
+# the eager sequence above must not have taken ANY corrective resharding
+# pass: ops pin the env sharding inside their own programs (api._pinned)
+from quest_tpu import qureg as qmod
+assert qmod.REPIN_COUNT == 0, f"corrective reshards fired: {qmod.REPIN_COUNT}"
+
 save_qureg(q, ckpt)
 q2 = load_qureg(ckpt, env)
 
