@@ -796,6 +796,29 @@ def constrained_op(state: jax.Array, dyn: tuple, fn, statics: tuple,
     return jax.lax.with_sharding_constraint(out, out_sharding)
 
 
+def _dense_1q_f64_shadow(state: jax.Array, u: jax.Array, q: int,
+                         num_qubits: int) -> jax.Array:
+    """Fused f64 density-matrix 1q gate: U on row bit ``q`` AND conj(U) on
+    column bit ``q + num_qubits`` in ONE pass over the Choi vector.
+
+    The two-pass form reads and writes the 4 GiB state twice (plus chunk
+    overhead); the fused form is the 2-target superoperator conj(U) ⊗ U on
+    (q, q+n) through the GATHER engine — the exact structure every
+    decoherence channel already runs (ops/decoherence.py), which matters:
+    a hand-rolled 4-pattern elementwise variant of this op computed a wrong
+    trace on-chip for sublane row bits (the X64-rewriter miscompile family,
+    docs/DESIGN.md "f64 on TPU") while the gather formulation is
+    TPU-proven."""
+    from .pallas_layer import _kron_pair  # lazy: avoids an import cycle
+
+    q = int(q)
+    qc = q + int(num_qubits)
+    # conj(U) ⊗ U as a (2, 4, 4) real pair: matrix bit 0 = q, bit 1 = qc
+    # (kron's first factor is the high bit)
+    sp = _kron_pair(jnp.stack([u[0], -u[1]]), u)
+    return _dense_gather(state, sp, (q, qc), (), ())
+
+
 def apply_matrix_routed(state: jax.Array, u: jax.Array, targets: tuple,
                         controls: tuple, control_states: tuple, perm: tuple):
     """Deferred-layout dense gate for compiled circuit programs: like
@@ -923,6 +946,12 @@ def apply_matrix_density(state: jax.Array, u: jax.Array, targets: tuple,
     dispatches outright.  The flag still applies to statevector gates."""
     if not control_states:
         control_states = (1,) * len(controls)
+    if (len(targets) == 1 and not controls
+            and _use_gather(state.dtype, 2, None)):  # dispatches a 2-target gather
+        # f64 accelerator path: gate + shadow share ONE read and write of
+        # the 4 GiB Choi vector (four partner patterns) instead of two full
+        # passes — the dominant cost of the f64 density workload
+        return _dense_1q_f64_shadow(state, u, targets[0], num_qubits)
     state = _apply_matrix_xla(state, u, targets, controls, control_states)
     conj = jnp.stack([u[0], -u[1]])
     return _apply_matrix_xla(state, conj,

@@ -208,3 +208,21 @@ def test_gather_three_four_targets(state, targets):
     got = ap._dense_gather(state, up, targets, (), ())
     g_c = np.asarray(got[0]) + 1j * np.asarray(got[1])
     np.testing.assert_allclose(g_c, want, rtol=0, atol=1e-13)
+
+
+def test_dense_1q_shadow_fused_matches_two_pass(state):
+    """The fused density gate+shadow (conj(U) ⊗ U superoperator on
+    (q, q+n) through the gather engine) against the two-pass engine.  The
+    gather formulation is deliberate: a hand-rolled 4-pattern elementwise
+    variant computed a wrong trace on-chip for sublane row bits (the
+    X64-rewriter miscompile family — see docs/DESIGN.md)."""
+    nq = N // 2
+    for q in range(nq):
+        u = _seeded_unitary(1, 500 + q)
+        up = jnp.asarray(ap.mat_pair(u), jnp.float64)
+        upc = jnp.asarray(ap.mat_pair(u.conj()), jnp.float64)
+        want = ap._apply_matrix_xla(state, up, (q,), (), ())
+        want = ap._apply_matrix_xla(want, upc, (q + nq,), (), ())
+        got = ap._dense_1q_f64_shadow(state, up, q, nq)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=0, atol=1e-13)
