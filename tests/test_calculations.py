@@ -234,17 +234,24 @@ def test_pauli_sum_scan_fallback_matches_unrolled(env_local):
         psi.amps, terms, cf))
     op = pauli_sum_matrix(N, codes, coeffs)
     expected = float(np.real(np.vdot(vec, op @ vec)))
-    assert got_scan == pytest.approx(expected, abs=1e-10)
-    assert got_scan == pytest.approx(got_unrolled, abs=1e-12)
+    # dtype-aware tolerances: the TPU-platform suite runs f32 registers
+    # (f64 accumulation over f32 amplitudes lands near 1e-9 absolute)
+    f64 = psi.dtype == np.float64
+    oracle_tol = 1e-10 if f64 else 1e-7   # scalar expectation vs oracle
+    twin_tol = 1e-12 if f64 else 1e-7     # scan vs unrolled scalar
+    apply_tol = 1e-10 if f64 else 1e-5    # elementwise state comparisons
+    assert got_scan == pytest.approx(expected, abs=oracle_tol)
+    assert got_scan == pytest.approx(got_unrolled, abs=twin_tol)
 
     # apply_pauli_sum: scan vs unrolled vs dense oracle
     out_scan = np.asarray(_calc.apply_pauli_sum(psi.amps, terms, cf))
     out_unrolled = np.asarray(_calc._apply_pauli_sum_unrolled(psi.amps, terms, cf))
     want = op @ vec
-    np.testing.assert_allclose(out_scan[0] + 1j * out_scan[1], want, atol=1e-10)
-    np.testing.assert_allclose(out_scan, out_unrolled, atol=1e-12)
+    np.testing.assert_allclose(out_scan[0] + 1j * out_scan[1], want,
+                               atol=apply_tol)
+    np.testing.assert_allclose(out_scan, out_unrolled, atol=apply_tol)
 
     # work through the public API too (calcExpecPauliSum on a many-term sum)
     work = qt.createQureg(N, env_local)
     got_api = qt.calcExpecPauliSum(psi, codes.ravel(), coeffs, num_terms, work)
-    assert got_api == pytest.approx(expected, abs=1e-10)
+    assert got_api == pytest.approx(expected, abs=oracle_tol)
