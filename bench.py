@@ -449,6 +449,8 @@ def bench_density(n=14, depth=5, precision=2, seed=7):
 
     from functools import partial
 
+    from quest_tpu import _compat
+
     # trace of rho = sum of real diagonal, via strided slice (elements
     # k*(2^n+1)) — no (2^n, 2^n) square view materialised
     @jax.jit
@@ -472,7 +474,7 @@ def bench_density(n=14, depth=5, precision=2, seed=7):
 
         # x64 off for the Mosaic layer pass (same constraint as
         # pallas_layer.apply_1q_layer); f32 operands are unaffected
-        with jax.enable_x64(False):
+        with _compat.enable_x64(False):
             float(run(fresh(), 1))
             t0 = time.perf_counter()
             base = float(run(fresh(), 0))

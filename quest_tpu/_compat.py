@@ -1,0 +1,33 @@
+"""Version shims over the moving parts of the jax API surface.
+
+The codebase targets current jax, where ``jax.shard_map`` and
+``jax.enable_x64`` are top-level; on the 0.4.x series both still live under
+``jax.experimental``.  Every internal user imports the symbol from here so
+the compatibility decision is made exactly once.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:  # jax >= 0.5
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+import inspect
+
+_SHARD_MAP_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+
+
+def shard_map(f, **kwargs):
+    """shard_map with the check_vma/check_rep kwarg rename papered over
+    (new jax renamed check_rep -> check_vma; the semantics are the same)."""
+    if "check_vma" in kwargs and "check_vma" not in _SHARD_MAP_PARAMS:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map(f, **kwargs)
+
+try:  # jax >= 0.4.26 top-level export
+    enable_x64 = jax.enable_x64
+except AttributeError:  # pragma: no cover - 0.4.x
+    from jax.experimental import enable_x64  # noqa: F401

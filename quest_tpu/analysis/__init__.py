@@ -1,0 +1,31 @@
+"""quest_tpu.analysis — static analysis for circuits and the codebase.
+
+Three cooperating passes, all pure host work (no device allocation, no
+compilation), mirroring the role QuEST_validation.c plays in the reference
+but *ahead* of run time:
+
+1. :func:`analyze_circuit` — whole-circuit IR checks: wire bounds,
+   payload unitarity, shard fit, memory footprint vs the target mesh
+   (parallel/planner.py's cost model), plane-storage compatibility, and
+   optimization hints.
+2. :func:`check_abstract_eval` — eager-vs-compiled consistency via
+   ``jax.eval_shape``: shape/dtype/sharding agreement per op plus
+   per-operand dtype contracts (the multiRotateZ f32-angle bug class).
+3. :func:`lint_paths` / :func:`lint_package` — AST purity lint over the
+   source tree for jit-unsafe host-Python patterns.
+
+CLI: ``python -m quest_tpu.analysis --self-lint`` (the tier-1 CI gate),
+see ``python -m quest_tpu.analysis --help`` and docs/ANALYSIS.md.
+"""
+
+from .diagnostics import (AnalysisCode, Diagnostic, Severity,  # noqa: F401
+                          max_severity, message_for)
+from .circuit_ir import analyze_circuit  # noqa: F401
+from .abstract_eval import check_abstract_eval  # noqa: F401
+from .purity import lint_package, lint_paths, lint_source  # noqa: F401
+
+__all__ = [
+    "AnalysisCode", "Diagnostic", "Severity", "max_severity", "message_for",
+    "analyze_circuit", "check_abstract_eval",
+    "lint_source", "lint_paths", "lint_package",
+]

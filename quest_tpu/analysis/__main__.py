@@ -1,0 +1,126 @@
+"""``python -m quest_tpu.analysis`` — the static-analysis CLI.
+
+Modes (combinable; exit status is 1 iff any ERROR-severity diagnostic):
+
+- ``--self-lint``: purity-lint the installed quest_tpu tree (the CI gate).
+- ``--lint PATH [PATH ...]``: purity-lint arbitrary files/trees.
+- ``--qft N`` / ``--random N DEPTH``: analyze a generated benchmark circuit.
+- ``--circuit module:attr``: import and analyze a user circuit — ``attr``
+  may be a :class:`quest_tpu.Circuit` or a zero-argument factory.
+
+Circuit modes run the IR pass and the eager/compiled abstract-eval pass
+against the deployment described by ``--devices/--precision/--chip``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import sys
+
+from .abstract_eval import check_abstract_eval
+from .circuit_ir import analyze_circuit
+from .diagnostics import Severity
+from .purity import lint_package, lint_paths
+
+
+def _load_circuit(spec: str):
+    module_name, _, attr = spec.partition(":")
+    if not attr:
+        raise SystemExit(f"--circuit takes module:attr, got {spec!r}")
+    obj = getattr(importlib.import_module(module_name), attr)
+    return obj() if callable(obj) else obj
+
+
+def _chip(name: str):
+    from ..parallel import planner
+    try:
+        return {"v5e": planner.V5E, "v5p": planner.V5P}[name]
+    except KeyError:
+        raise SystemExit(f"unknown chip {name!r} (v5e | v5p)")
+
+
+def _dtype(precision: int):
+    import jax.numpy as jnp
+    return jnp.float32 if precision == 1 else jnp.float64
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m quest_tpu.analysis",
+        description="Static circuit analyzer + JAX-purity lint for quest_tpu.")
+    parser.add_argument("--self-lint", action="store_true",
+                        help="purity-lint the quest_tpu package tree")
+    parser.add_argument("--lint", nargs="+", metavar="PATH",
+                        help="purity-lint the given files/directories")
+    parser.add_argument("--qft", type=int, metavar="N",
+                        help="analyze an N-qubit QFT circuit")
+    parser.add_argument("--random", nargs=2, type=int, metavar=("N", "DEPTH"),
+                        help="analyze an N-qubit depth-DEPTH random circuit")
+    parser.add_argument("--circuit", metavar="MODULE:ATTR",
+                        help="import and analyze a Circuit (or factory)")
+    parser.add_argument("--devices", type=int, default=1,
+                        help="mesh size for the deployment model (default 1)")
+    parser.add_argument("--precision", type=int, default=1, choices=(1, 2),
+                        help="1 = f32 SoA, 2 = f64 (default 1)")
+    parser.add_argument("--chip", default="v5e", help="v5e | v5p (default v5e)")
+    parser.add_argument("--no-hints", action="store_true",
+                        help="suppress HINT-severity findings")
+    parser.add_argument("--strict", action="store_true",
+                        help="fail on WARNING as well as ERROR")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit diagnostics as JSON lines")
+    args = parser.parse_args(argv)
+
+    diagnostics = []
+    ran = False
+    if args.self_lint:
+        diagnostics += lint_package()
+        ran = True
+    if args.lint:
+        diagnostics += lint_paths(args.lint)
+        ran = True
+
+    circuits = []
+    if args.qft is not None:
+        from ..circuit import qft_circuit
+        circuits.append((f"qft({args.qft})", qft_circuit(args.qft)))
+    if args.random is not None:
+        from ..circuit import random_circuit
+        n, depth = args.random
+        circuits.append((f"random({n},{depth})", random_circuit(n, depth)))
+    if args.circuit:
+        circuits.append((args.circuit, _load_circuit(args.circuit)))
+    for label, circuit in circuits:
+        ran = True
+        found = analyze_circuit(circuit, num_devices=args.devices,
+                                precision=args.precision,
+                                chip=_chip(args.chip),
+                                hints=not args.no_hints)
+        found += check_abstract_eval(circuit, dtype=_dtype(args.precision))
+        diagnostics += found
+        print(f"{label}: {len(circuit.ops)} ops, "
+              f"{len(found)} finding(s)")
+
+    if not ran:
+        parser.print_usage()
+        return 2
+
+    fail_at = Severity.WARNING if args.strict else Severity.ERROR
+    for d in diagnostics:
+        if args.no_hints and d.severity == Severity.HINT:
+            continue
+        if args.as_json:
+            print(json.dumps({"code": d.code, "severity": d.severity.name,
+                              "location": d.location, "message": d.message}))
+        else:
+            print(d.format())
+    n_err = sum(d.severity >= fail_at for d in diagnostics)
+    print(f"{len(diagnostics)} diagnostic(s), {n_err} at/above "
+          f"{fail_at.name.lower()}")
+    return 1 if n_err else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,163 @@
+"""Pass 2: eager-vs-compiled abstract-eval consistency.
+
+The same gate reaches the kernels through two doors: the eager API
+(api.py dispatches one program per call) and the compiled circuit path
+(circuit.py ``_apply_one`` inside one fused program).  Nothing forces the
+two to construct identical operands — which is exactly how the
+multiRotateZ angle was once cast to the state dtype on the compiled path
+while the eager path kept float64.  This pass runs every recorded op
+through ``jax.eval_shape`` on BOTH paths (abstract: no device work, no
+compile) and asserts shape/dtype/sharding agreement, plus per-operand
+dtype contracts that pin trace-time casting decisions.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .. import circuit as _circuit
+from ..ops import apply as _ap
+from .diagnostics import AnalysisCode, Diagnostic, Severity, diag
+
+
+def _eager_matrix(state, op):
+    # api.py _apply_unitary: payload enters as the host-side f64 pair
+    return _ap.apply_matrix(state, jnp.asarray(op.payload()), op.targets,
+                            op.controls, op.control_states)
+
+
+def _eager_diagonal(state, op):
+    return _ap.apply_diagonal(state, jnp.asarray(op.payload()), op.targets,
+                              op.controls, op.control_states)
+
+
+def _eager_x(state, op):
+    return _ap.apply_pauli_x(state, op.targets[0], op.controls,
+                             op.control_states)
+
+
+def _eager_y(state, op):
+    return _ap.apply_pauli_y(state, op.targets[0], op.controls,
+                             op.control_states)
+
+
+def _eager_y_conj(state, op):
+    return _ap.apply_pauli_y(state, op.targets[0], op.controls,
+                             op.control_states, conj_fac=-1)
+
+
+def _eager_swap(state, op):
+    return _ap.swap_qubit_amps(state, op.targets[0], op.targets[1])
+
+
+def _eager_mrz(state, op):
+    # api.py multiRotateZ: the angle is ALWAYS float64 on the eager path
+    return _ap.apply_multi_rotate_z(state, jnp.float64(op.matrix[0]),
+                                    op.targets)
+
+
+# the eager API's dispatch, kind by kind (mirrors api.py); tests monkeypatch
+# entries to seed violations
+EAGER_MIRROR = {
+    "matrix": _eager_matrix,
+    "diagonal": _eager_diagonal,
+    "x": _eager_x,
+    "y": _eager_y,
+    "y*": _eager_y_conj,
+    "swap": _eager_swap,
+    "mrz": _eager_mrz,
+}
+
+# Per-operand dtype contracts at kernel entry.  Dense/diagonal payloads are
+# deliberately absent: the kernels cast payloads to the state dtype
+# internally, so either width is sound.  Parameters that feed trig before
+# any state-dtype cast must stay wide on both paths.
+OPERAND_CONTRACTS = {
+    "mrz": {"angle": jnp.dtype(jnp.float64)},
+}
+
+
+def check_abstract_eval(circuit, dtype=jnp.float32,
+                        sharding=None) -> list[Diagnostic]:
+    """Abstract-eval every op of ``circuit`` on the eager and compiled paths
+    over a ``dtype`` state and report any disagreement.  Pure host work:
+    ``jax.eval_shape`` traces with abstract values only."""
+    out: list[Diagnostic] = []
+    dtype = jnp.dtype(dtype)
+    n = circuit.num_qubits
+    kwargs = {"sharding": sharding} if sharding is not None else {}
+    spec = jax.ShapeDtypeStruct((2, 1 << n), dtype, **kwargs)
+    for i, op in enumerate(circuit.ops):
+        eager_fn = EAGER_MIRROR.get(op.kind)
+        if eager_fn is None:
+            continue  # unknown kinds are the IR pass's finding
+        compiled, c_err = _try_eval(partial(_apply_one_flipped, op), spec)
+        eager, e_err = _try_eval(partial(eager_fn, op=op), spec)
+        if c_err and e_err:
+            # both paths refuse to trace: a semantically invalid op — the
+            # IR pass owns that finding (bounds, payload shape, ...)
+            continue
+        if c_err or e_err:
+            which, err = ("compiled", c_err) if c_err else ("eager", e_err)
+            out.append(diag(
+                AnalysisCode.EAGER_COMPILED_SHAPE_MISMATCH, Severity.ERROR,
+                op_index=i,
+                detail=f"only the {which} path fails to trace: {err}"))
+            continue
+        if compiled.shape != eager.shape:
+            out.append(diag(
+                AnalysisCode.EAGER_COMPILED_SHAPE_MISMATCH, Severity.ERROR,
+                op_index=i,
+                detail=f"compiled {compiled.shape} vs eager {eager.shape}"))
+        if compiled.dtype != eager.dtype:
+            out.append(diag(
+                AnalysisCode.EAGER_COMPILED_DTYPE_MISMATCH, Severity.ERROR,
+                op_index=i,
+                detail=f"compiled {compiled.dtype} vs eager {eager.dtype}"))
+        elif compiled.dtype != dtype:
+            # both paths agree but silently promoted/demoted the state
+            out.append(diag(
+                AnalysisCode.EAGER_COMPILED_DTYPE_MISMATCH, Severity.ERROR,
+                op_index=i,
+                detail=f"state {dtype} promoted to {compiled.dtype} on both paths"))
+        csh = getattr(compiled, "sharding", None)
+        esh = getattr(eager, "sharding", None)
+        if csh is not None and esh is not None and csh != esh:
+            out.append(diag(
+                AnalysisCode.EAGER_COMPILED_SHARDING_MISMATCH, Severity.ERROR,
+                op_index=i, detail=f"compiled {csh} vs eager {esh}"))
+        _check_operand_contracts(i, op, dtype, out)
+    return out
+
+
+def _apply_one_flipped(op, state):
+    return _circuit._apply_one(state, op)
+
+
+def _try_eval(fn, spec):
+    """(result, None) on success, (None, short error text) if tracing the op
+    fails — invalid ops (bad wires, wrong payload shape) raise arbitrarily
+    deep in the kernels."""
+    try:
+        return jax.eval_shape(fn, spec), None
+    except Exception as e:  # noqa: BLE001 - kernels raise many types
+        return None, f"{type(e).__name__}: {e}"[:120]
+
+
+def _check_operand_contracts(i: int, op, dtype, out: list) -> None:
+    contracts = OPERAND_CONTRACTS.get(op.kind)
+    if not contracts:
+        return
+    # abstract: operand construction itself runs under eval_shape so no
+    # device buffers are built for large payloads
+    operands = jax.eval_shape(lambda: _circuit.op_operands(op, dtype))
+    for name, want in contracts.items():
+        got = operands.get(name)
+        if got is not None and got.dtype != want:
+            out.append(diag(
+                AnalysisCode.OPERAND_DTYPE_DRIFT, Severity.ERROR, op_index=i,
+                detail=f"operand '{name}' of '{op.kind}': compiled path "
+                       f"builds {got.dtype}, eager contract is {want}"))

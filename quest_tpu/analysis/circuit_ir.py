@@ -1,0 +1,209 @@
+"""Pass 1: whole-circuit IR analysis, before any tracing or device work.
+
+The reference checks every input at call time (QuEST_validation.c); the
+circuit layer deliberately skips those checks while *recording* (builder
+methods are hot paths), deferring them to trace time where they surface as
+deep XLA shape errors.  This pass walks the recorded ``GateOp`` list on the
+host and reports everything the validation layer *would* have raised —
+with the same ``E_*`` codes — plus projections no runtime check can make:
+memory footprint against the target mesh (parallel/planner.py's model),
+plane-storage compatibility, and optimization hints.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import qureg as _qureg
+from ..parallel import planner as _planner
+from ..precision import real_eps
+from ..validation import ErrorCode, _is_unitary
+from .diagnostics import AnalysisCode, Diagnostic, Severity, diag
+
+# kinds whose payload is a dense unitary / unit-modulus diagonal
+_DENSE_KINDS = ("matrix",)
+_DIAG_KINDS = ("diagonal",)
+_KNOWN_KINDS = ("matrix", "diagonal", "x", "y", "y*", "swap", "mrz")
+
+
+def _op_matrix(op) -> np.ndarray | None:
+    """Complex payload of a dense op (None for payload-free kinds)."""
+    if op.kind not in _DENSE_KINDS or op.matrix is None:
+        return None
+    p = op.payload()
+    return p[0] + 1j * p[1]
+
+
+def _op_diagonal(op) -> np.ndarray | None:
+    if op.kind not in _DIAG_KINDS or op.matrix is None:
+        return None
+    p = op.payload()
+    return p[0] + 1j * p[1]
+
+
+def _check_wires(i: int, op, n: int, out: list) -> None:
+    targets = [int(t) for t in op.targets]
+    controls = [int(c) for c in op.controls]
+    for t in targets:
+        if not 0 <= t < n:
+            out.append(diag(ErrorCode.INVALID_TARGET_QUBIT, Severity.ERROR,
+                            op_index=i, detail=f"target {t} of {n} qubits"))
+    for c in controls:
+        if not 0 <= c < n:
+            out.append(diag(ErrorCode.INVALID_CONTROL_QUBIT, Severity.ERROR,
+                            op_index=i, detail=f"control {c} of {n} qubits"))
+    if len(set(targets)) != len(targets):
+        out.append(diag(ErrorCode.TARGETS_NOT_UNIQUE, Severity.ERROR,
+                        op_index=i, detail=f"targets {tuple(targets)}"))
+    if len(set(controls)) != len(controls):
+        out.append(diag(ErrorCode.CONTROLS_NOT_UNIQUE, Severity.ERROR,
+                        op_index=i, detail=f"controls {tuple(controls)}"))
+    if set(targets) & set(controls):
+        out.append(diag(ErrorCode.CONTROL_TARGET_COLLISION, Severity.ERROR,
+                        op_index=i,
+                        detail=f"shared wires {tuple(set(targets) & set(controls))}"))
+    if op.control_states:
+        if len(op.control_states) != len(controls):
+            out.append(diag(ErrorCode.MISMATCHING_NUM_CONTROL_STATES,
+                            Severity.ERROR, op_index=i))
+        for b in op.control_states:
+            if int(b) not in (0, 1):
+                out.append(diag(ErrorCode.INVALID_CONTROLS_BIT_STATE,
+                                Severity.ERROR, op_index=i,
+                                detail=f"state {b}"))
+
+
+def _check_payload(i: int, op, eps: float, out: list) -> None:
+    mat = _op_matrix(op)
+    if mat is not None:
+        dim = 1 << len(op.targets)
+        if mat.shape != (dim, dim):
+            out.append(diag(ErrorCode.INVALID_UNITARY_SIZE, Severity.ERROR,
+                            op_index=i,
+                            detail=f"shape {mat.shape} for {len(op.targets)} targets"))
+            return
+        # matrix norms compound rounding: same widened tolerance the runtime
+        # CPTP check uses (validation.py validate_kraus_cptp)
+        if not _is_unitary(mat, 10 * eps):
+            out.append(diag(ErrorCode.NON_UNITARY_MATRIX, Severity.ERROR,
+                            op_index=i))
+        return
+    d = _op_diagonal(op)
+    if d is not None:
+        if d.shape != (1 << len(op.targets),):
+            out.append(diag(ErrorCode.INVALID_UNITARY_SIZE, Severity.ERROR,
+                            op_index=i,
+                            detail=f"{d.shape[0]} diagonal entries for {len(op.targets)} targets"))
+            return
+        if np.any(np.abs(np.abs(d) - 1.0) > 10 * eps):
+            out.append(diag(ErrorCode.NON_UNITARY_MATRIX, Severity.ERROR,
+                            op_index=i, detail="diagonal entry off the unit circle"))
+
+
+def _check_memory(circuit, num_devices: int, precision: int,
+                  chip: _planner.ChipSpec, out: list) -> None:
+    fp = _planner.memory_footprint(circuit.num_qubits, num_devices, precision)
+    if fp["peak_shard_bytes"] > chip.hbm_bytes:
+        out.append(diag(
+            AnalysisCode.STATE_EXCEEDS_MESH_MEMORY, Severity.ERROR,
+            detail=(f"{fp['peak_shard_bytes'] / 2**30:.1f} GiB working set "
+                    f"per device vs {chip.hbm_bytes / 2**30:.1f} GiB HBM "
+                    f"({chip.name} x{num_devices})")))
+
+
+def _check_shard_fit(i: int, op, circuit, num_devices: int, out: list) -> None:
+    # multi-target dense gates only: the routed amplitude groups must be
+    # shard-local (validation.validate_multi_qubit_matrix_fits_in_shard);
+    # 1q gates cross shards via collective-permute and never hit this
+    if op.kind in _DENSE_KINDS and len(op.targets) > 1 and num_devices > 1:
+        if (1 << len(op.targets)) > (1 << circuit.num_qubits) // num_devices:
+            out.append(diag(ErrorCode.CANNOT_FIT_MULTI_QUBIT_MATRIX,
+                            Severity.ERROR, op_index=i,
+                            detail=f"{len(op.targets)} targets over {num_devices} devices"))
+
+
+def _plane_mode_predicted(circuit, num_devices: int, precision: int) -> bool:
+    """Would a register of this size take plane-pair storage?  Mirrors
+    Qureg.uses_plane_storage minus the backend gate (the analyzer targets
+    the accelerator deployment, where the gate passes)."""
+    if precision != 1 or num_devices > 1:
+        return False
+    return 2 * 4 * (1 << circuit.num_qubits) >= _qureg.PLANE_STORAGE_MIN_BYTES
+
+
+def _check_plane_compat(i: int, op, out: list) -> None:
+    if len(op.targets) > 1 or op.controls:
+        out.append(diag(ErrorCode.PLANE_ONLY_1Q, Severity.WARNING, op_index=i,
+                        detail=f"kind '{op.kind}' on wires {op.targets + op.controls}"))
+
+
+def _is_inverse_pair(a, b, eps: float) -> bool:
+    """Do adjacent ops ``a`` then ``b`` compose to the identity?"""
+    if (a.targets != b.targets or a.controls != b.controls
+            or a.control_states != b.control_states):
+        return False
+    if a.kind != b.kind:
+        return False
+    if a.kind in ("x", "y", "swap"):
+        return True  # self-inverse on identical wires
+    if a.kind == "mrz":
+        return abs(a.matrix[0] + b.matrix[0]) < eps
+    ma, mb = _op_matrix(a), _op_matrix(b)
+    if ma is not None and mb is not None:
+        return bool(np.all(np.abs(mb @ ma - np.eye(ma.shape[0])) < 10 * eps))
+    da, db = _op_diagonal(a), _op_diagonal(b)
+    if da is not None and db is not None:
+        return bool(np.all(np.abs(da * db - 1.0) < 10 * eps))
+    return False
+
+
+def _check_hints(circuit, eps: float, out: list) -> None:
+    ops = circuit.ops
+    for i in range(len(ops) - 1):
+        if _is_inverse_pair(ops[i], ops[i + 1], eps):
+            out.append(diag(AnalysisCode.ADJACENT_INVERSE_PAIR, Severity.HINT,
+                            op_index=i,
+                            detail=f"ops {i} and {i + 1} ({ops[i].kind}) cancel"))
+    # maximal runs of uncontrolled 1q gates on one target (a 1q diagonal is
+    # a dense 2x2 for fusion purposes)
+    run_start, run_target = None, None
+    for i, op in enumerate(ops + [None]):
+        is_1q = (op is not None
+                 and op.kind in ("matrix", "diagonal", "x", "y")
+                 and len(op.targets) == 1 and not op.controls)
+        t = op.targets[0] if is_1q else None
+        if is_1q and t == run_target:
+            continue
+        if run_target is not None and i - run_start >= 2:
+            out.append(diag(AnalysisCode.FUSABLE_1Q_RUN, Severity.HINT,
+                            op_index=run_start,
+                            detail=f"ops {run_start}..{i - 1} on qubit {run_target}"))
+        run_start, run_target = (i, t) if is_1q else (None, None)
+
+
+def analyze_circuit(circuit, *, num_devices: int = 1, precision: int = 1,
+                    chip: _planner.ChipSpec = _planner.V5E,
+                    hints: bool = True) -> list[Diagnostic]:
+    """Analyze a recorded :class:`quest_tpu.Circuit` against a deployment
+    (``num_devices`` chips of ``chip`` at ``precision``).  Returns structured
+    :class:`Diagnostic`\\ s; ERROR severity means the circuit would raise or
+    OOM at runtime, WARNING flags gates that die only in a specific regime
+    (plane storage), HINT marks optimization opportunities."""
+    out: list[Diagnostic] = []
+    eps = real_eps(None)
+    n = circuit.num_qubits
+    plane_mode = _plane_mode_predicted(circuit, num_devices, precision)
+    for i, op in enumerate(circuit.ops):
+        if op.kind not in _KNOWN_KINDS:
+            out.append(diag(AnalysisCode.UNKNOWN_GATE_KIND, Severity.ERROR,
+                            op_index=i, detail=f"kind '{op.kind}'"))
+            continue
+        _check_wires(i, op, n, out)
+        _check_payload(i, op, eps, out)
+        _check_shard_fit(i, op, circuit, num_devices, out)
+        if plane_mode:
+            _check_plane_compat(i, op, out)
+    _check_memory(circuit, num_devices, precision, chip, out)
+    if hints:
+        _check_hints(circuit, eps, out)
+    return out

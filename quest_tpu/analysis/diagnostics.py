@@ -1,0 +1,142 @@
+"""Structured diagnostics shared by the three static-analysis passes.
+
+The runtime validation layer (validation.py) mirrors the reference's
+QuEST_validation.c: symbolic ``E_*`` codes raised as exceptions at call time.
+The analysis passes report the SAME codes — a diagnostic that predicts a
+runtime failure carries the exact ``ErrorCode`` the op would raise, so a CI
+log line maps 1:1 onto the exception a production run would have died with.
+Findings with no runtime twin (memory projections, eager/compiled drift,
+purity lint) use analysis-only code families: ``A_*`` for circuit/abstract
+analysis, ``H_*`` for optimization hints, ``P_*`` for source purity rules.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+from ..validation import MESSAGES as _ERROR_MESSAGES
+
+
+class Severity(enum.IntEnum):
+    """Ordering matters: the CLI fails on max(severity) >= ERROR."""
+    HINT = 0
+    WARNING = 1
+    ERROR = 2
+
+
+class AnalysisCode:
+    """Analysis-only diagnostic codes (ErrorCode-style symbolic strings)."""
+
+    # circuit-level projections (no runtime exception twin)
+    STATE_EXCEEDS_MESH_MEMORY = "A_STATE_EXCEEDS_MESH_MEMORY"
+    UNKNOWN_GATE_KIND = "A_UNKNOWN_GATE_KIND"
+    # eager-vs-compiled abstract-eval drift
+    EAGER_COMPILED_DTYPE_MISMATCH = "A_EAGER_COMPILED_DTYPE_MISMATCH"
+    EAGER_COMPILED_SHAPE_MISMATCH = "A_EAGER_COMPILED_SHAPE_MISMATCH"
+    EAGER_COMPILED_SHARDING_MISMATCH = "A_EAGER_COMPILED_SHARDING_MISMATCH"
+    OPERAND_DTYPE_DRIFT = "A_OPERAND_DTYPE_DRIFT"
+    # optimization hints
+    ADJACENT_INVERSE_PAIR = "H_ADJACENT_INVERSE_PAIR"
+    FUSABLE_1Q_RUN = "H_FUSABLE_1Q_RUN"
+    # source purity lint
+    TRACED_PYTHON_BRANCH = "P_TRACED_PYTHON_BRANCH"
+    HOST_CAST_ON_TRACED = "P_HOST_CAST_ON_TRACED"
+    NUMPY_ON_TRACED = "P_NUMPY_ON_TRACED"
+    ANGLE_NOT_F64 = "P_ANGLE_NOT_F64"
+    CALLBACK_IN_SHARD_MAP = "P_HOST_CALLBACK_IN_SHARD_MAP"
+
+
+ANALYSIS_MESSAGES = {
+    AnalysisCode.STATE_EXCEEDS_MESH_MEMORY:
+        "The statevector's per-device working set exceeds the device's HBM; "
+        "the program will OOM at allocation. Shard over more devices or drop "
+        "to precision 1.",
+    AnalysisCode.UNKNOWN_GATE_KIND:
+        "Unknown gate kind: _apply_one would raise ValueError at trace time.",
+    AnalysisCode.EAGER_COMPILED_DTYPE_MISMATCH:
+        "Eager and compiled paths disagree on the output dtype of this op; "
+        "the two paths would produce numerically different states.",
+    AnalysisCode.EAGER_COMPILED_SHAPE_MISMATCH:
+        "Eager and compiled paths disagree on the output shape of this op.",
+    AnalysisCode.EAGER_COMPILED_SHARDING_MISMATCH:
+        "Eager and compiled paths disagree on the output sharding of this op.",
+    AnalysisCode.OPERAND_DTYPE_DRIFT:
+        "The compiled path feeds this kernel an operand of a different dtype "
+        "than the eager API contract; eager and compiled states would drift "
+        "(the circuit.py multiRotateZ f32-angle bug class).",
+    AnalysisCode.ADJACENT_INVERSE_PAIR:
+        "Adjacent gates on identical wires compose to the identity and can "
+        "be cancelled.",
+    AnalysisCode.FUSABLE_1Q_RUN:
+        "A run of consecutive single-qubit gates on one target can be fused "
+        "into a single 2x2 matrix (one HBM pass instead of one per gate); "
+        "see Circuit.optimize().",
+    AnalysisCode.TRACED_PYTHON_BRANCH:
+        "Python control flow on a traced value inside a jitted function: the "
+        "branch is resolved at trace time, not per element. Use jnp.where / "
+        "lax.cond, or mark the argument static.",
+    AnalysisCode.HOST_CAST_ON_TRACED:
+        "Host cast (float/int/bool) on a traced value inside a jitted "
+        "function: this forces a trace-time ConcretizationTypeError or a "
+        "silent host round-trip.",
+    AnalysisCode.NUMPY_ON_TRACED:
+        "numpy call on a traced value inside a jitted function: np.* "
+        "executes at trace time on the host and freezes the value into the "
+        "compiled program. Use the jnp equivalent.",
+    AnalysisCode.ANGLE_NOT_F64:
+        "apply_multi_rotate_z angle operand is cast to a non-float64 dtype; "
+        "the eager API passes float64 (api.py multiRotateZ), so a narrower "
+        "cast here makes compiled f32 states drift from eager ones.",
+    AnalysisCode.CALLBACK_IN_SHARD_MAP:
+        "Host callback inside a shard_map region: the callback runs "
+        "per-shard on every device and serialises the collective schedule.",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Diagnostic:
+    """One analysis finding.  ``code`` is an ErrorCode or AnalysisCode
+    string; location is an op index (circuit passes) or file:line (lint)."""
+
+    code: str
+    severity: Severity
+    message: str
+    op_index: int | None = None
+    file: str | None = None
+    line: int | None = None
+
+    @property
+    def location(self) -> str:
+        if self.file is not None:
+            return f"{self.file}:{self.line}" if self.line else self.file
+        if self.op_index is not None:
+            return f"op[{self.op_index}]"
+        return "<circuit>"
+
+    def format(self) -> str:
+        return f"{self.severity.name.lower()}[{self.code}] {self.location}: {self.message}"
+
+
+def message_for(code: str) -> str:
+    """Canonical text for any diagnostic code: validation's MESSAGES for the
+    shared ``E_*`` codes, the analysis table for the rest."""
+    return ANALYSIS_MESSAGES.get(code) or _ERROR_MESSAGES.get(code) or code
+
+
+def diag(code: str, severity: Severity, *, op_index: int | None = None,
+         file: str | None = None, line: int | None = None,
+         detail: str | None = None) -> Diagnostic:
+    msg = message_for(code)
+    if detail:
+        msg = f"{msg} [{detail}]"
+    return Diagnostic(code, severity, msg, op_index=op_index, file=file,
+                      line=line)
+
+
+def max_severity(diagnostics) -> Severity | None:
+    worst = None
+    for d in diagnostics:
+        if worst is None or d.severity > worst:
+            worst = d.severity
+    return worst

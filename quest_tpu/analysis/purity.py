@@ -1,0 +1,249 @@
+"""Pass 3: source-level JAX-purity lint over the quest_tpu tree itself.
+
+The round-5 review bugs (eager/compiled dtype drift, storage misrouting)
+belong to a *source* bug class no circuit-level check can see: host Python
+leaking into traced code.  This linter parses each module's AST and flags
+jit-unsafe patterns inside jit-decorated functions — conservatively: a rule
+fires only on provable violations (a traced *parameter name* used directly),
+never on derived values, so the pass stays false-positive-free on a clean
+tree and is enforceable in tier-1 CI (``python -m quest_tpu.analysis
+--self-lint``).
+
+Rules
+-----
+- ``P_TRACED_PYTHON_BRANCH``: ``if``/``while`` whose test names a traced
+  parameter of the enclosing jit function (trace-time branch).
+- ``P_HOST_CAST_ON_TRACED``: ``float()``/``int()``/``bool()`` on a traced
+  parameter (concretization error / host round-trip).
+- ``P_NUMPY_ON_TRACED``: ``np.*(...)`` with a traced parameter argument
+  (trace-time host compute frozen into the program).
+- ``P_ANGLE_NOT_F64``: an ``apply_multi_rotate_z`` angle operand cast to a
+  dtype other than ``jnp.float64`` (the circuit.py:208 bug class; the
+  eager path pins float64).
+- ``P_HOST_CALLBACK_IN_SHARD_MAP``: ``jax.debug.callback`` /
+  ``pure_callback`` / ``io_callback`` / ``host_callback`` inside a
+  shard_map-decorated function.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .diagnostics import AnalysisCode, Diagnostic, Severity, diag
+
+_HOST_CASTS = ("float", "int", "bool")
+_CALLBACK_NAMES = ("callback", "pure_callback", "io_callback", "host_callback")
+_F64_NAMES = ("float64",)
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.jit' for Attribute/Name chains, '' for anything else."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _decorator_call(dec: ast.AST) -> tuple[str, list, list]:
+    """(dotted name, args, keywords) of a decorator, unwrapping partial()."""
+    if isinstance(dec, ast.Call):
+        name = _dotted(dec.func)
+        if name in ("partial", "functools.partial") and dec.args:
+            inner = _dotted(dec.args[0])
+            return inner, dec.args[1:], dec.keywords
+        return name, dec.args, dec.keywords
+    return _dotted(dec), [], []
+
+
+def _static_names(keywords: list, func: ast.FunctionDef) -> set[str]:
+    """Parameter names excluded from tracing by static_argnames/argnums."""
+    params = [a.arg for a in func.args.posonlyargs + func.args.args]
+    static: set[str] = set()
+    for kw in keywords:
+        if kw.arg == "static_argnames":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                    static.add(node.value)
+        elif kw.arg == "static_argnums":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(node.value, int):
+                    if 0 <= node.value < len(params):
+                        static.add(params[node.value])
+    return static
+
+
+def _jit_traced_params(func: ast.FunctionDef) -> set[str] | None:
+    """Traced parameter names if ``func`` is jit-decorated, else None."""
+    for dec in func.decorator_list:
+        name, _args, keywords = _decorator_call(dec)
+        if name in ("jax.jit", "jit"):
+            params = {a.arg for a in func.args.posonlyargs + func.args.args}
+            return params - _static_names(keywords, func)
+    return None
+
+
+def _is_shard_mapped(func: ast.FunctionDef) -> bool:
+    for dec in func.decorator_list:
+        name, _args, _kw = _decorator_call(dec)
+        if name in ("shard_map", "jax.shard_map",
+                    "jax.experimental.shard_map.shard_map"):
+            return True
+    return False
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+# Attributes of a traced array that are static trace-time metadata: reading
+# them (and branching on them) is host-safe, so `if state.dtype == ...` is
+# NOT a traced branch even though `state` is traced.
+_STATIC_ATTRS = frozenset(
+    ("dtype", "shape", "ndim", "size", "itemsize", "sharding", "aval",
+     "device", "weak_type"))
+
+
+def _traced_value_names(node: ast.AST) -> set[str]:
+    """Names used as VALUES in ``node``, skipping static-metadata reads."""
+    names: set[str] = set()
+
+    def walk(n: ast.AST) -> None:
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            return
+        if isinstance(n, ast.Name):
+            names.add(n.id)
+        for child in ast.iter_child_nodes(n):
+            walk(child)
+
+    walk(node)
+    return names
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, filename: str):
+        self.filename = filename
+        self.out: list[Diagnostic] = []
+        # innermost enclosing traced-parameter scope (None outside jit)
+        self._traced: set[str] | None = None
+        self._in_shard_map = False
+
+    def _emit(self, code: str, node: ast.AST, detail: str) -> None:
+        self.out.append(diag(code, Severity.ERROR, file=self.filename,
+                             line=getattr(node, "lineno", None), detail=detail))
+
+    # --- scope tracking ----------------------------------------------------
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        traced = _jit_traced_params(node)
+        shard_mapped = _is_shard_mapped(node)
+        prev, prev_sm = self._traced, self._in_shard_map
+        if traced is not None:
+            self._traced = traced
+        if shard_mapped:
+            self._in_shard_map = True
+        self.generic_visit(node)
+        self._traced, self._in_shard_map = prev, prev_sm
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    # --- rules -------------------------------------------------------------
+    def _traced_in(self, node: ast.AST) -> set[str]:
+        if not self._traced:
+            return set()
+        return self._traced & _traced_value_names(node)
+
+    def visit_If(self, node: ast.If) -> None:
+        hit = self._traced_in(node.test)
+        if hit:
+            self._emit(AnalysisCode.TRACED_PYTHON_BRANCH, node,
+                       f"if on traced {sorted(hit)}")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        hit = self._traced_in(node.test)
+        if hit:
+            self._emit(AnalysisCode.TRACED_PYTHON_BRANCH, node,
+                       f"while on traced {sorted(hit)}")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _dotted(node.func)
+        # host casts on a traced parameter, passed directly
+        if name in _HOST_CASTS and self._traced:
+            for arg in node.args:
+                if isinstance(arg, ast.Name) and arg.id in self._traced:
+                    self._emit(AnalysisCode.HOST_CAST_ON_TRACED, node,
+                               f"{name}({arg.id})")
+        # numpy on a traced parameter
+        if name.startswith(("np.", "numpy.")) and self._traced:
+            for arg in node.args:
+                if isinstance(arg, ast.Name) and arg.id in self._traced:
+                    self._emit(AnalysisCode.NUMPY_ON_TRACED, node,
+                               f"{name}({arg.id}, ...)")
+        # mrz angle must not be cast away from float64
+        if name.endswith("apply_multi_rotate_z") and len(node.args) >= 2:
+            self._check_angle(node.args[1])
+        # host callbacks under shard_map
+        if self._in_shard_map and name.split(".")[-1] in _CALLBACK_NAMES:
+            self._emit(AnalysisCode.CALLBACK_IN_SHARD_MAP, node, name)
+        self.generic_visit(node)
+
+    def _check_angle(self, angle: ast.AST) -> None:
+        """Flag only *provably* narrowing casts: jnp.asarray(x, dtype=D) or
+        x.astype(D) with D a named dtype other than float64, or an explicit
+        jnp.float32(...) constructor.  Bare names pass (unknowable here; the
+        abstract-eval pass checks the built operand)."""
+        if not isinstance(angle, ast.Call):
+            return
+        name = _dotted(angle.func)
+        if name.split(".")[-1] == "float32":
+            self._emit(AnalysisCode.ANGLE_NOT_F64, angle, f"{name}(...)")
+            return
+        dtype_node = None
+        if name.split(".")[-1] in ("asarray", "array"):
+            for kw in angle.keywords:
+                if kw.arg == "dtype":
+                    dtype_node = kw.value
+        elif name.endswith(".astype") and angle.args:
+            dtype_node = angle.args[0]
+        if dtype_node is None:
+            return
+        dtype_name = _dotted(dtype_node)
+        if dtype_name and dtype_name.split(".")[-1] not in _F64_NAMES:
+            self._emit(AnalysisCode.ANGLE_NOT_F64, angle,
+                       f"angle cast to {dtype_name}")
+
+
+def lint_source(source: str, filename: str = "<string>") -> list[Diagnostic]:
+    """Lint one module's source text; returns purity diagnostics."""
+    tree = ast.parse(source, filename=filename)
+    linter = _Linter(filename)
+    linter.visit(tree)
+    return linter.out
+
+
+def lint_paths(paths) -> list[Diagnostic]:
+    """Lint ``.py`` files / directory trees; returns all diagnostics."""
+    out: list[Diagnostic] = []
+    files: list[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            for root, _dirs, names in os.walk(path):
+                files.extend(os.path.join(root, f) for f in sorted(names)
+                             if f.endswith(".py"))
+        else:
+            files.append(path)
+    for f in files:
+        with open(f, "r", encoding="utf-8") as fh:
+            out.extend(lint_source(fh.read(), f))
+    return out
+
+
+def lint_package() -> list[Diagnostic]:
+    """Lint the installed quest_tpu tree (the --self-lint target)."""
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return lint_paths([pkg_root])

@@ -29,8 +29,8 @@ import numpy as np
 
 from .ops import apply as _ap
 
-__all__ = ["Circuit", "compile_circuit", "apply_circuit", "random_circuit",
-           "qft_circuit"]
+__all__ = ["Circuit", "GateOp", "compile_circuit", "apply_circuit",
+           "op_operands", "random_circuit", "qft_circuit"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -187,13 +187,30 @@ class Circuit:
         return self
 
 
+def op_operands(op: GateOp, state_dtype) -> dict:
+    """Device operands the compiled path feeds the gate kernels for ``op``.
+
+    Single source of truth for per-op operand construction: ``_apply_one``
+    consumes it when tracing, and ``quest_tpu.analysis.abstract_eval``
+    compares it against the eager API's operand contract — the mrz angle in
+    particular must stay float64 on BOTH paths (api.py multiRotateZ passes
+    ``jnp.float64(angle)``; an f32-cast angle here would give compiled f32
+    states different phases than eager ones)."""
+    if op.kind in ("matrix", "diagonal"):
+        return {"payload": jnp.asarray(op.payload(), dtype=state_dtype)}
+    if op.kind == "mrz":
+        return {"angle": jnp.asarray(op.matrix[0], dtype=jnp.float64)}
+    return {}
+
+
 def _apply_one(state: jax.Array, op: GateOp) -> jax.Array:
+    operands = op_operands(op, state.dtype)
     if op.kind == "matrix":
-        u = jnp.asarray(op.payload(), dtype=state.dtype)
-        return _ap.apply_matrix(state, u, op.targets, op.controls, op.control_states)
+        return _ap.apply_matrix(state, operands["payload"], op.targets,
+                                op.controls, op.control_states)
     if op.kind == "diagonal":
-        d = jnp.asarray(op.payload(), dtype=state.dtype)
-        return _ap.apply_diagonal(state, d, op.targets, op.controls, op.control_states)
+        return _ap.apply_diagonal(state, operands["payload"], op.targets,
+                                  op.controls, op.control_states)
     if op.kind == "x":
         return _ap.apply_pauli_x(state, op.targets[0], op.controls, op.control_states)
     if op.kind == "y":
@@ -204,8 +221,7 @@ def _apply_one(state: jax.Array, op: GateOp) -> jax.Array:
     if op.kind == "swap":
         return _ap.swap_qubit_amps(state, op.targets[0], op.targets[1])
     if op.kind == "mrz":
-        return _ap.apply_multi_rotate_z(
-            state, jnp.asarray(op.matrix[0], dtype=state.dtype), op.targets)
+        return _ap.apply_multi_rotate_z(state, operands["angle"], op.targets)
     raise ValueError(f"unknown gate kind {op.kind}")
 
 

@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from .. import _compat
+
 LANE = 128
 _BLOCK_ROWS = 512  # rows of 128 amps per kernel instance (256 KiB f32 tile)
 
@@ -69,7 +71,7 @@ def apply_lane_matrix_eager(state: jax.Array, u: jax.Array, plan) -> jax.Array:
     Mosaic lowering on this stack requires x64 off, so the whole jit runs
     inside an ``enable_x64(False)`` scope — f32 operands are unaffected."""
     from .apply import _expand_matrix
-    with jax.enable_x64(False):
+    with _compat.enable_x64(False):
         u = _expand_matrix(jnp.asarray(u, jnp.float32), plan, jnp.float32)
         return apply_lane_matrix(state, u)
 

@@ -32,6 +32,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from .. import _compat
+
 LANE = 128
 SUB = 8
 
@@ -297,7 +299,7 @@ def apply_1q_layer(state: jax.Array, gate_pairs) -> jax.Array:
     gates = jnp.stack([jnp.asarray(g, dtype=state.dtype) for g in gate_pairs])
     # Mosaic lowering on this stack requires x64 off (same constraint as
     # pallas_kernels.apply_lane_matrix_eager); f32 operands are unaffected
-    with jax.enable_x64(False):
+    with _compat.enable_x64(False):
         return _layer_all(state, gates)
 
 
@@ -342,7 +344,7 @@ def apply_1q_gate_planes(re: jax.Array, im: jax.Array, gate, q: int):
     if re.dtype != jnp.float32 or im.dtype != jnp.float32:
         raise ValueError(f"layer kernel is f32-only, got {re.dtype}/{im.dtype}")
     gate = jnp.asarray(gate, dtype=re.dtype)
-    with jax.enable_x64(False):
+    with _compat.enable_x64(False):
         return _gate1_planes(re, im, gate, q)
 
 
@@ -359,5 +361,5 @@ def apply_1q_layer_planes(re: jax.Array, im: jax.Array, gate_pairs):
     if re.dtype != jnp.float32 or im.dtype != jnp.float32:
         raise ValueError(f"layer kernel is f32-only, got {re.dtype}/{im.dtype}")
     gates = jnp.stack([jnp.asarray(g, dtype=re.dtype) for g in gate_pairs])
-    with jax.enable_x64(False):
+    with _compat.enable_x64(False):
         return _layer_all_planes(re, im, gates)

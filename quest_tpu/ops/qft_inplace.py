@@ -51,6 +51,8 @@ import numpy as np
 
 from jax.experimental import pallas as pl
 
+from .. import _compat
+
 from .pallas_layer import (LANE, SUB, _interpret, _shape3, _state_spec,
                            layer_supported)
 
@@ -348,5 +350,5 @@ def qft_planes(re: jax.Array, im: jax.Array, *, bit_reversal: bool = True,
         raise ValueError(f"in-place QFT needs n >= 17, got {n}")
     if re.dtype != jnp.float32 or im.dtype != jnp.float32:
         raise ValueError(f"in-place QFT is f32-only, got {re.dtype}/{im.dtype}")
-    with jax.enable_x64(False):
+    with _compat.enable_x64(False):
         return _qft_all(re, im, bit_reversal, inverse)

@@ -21,7 +21,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+from .._compat import shard_map
 
 from .mesh import AMPS_AXIS
 

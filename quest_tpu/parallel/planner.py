@@ -133,6 +133,31 @@ MEASURED_EFFICIENCY = {
 }
 
 
+def memory_footprint(num_qubits: int, num_devices: int = 1,
+                     precision: int = 1, is_density_matrix: bool = False,
+                     transient_factor: float = 2.0) -> dict:
+    """Static memory model of one register over an amplitude mesh.
+
+    ``precision`` follows the precision.py convention (1 -> f32 SoA, 8 B per
+    amplitude; else f64, 16 B).  ``transient_factor`` models XLA's working
+    set: a non-donated gate program holds input and output buffers of the
+    sharded state live at once (2.0); in-place plane engines with donation
+    run at 1.0.  Consumed by quest_tpu.analysis (the pre-flight OOM check)
+    and exposed for capacity planning next to time_model."""
+    n = num_qubits * (2 if is_density_matrix else 1)
+    bytes_per_amp = 8 if precision == 1 else 16
+    state_bytes = (1 << n) * bytes_per_amp
+    shard_bytes = state_bytes // max(num_devices, 1)
+    return {
+        "num_qubits": num_qubits,
+        "state_bytes": state_bytes,
+        "shard_bytes": shard_bytes,
+        "peak_shard_bytes": int(shard_bytes * transient_factor),
+        "bytes_per_amp": bytes_per_amp,
+        "devices": num_devices,
+    }
+
+
 @dataclasses.dataclass
 class GateTime:
     index: int

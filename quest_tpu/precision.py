@@ -45,8 +45,10 @@ class PrecisionConfig:
                 import warnings
                 warnings.warn(
                     "precision 4 (long double, QuEST_precision.h:51-66) has no "
-                    "TPU equivalent; mapping to precision 2 (float64). REAL_EPS "
-                    "uses the long-double table entry (1e-14).",
+                    "TPU equivalent; precision 4 is retained (get_precision() "
+                    "reports 4, REAL_EPS uses the long-double table entry "
+                    "1e-14) but amplitudes are stored as float64, the widest "
+                    "TPU-representable real.",
                     RuntimeWarning, stacklevel=3)
         self.precision = precision
         self.real_eps = _REAL_EPS[precision]

@@ -13,6 +13,7 @@ Ref analogue: struct Qureg (QuEST.h:203-234).  Differences by design:
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -72,6 +73,23 @@ PLANE_STORAGE_MIN_BYTES = 8 << 30
 # small sizes while still exercising materialisation).
 PLANE_MATERIALIZE_LIMIT_BYTES = 8 << 30
 
+# Plane-pair storage exists for the ACCELERATOR memory ceiling; on a CPU
+# backend the same byte count carries no plane-only gate restriction, so a
+# 30q f32 register on a single-device CPU env must keep the full gate set
+# instead of dying with E_PLANE_ONLY_1Q.  The env var overrides the backend
+# gate both ways: "1" forces plane storage on CPU (tests drive the Pallas
+# engines in interpret mode), "0" disables it even on an accelerator.
+PLANE_STORAGE_ENV = "QUEST_TPU_PLANE_STORAGE"
+
+
+def _plane_storage_enabled() -> bool:
+    value = os.environ.get(PLANE_STORAGE_ENV)
+    if value is not None:
+        # only explicit truthy spellings force-enable; "no"/"off"/garbage
+        # all disable, so a user opting out can't accidentally opt in
+        return value.strip().lower() in ("1", "on", "true", "yes", "force")
+    return jax.default_backend() != "cpu"
+
 
 class Qureg:
     """Mutable shell over an immutable amplitude array (functional core,
@@ -107,11 +125,14 @@ class Qureg:
     # --- plane-pair storage ------------------------------------------------
     def uses_plane_storage(self) -> bool:
         """True for single-device f32 statevectors at/above the plane
-        threshold (the regime served by the in-place Pallas engines)."""
+        threshold (the regime served by the in-place Pallas engines) on an
+        accelerator backend — or wherever QUEST_TPU_PLANE_STORAGE forces
+        the decision (see _plane_storage_enabled)."""
         return (not self.is_density_matrix
                 and self.dtype == jnp.dtype(jnp.float32)
                 and (self.env is None or self.env.sharding is None)
-                and 2 * 4 * self.num_amps_total >= PLANE_STORAGE_MIN_BYTES)
+                and 2 * 4 * self.num_amps_total >= PLANE_STORAGE_MIN_BYTES
+                and _plane_storage_enabled())
 
     @property
     def planes(self):
@@ -140,6 +161,12 @@ class Qureg:
             self._planes = None
             return planes
         amps = self._amps
+        if amps is None:
+            # a destroyed (or never-initialised) register has no buffers to
+            # donate; surface the API-level error, not a bare TypeError from
+            # subscripting None
+            from .validation import ErrorCode, _throw
+            _throw(ErrorCode.QUREG_NOT_INITIALISED, "take_planes")
         self._amps = None
         return (amps[0], amps[1])
 

@@ -97,6 +97,7 @@ class ErrorCode:
     DIAGONAL_OP_NOT_INITIALISED = "E_DIAGONAL_OP_NOT_INITIALISED"
     PLANE_ONLY_1Q = "E_PLANE_ONLY_1Q"
     PLANE_ONLY = "E_PLANE_ONLY"
+    QUREG_NOT_INITIALISED = "E_QUREG_NOT_INITIALISED"
 
 
 # Human-readable messages; tests substring-match these, mirroring the
@@ -169,6 +170,7 @@ MESSAGES = {
     ErrorCode.MISMATCHING_QUREG_DIAGONAL_OP_SIZE: "The qureg must represent an equal number of qubits as that in the applied diagonal operator.",
     ErrorCode.DIAGONAL_OP_NOT_INITIALISED: "The diagonal operator has not been initialised through createDiagonalOperator().",
     ErrorCode.PLANE_ONLY_1Q: "This register uses plane-pair storage (the single-chip memory ceiling); only single-qubit uncontrolled gates are supported at this size. Apply multi-qubit/controlled gates on a register below the plane-storage threshold.",
+    ErrorCode.QUREG_NOT_INITIALISED: "The register's amplitude storage has not been initialised, or was already destroyed (destroyQureg).",
     ErrorCode.PLANE_ONLY: "This register uses plane-pair storage (the single-chip memory ceiling); the requested operation needs the stacked amplitude array, which cannot be materialised at this size. Supported in plane mode: init*, single-qubit gates, applyFullQFT, measure/collapse, probabilities, amplitude reads.",
 }
 

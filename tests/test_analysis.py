@@ -1,0 +1,421 @@
+"""quest_tpu.analysis: the static circuit analyzer + purity lint.
+
+Every analyzer rule gets one known-bad circuit (asserting the stable
+diagnostic code), plus clean-circuit no-false-positive cases, a purity-lint
+self-test over the quest_tpu tree (the same gate
+``python -m quest_tpu.analysis --self-lint`` enforces in CI), and the
+precision-4 warning regression from the same review round.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu import analysis as an
+from quest_tpu import circuit as cmod
+from quest_tpu import qureg as qmod
+from quest_tpu.analysis import AnalysisCode, Severity
+from quest_tpu.circuit import Circuit, GateOp
+from quest_tpu.validation import ErrorCode
+
+
+def codes(diags):
+    return [d.code for d in diags]
+
+
+def analyze(circuit, **kw):
+    return an.analyze_circuit(circuit, **kw)
+
+
+# ---------------------------------------------------------------------------
+# pass 1: circuit IR analyzer — one bad circuit per diagnostic code
+# ---------------------------------------------------------------------------
+
+def test_ir_invalid_target():
+    c = Circuit(3).x(7)
+    assert ErrorCode.INVALID_TARGET_QUBIT in codes(analyze(c))
+
+
+def test_ir_negative_target():
+    c = Circuit(3)
+    c.ops.append(GateOp("x", (-1,)))
+    assert ErrorCode.INVALID_TARGET_QUBIT in codes(analyze(c))
+
+
+def test_ir_invalid_control():
+    c = Circuit(3).x(0, controls=(4,))
+    assert ErrorCode.INVALID_CONTROL_QUBIT in codes(analyze(c))
+
+
+def test_ir_duplicate_targets():
+    c = Circuit(3)
+    c.ops.append(GateOp("matrix", (1, 1), (), (),
+                        tuple(np.stack([np.eye(4), np.zeros((4, 4))]).ravel()),
+                        (2, 4, 4)))
+    assert ErrorCode.TARGETS_NOT_UNIQUE in codes(analyze(c))
+
+
+def test_ir_duplicate_controls():
+    c = Circuit(4).x(0, controls=(1, 1))
+    assert ErrorCode.CONTROLS_NOT_UNIQUE in codes(analyze(c))
+
+
+def test_ir_control_target_collision():
+    c = Circuit(3).x(0, controls=(0,))
+    assert ErrorCode.CONTROL_TARGET_COLLISION in codes(analyze(c))
+
+
+def test_ir_control_state_arity_and_bits():
+    c = Circuit(3)
+    c.multi_qubit_unitary((0,), np.eye(2), controls=(1, 2),
+                          control_states=(1,))
+    found = codes(analyze(c))
+    assert ErrorCode.MISMATCHING_NUM_CONTROL_STATES in found
+    c2 = Circuit(3)
+    c2.multi_qubit_unitary((0,), np.eye(2), controls=(1,),
+                           control_states=(2,))
+    assert ErrorCode.INVALID_CONTROLS_BIT_STATE in codes(analyze(c2))
+
+
+def test_ir_non_unitary_matrix():
+    c = Circuit(2).unitary(0, [[1.0, 1.0], [0.0, 1.0]])
+    diags = analyze(c)
+    assert ErrorCode.NON_UNITARY_MATRIX in codes(diags)
+    assert all(d.severity == Severity.ERROR for d in diags
+               if d.code == ErrorCode.NON_UNITARY_MATRIX)
+
+
+def test_ir_non_unitary_diagonal():
+    c = Circuit(2)
+    c._diag([1.0, 0.5], (0,))  # |d| != 1: not norm-preserving
+    assert ErrorCode.NON_UNITARY_MATRIX in codes(analyze(c))
+
+
+def test_ir_matrix_shape_mismatch():
+    c = Circuit(3)
+    c.ops.append(GateOp("matrix", (0, 1), (), (),
+                        tuple(np.stack([np.eye(2), np.zeros((2, 2))]).ravel()),
+                        (2, 2, 2)))  # 2x2 payload on 2 targets
+    assert ErrorCode.INVALID_UNITARY_SIZE in codes(analyze(c))
+
+
+def test_ir_unknown_kind():
+    c = Circuit(2)
+    c.ops.append(GateOp("frobnicate", (0,)))
+    diags = [d for d in analyze(c)
+             if d.code == AnalysisCode.UNKNOWN_GATE_KIND]
+    assert len(diags) == 1 and diags[0].severity == Severity.ERROR
+
+
+def test_ir_matrix_exceeds_shard():
+    c = Circuit(3).multi_qubit_unitary((0, 1, 2), np.eye(8))
+    assert ErrorCode.CANNOT_FIT_MULTI_QUBIT_MATRIX in codes(
+        analyze(c, num_devices=4))
+    assert ErrorCode.CANNOT_FIT_MULTI_QUBIT_MATRIX not in codes(
+        analyze(c, num_devices=1))
+
+
+def test_ir_memory_footprint_vs_mesh():
+    big = Circuit(36).h(0)  # 2^36 f64 amps = 1 TiB state
+    diags = analyze(big, num_devices=1, precision=2)
+    assert AnalysisCode.STATE_EXCEEDS_MESH_MEMORY in codes(diags)
+    # sharded wide enough, the same circuit fits (pass needs no devices)
+    from quest_tpu.parallel.planner import V5P
+    ok = analyze(big, num_devices=256, precision=2, chip=V5P)
+    assert AnalysisCode.STATE_EXCEEDS_MESH_MEMORY not in codes(ok)
+
+
+def test_ir_plane_storage_compat(monkeypatch):
+    monkeypatch.setattr(qmod, "PLANE_STORAGE_MIN_BYTES", 2 * 4 * (1 << 4))
+    c = Circuit(4).cnot(0, 1)
+    c.h(2)
+    diags = analyze(c, num_devices=1, precision=1)
+    flagged = [d for d in diags if d.code == ErrorCode.PLANE_ONLY_1Q]
+    assert len(flagged) == 1 and flagged[0].op_index == 0
+    assert flagged[0].severity == Severity.WARNING
+    # f64 registers never take plane storage: no warning
+    assert ErrorCode.PLANE_ONLY_1Q not in codes(
+        analyze(c, num_devices=1, precision=2))
+
+
+def test_ir_hint_adjacent_inverse_pair():
+    c = Circuit(2).h(0).h(0)
+    assert AnalysisCode.ADJACENT_INVERSE_PAIR in codes(analyze(c))
+    c2 = Circuit(2).x(1).x(1)
+    assert AnalysisCode.ADJACENT_INVERSE_PAIR in codes(analyze(c2))
+    c3 = Circuit(12)
+    c3.multi_rotate_z(tuple(range(12)), 0.7)   # O(1)-payload mrz kind
+    c3.multi_rotate_z(tuple(range(12)), -0.7)
+    assert AnalysisCode.ADJACENT_INVERSE_PAIR in codes(analyze(c3))
+
+
+def test_ir_hint_fusable_1q_run():
+    c = Circuit(3).h(1).t(1)
+    c.rx(1, 0.3)
+    diags = [d for d in analyze(c) if d.code == AnalysisCode.FUSABLE_1Q_RUN]
+    assert len(diags) == 1 and diags[0].severity == Severity.HINT
+
+
+def test_ir_clean_circuits_have_no_findings():
+    assert analyze(qt.qft_circuit(5)) == []
+    assert analyze(qt.random_circuit(4, 3)) == []
+    assert analyze(qt.qft_circuit(6), num_devices=8, precision=2) == []
+
+
+# ---------------------------------------------------------------------------
+# pass 2: eager-vs-compiled abstract-eval consistency
+# ---------------------------------------------------------------------------
+
+def _mrz_circuit():
+    c = Circuit(3)
+    c.ops.append(GateOp("mrz", (0, 1, 2), (), (), (0.5,), None))
+    return c
+
+
+def test_abstract_eval_clean_on_real_circuits():
+    for circuit in (qt.qft_circuit(4), qt.random_circuit(3, 2),
+                    _mrz_circuit()):
+        for dtype in (jnp.float32, jnp.float64):
+            assert an.check_abstract_eval(circuit, dtype=dtype) == []
+
+
+def test_abstract_eval_catches_angle_dtype_drift(monkeypatch):
+    """The circuit.py:208 bug class re-seeded: compiled path casting the mrz
+    angle to the state dtype must be flagged with a stable code."""
+    orig = cmod.op_operands
+
+    def buggy(op, state_dtype):
+        if op.kind == "mrz":
+            return {"angle": jnp.asarray(op.matrix[0], dtype=state_dtype)}
+        return orig(op, state_dtype)
+
+    monkeypatch.setattr(cmod, "op_operands", buggy)
+    diags = an.check_abstract_eval(_mrz_circuit(), dtype=jnp.float32)
+    assert codes(diags) == [AnalysisCode.OPERAND_DTYPE_DRIFT]
+    assert diags[0].severity == Severity.ERROR and diags[0].op_index == 0
+    # at f64 the buggy cast coincides with the contract: nothing to flag
+    assert an.check_abstract_eval(_mrz_circuit(), dtype=jnp.float64) == []
+
+
+def test_abstract_eval_catches_output_dtype_mismatch(monkeypatch):
+    """A compiled path that promotes the state dtype (e.g. an f64 constant
+    multiplied in without a cast) diverges from eager output dtype."""
+    from quest_tpu.analysis import abstract_eval as ae
+
+    monkeypatch.setitem(ae.EAGER_MIRROR, "mrz",
+                        lambda state, op: state.astype(jnp.float64))
+    diags = an.check_abstract_eval(_mrz_circuit(), dtype=jnp.float32)
+    assert AnalysisCode.EAGER_COMPILED_DTYPE_MISMATCH in codes(diags)
+
+
+def test_abstract_eval_catches_shape_mismatch(monkeypatch):
+    from quest_tpu.analysis import abstract_eval as ae
+
+    monkeypatch.setitem(ae.EAGER_MIRROR, "mrz",
+                        lambda state, op: state[:, ::2])
+    diags = an.check_abstract_eval(_mrz_circuit(), dtype=jnp.float32)
+    assert AnalysisCode.EAGER_COMPILED_SHAPE_MISMATCH in codes(diags)
+
+
+def test_abstract_eval_skips_semantically_invalid_ops():
+    """Ops the IR pass rejects (bad wires) fail to trace on BOTH paths; the
+    checker must skip them instead of crashing, leaving the finding to the
+    IR pass — the CLI runs both passes together."""
+    c = Circuit(3).x(7)
+    c.unitary(0, [[1.0, 1.0], [0.0, 1.0]])  # traces fine, flagged by IR
+    assert an.check_abstract_eval(c, dtype=jnp.float32) == []
+    assert ErrorCode.INVALID_TARGET_QUBIT in codes(an.analyze_circuit(c))
+
+
+def test_compiled_mrz_angle_is_float64():
+    """The invariant itself, not just the checker: the compiled path builds
+    the mrz angle wide (satellite fix for circuit.py:208)."""
+    import jax
+
+    op = _mrz_circuit().ops[0]
+    operands = jax.eval_shape(lambda: cmod.op_operands(op, jnp.float32))
+    assert operands["angle"].dtype == jnp.dtype(jnp.float64)
+
+
+def test_eager_and_compiled_mrz_agree_numerically():
+    """End-to-end: a wide multiRotateZ through the eager API and through a
+    compiled Circuit produces the same f32 state."""
+    env = qt.createQuESTEnv(1)
+    targets = tuple(range(12))  # >10 targets: the mrz kernel path
+    qe = qt.createQureg(12, env, dtype=jnp.float32)
+    qt.multiRotateZ(qe, targets, 0.37)
+    c = Circuit(12).multi_rotate_z(targets, 0.37)
+    qc = qt.createQureg(12, env, dtype=jnp.float32)
+    qt.apply_circuit(qc, c)
+    np.testing.assert_array_equal(np.asarray(qe.amps), np.asarray(qc.amps))
+
+
+# ---------------------------------------------------------------------------
+# pass 3: source purity lint
+# ---------------------------------------------------------------------------
+
+def lint_codes(src):
+    return codes(an.lint_source(src, "seed.py"))
+
+
+def test_lint_traced_python_branch():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x > 0:\n"
+        "        return x\n"
+        "    return -x\n")
+    assert lint_codes(src) == [AnalysisCode.TRACED_PYTHON_BRANCH]
+
+
+def test_lint_traced_while():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    while x < 3:\n"
+        "        x = x + 1\n"
+        "    return x\n")
+    assert lint_codes(src) == [AnalysisCode.TRACED_PYTHON_BRANCH]
+
+
+def test_lint_host_cast_on_traced():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(x)\n")
+    assert lint_codes(src) == [AnalysisCode.HOST_CAST_ON_TRACED]
+
+
+def test_lint_numpy_on_traced():
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return np.sum(x)\n")
+    assert lint_codes(src) == [AnalysisCode.NUMPY_ON_TRACED]
+
+
+def test_lint_angle_not_f64():
+    src = (
+        "import jax.numpy as jnp\n"
+        "def f(state, op):\n"
+        "    return apply_multi_rotate_z(\n"
+        "        state, jnp.asarray(op.matrix[0], dtype=state.dtype),\n"
+        "        op.targets)\n")
+    assert lint_codes(src) == [AnalysisCode.ANGLE_NOT_F64]
+    ok = (
+        "import jax.numpy as jnp\n"
+        "def f(state, op):\n"
+        "    return apply_multi_rotate_z(\n"
+        "        state, jnp.asarray(op.matrix[0], dtype=jnp.float64),\n"
+        "        op.targets)\n")
+    assert lint_codes(ok) == []
+
+
+def test_lint_callback_in_shard_map():
+    src = (
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(shard_map, mesh=None, in_specs=None, out_specs=None)\n"
+        "def f(shard):\n"
+        "    jax.debug.callback(print, shard)\n"
+        "    return shard\n")
+    assert lint_codes(src) == [AnalysisCode.CALLBACK_IN_SHARD_MAP]
+
+
+def test_lint_statics_and_metadata_are_clean():
+    """No false positives: static args, dtype/shape metadata branches, and
+    host code outside jit are all fine."""
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnames=('k',))\n"
+        "def f(x, k):\n"
+        "    if k:\n"
+        "        return x\n"
+        "    if x.dtype == jnp.float32:\n"
+        "        return x * 2\n"
+        "    return x\n"
+        "def host(y):\n"
+        "    if y > 0:\n"
+        "        return float(y) + np.sum(y)\n"
+        "    return y\n")
+    assert lint_codes(src) == []
+
+
+def test_lint_self_clean():
+    """The quest_tpu tree itself is clean under the purity rules — the CI
+    gate (`python -m quest_tpu.analysis --self-lint`) stays green."""
+    assert an.lint_package() == []
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_self_lint_exits_zero(capsys):
+    from quest_tpu.analysis.__main__ import main
+    assert main(["--self-lint"]) == 0
+    assert "0 at/above error" in capsys.readouterr().out
+
+
+def test_cli_circuit_modes(capsys):
+    from quest_tpu.analysis.__main__ import main
+    assert main(["--qft", "4", "--random", "3", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "qft(4)" in out and "random(3,2)" in out
+
+
+def test_cli_lint_flags_bad_file(tmp_path, capsys):
+    from quest_tpu.analysis.__main__ import main
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(x)\n")
+    assert main(["--lint", str(bad)]) == 1
+    assert AnalysisCode.HOST_CAST_ON_TRACED in capsys.readouterr().out
+
+
+def test_cli_no_mode_is_usage_error():
+    from quest_tpu.analysis.__main__ import main
+    assert main([]) == 2
+
+
+# ---------------------------------------------------------------------------
+# satellite regression: the precision-4 warning tells the truth
+# ---------------------------------------------------------------------------
+
+def test_precision4_warning_matches_get_precision():
+    from quest_tpu import precision as pmod
+
+    prev = qt.get_precision()
+    pmod._WARNED_PREC4 = False
+    try:
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            qt.set_precision(4)
+        assert qt.get_precision() == 4  # retained, exactly as the text says
+        msgs = [str(w.message) for w in caught
+                if issubclass(w.category, RuntimeWarning)]
+        assert len(msgs) == 1
+        assert "retained" in msgs[0] and "float64" in msgs[0]
+        assert "mapping to precision 2" not in msgs[0]
+        # storage really is float64
+        assert pmod.CONFIG.real_dtype == jnp.float64
+        assert qt.real_eps() == 1e-14
+    finally:
+        pmod._WARNED_PREC4 = False
+        qt.set_precision(prev)

@@ -10,6 +10,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from quest_tpu import _compat
 from quest_tpu.ops import apply as ap
 from quest_tpu.ops import pallas_layer as pll
 
@@ -137,7 +138,7 @@ def test_ladder_pallas_matches_xla_form(q):
     want_re, want_im = _ladder_diag(re, im, q)
     # Mosaic lowering requires x64 off (the qft_planes entry does the same;
     # see pallas_layer apply_1q_layer) — f32 operands are unaffected
-    with jax.enable_x64(False):
+    with _compat.enable_x64(False):
         got_re, got_im = jax.jit(_ladder_pallas,
                                  static_argnums=(2,))(re, im, q)
     np.testing.assert_allclose(np.asarray(got_re), np.asarray(want_re),

@@ -26,9 +26,43 @@ ATOL = 5e-6  # f32 engine-vs-engine tolerance (matches test_pallas_layer)
 @pytest.fixture
 def plane_env(monkeypatch):
     """Single-device env with the plane threshold lowered so an 18q f32
-    register uses plane storage."""
+    register uses plane storage.  Plane mode is normally accelerator-only
+    (the byte ceiling is an HBM property); the env var forces it on so the
+    CPU suite can exercise the engines in Pallas interpret mode."""
+    monkeypatch.setenv(qmod.PLANE_STORAGE_ENV, "1")
     monkeypatch.setattr(qmod, "PLANE_STORAGE_MIN_BYTES", 2 * 4 * (1 << N))
     return qt.createQuESTEnv(num_devices=1)
+
+
+def test_plane_storage_is_accelerator_only_by_default(monkeypatch):
+    """A plane-sized f32 register on a CPU backend keeps the FULL gate set:
+    the plane-only gate restriction is an accelerator-memory property, so on
+    CPU (no env var) the register stays on stacked storage."""
+    monkeypatch.delenv(qmod.PLANE_STORAGE_ENV, raising=False)
+    monkeypatch.setattr(qmod, "PLANE_STORAGE_MIN_BYTES", 2 * 4 * (1 << 6))
+    env = qt.createQuESTEnv(num_devices=1)
+    q = qt.createQureg(6, env, dtype=jnp.float32)
+    assert not q.uses_plane_storage()
+    assert q._amps is not None and q._planes is None
+    qt.controlledNot(q, 0, 1)  # would raise E_PLANE_ONLY_1Q in plane mode
+    assert qt.calcTotalProb(q) == pytest.approx(1.0, abs=1e-6)
+    # the env var force-enables plane mode on CPU (what the suite does)
+    monkeypatch.setenv(qmod.PLANE_STORAGE_ENV, "1")
+    assert qt.createQureg(6, env, dtype=jnp.float32).uses_plane_storage()
+    # and "0" disables it regardless of backend
+    monkeypatch.setenv(qmod.PLANE_STORAGE_ENV, "0")
+    assert not qt.createQureg(6, env, dtype=jnp.float32).uses_plane_storage()
+
+
+def test_take_planes_on_destroyed_register_raises(monkeypatch):
+    """Donating buffers out of a destroyed register is an API error
+    (E_QUREG_NOT_INITIALISED), not a bare TypeError."""
+    env = qt.createQuESTEnv(num_devices=1)
+    q = qt.createQureg(4, env)
+    qt.destroyQureg(q, env)
+    with pytest.raises(qt.QuESTError, match="destroyed") as exc:
+        q.take_planes()
+    assert exc.value.code == "E_QUREG_NOT_INITIALISED"
 
 
 def _pair(q):
