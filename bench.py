@@ -11,6 +11,8 @@ BASELINE.md config measured in the same run:
   - 22q QFT on an 8-virtual-device CPU mesh (cross-shard diagonal + swap
     routing end-to-end — communication-pattern validation, config 5's
     distributed regime without multi-chip hardware)
+  - scheduled-vs-unscheduled pairs on the same mesh (22q QFT, 24q random):
+    the comm-aware scheduler's predicted and measured comm deltas
 
 Workloads run INSIDE one jitted program (lax.fori_loop over layers where
 applicable) so remote-dispatch latency cannot pollute the measurement; a
@@ -614,6 +616,114 @@ def bench_qft30_api(n=30):
     return value, cfg
 
 
+_HLO_COLLECTIVES = ("all-to-all", "collective-permute", "all-gather",
+                    "all-reduce", "reduce-scatter")
+
+
+def _hlo_collective_count(compiled_text: str) -> int:
+    """Collective instruction DEFINITIONS in compiled HLO text — the
+    measured comm-pass count of a program (the static comm_plan predicts;
+    this observes what the partitioner actually emitted)."""
+    import re
+    pat = re.compile(r"= \S+ (" + "|".join(_HLO_COLLECTIVES) + r")\(")
+    return len(pat.findall(compiled_text))
+
+
+def bench_sched_pair(circuit, devices, depth=1):
+    """Scheduled vs unscheduled execution of one circuit over a device mesh:
+    the comm-aware scheduler's (parallel/scheduler.py) measured row.
+
+    Both variants run the identical program shape (per-op chain, output
+    sharding pinned to the input's so the partitioner cannot virtualise
+    trailing permutations into an output-layout drift); the row reports the
+    planner-PREDICTED comm savings next to the MEASURED wall-time and
+    compiled-HLO collective deltas.  Value = scheduled-variant amp updates/s
+    (validation_only on a CPU mesh, like the other sharded configs)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from quest_tpu.circuit import _apply_one
+    from quest_tpu.parallel.scheduler import schedule, schedule_savings
+
+    n = circuit.num_qubits
+    nd = len(devices)
+    sched = schedule(circuit, nd)
+    predicted = schedule_savings(circuit, nd, scheduled=sched)
+    mesh = Mesh(np.asarray(devices), ("amps",))
+    sharding = NamedSharding(mesh, P(None, "amps"))
+    measured = {}
+    for key, circ in (("unscheduled", circuit), ("scheduled", sched)):
+        ops = circ.key()
+
+        def run(s, _ops=ops):
+            for _ in range(depth):
+                for op in _ops:
+                    s = _apply_one(s, op)
+            return s
+
+        fn = jax.jit(run, out_shardings=sharding)
+        state = jax.device_put(
+            jnp.zeros((2, 1 << n), dtype=jnp.float32).at[0, 0].set(1.0),
+            sharding)
+        colls = _hlo_collective_count(fn.lower(state).compile().as_text())
+        out = fn(state)
+        out.block_until_ready()  # compile + warm
+        best = None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            out = fn(state)
+            out.block_until_ready()
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        norm = float(jnp.sum(out[0].astype(jnp.float64) ** 2
+                             + out[1].astype(jnp.float64) ** 2))
+        assert abs(norm - 1.0) < 1e-2, f"norm lost ({key}): {norm}"
+        measured[key] = {"seconds": best, "hlo_collectives": colls,
+                         "ops": len(ops)}
+    un, sc = measured["unscheduled"], measured["scheduled"]
+    value = (1 << n) * len(circuit) * depth / sc["seconds"]
+    cfg = {
+        "qubits": n, "depth": depth, "precision": 1, "devices": nd,
+        "platform": devices[0].platform,
+        # CPU-mesh pairs validate communication structure, not throughput
+        "validation_only": devices[0].platform == "cpu",
+        "predicted": {k: predicted[k] for k in (
+            "comm_events_before", "comm_events_after",
+            "reshard_events_before", "reshard_events_after",
+            "comm_bytes_before", "comm_bytes_after",
+            "comm_events_saved_frac", "comm_bytes_saved_frac")},
+        "measured": {
+            "unscheduled_seconds": un["seconds"],
+            "scheduled_seconds": sc["seconds"],
+            "wall_delta_frac": 1.0 - sc["seconds"] / un["seconds"],
+            "unscheduled_hlo_collectives": un["hlo_collectives"],
+            "scheduled_hlo_collectives": sc["hlo_collectives"],
+            "hlo_collectives_saved": (un["hlo_collectives"]
+                                      - sc["hlo_collectives"]),
+        },
+        "ops_unscheduled": un["ops"], "ops_scheduled": sc["ops"],
+    }
+    return value, cfg
+
+
+def bench_qft22_sched_pair(devices):
+    """BASELINE config 5's distributed regime, scheduled: the 22q QFT whose
+    trailing bit-reversal the scheduler fuses into one collective."""
+    from quest_tpu.circuit import qft_circuit
+    return bench_sched_pair(qft_circuit(22), devices)
+
+
+def bench_random24_sched_pair(devices, depth=2):
+    """The 24q random-circuit config over the mesh: 1q gates + CZ ladders
+    have no swap networks or wide dense gates, so this row pins the
+    scheduler's no-regression contract (predicted savings ~0, unchanged
+    wall time) on the headline workload shape."""
+    from quest_tpu.circuit import random_circuit
+    return bench_sched_pair(random_circuit(24, depth=depth, seed=11),
+                            devices, depth=1)
+
+
 def bench_qft(n, precision=1, devices=None):
     """Full QFT pass: H + controlled-phase ladder + reversal swaps — the
     diagonal-gate + swap routing path (BASELINE config 5).  With ``devices``
@@ -768,6 +878,12 @@ def main() -> None:
             cpu = []
         if len(cpu) == _N_VIRT:
             add("qft_20q_f32_cpu8shard", bench_qft, 20, 1, cpu)
+            # comm-aware scheduler pairs (parallel/scheduler.py): predicted
+            # vs measured comm deltas, scheduled and unscheduled in one row
+            add("qft_22q_f32_cpu8shard_sched_pair",
+                bench_qft22_sched_pair, cpu)
+            add("random24_f32_cpu8shard_sched_pair",
+                bench_random24_sched_pair, cpu)
 
     result = {
         "metric": "statevec_1q_gate_amp_updates_per_sec_per_chip",

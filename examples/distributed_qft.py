@@ -52,6 +52,14 @@ for p in plans:
 print(f"plan: {len(plans)} fused ops -> {kinds}, "
       f"{moved / 1024:.0f} KiB/device predicted exchange volume")
 
+# the comm-aware scheduler consumes that plan and rewrites the circuit:
+# the QFT's trailing bit-reversal swaps fuse into one collective
+# (docs/SCHEDULER.md); the scheduled circuit is exactly equivalent
+circuit = circuit.schedule(len(devices))
+after = sum(p.bytes_moved for p in comm_plan(circuit, len(devices)))
+print(f"scheduled: {len(circuit.ops)} ops, predicted exchange volume "
+      f"{moved / 1024:.0f} -> {after / 1024:.0f} KiB/device")
+
 # build a sharded Qureg and run the circuit as ONE compiled program; GSPMD
 # inserts exactly the collectives the plan predicts
 env = qt.createQuESTEnv()

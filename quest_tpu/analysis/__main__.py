@@ -7,6 +7,11 @@ Modes (combinable; exit status is 1 iff any ERROR-severity diagnostic):
 - ``--qft N`` / ``--random N DEPTH``: analyze a generated benchmark circuit.
 - ``--circuit module:attr``: import and analyze a user circuit — ``attr``
   may be a :class:`quest_tpu.Circuit` or a zero-argument factory.
+- ``--schedule``: additionally run the comm-aware scheduler
+  (parallel/scheduler.py) on each circuit and print the planner-predicted
+  before/after comm report; a scheduled circuit the model rates as MORE
+  communication is an ERROR (A_SCHEDULE_COMM_REGRESSION) — the CI smoke
+  gate that scheduling savings stay nonnegative.
 
 Circuit modes run the IR pass and the eager/compiled abstract-eval pass
 against the deployment described by ``--devices/--precision/--chip``.
@@ -46,6 +51,28 @@ def _dtype(precision: int):
     return jnp.float32 if precision == 1 else jnp.float64
 
 
+def _schedule_report(label: str, circuit, args) -> list:
+    """Run the comm-aware scheduler, print the planner-predicted savings as
+    one JSON line, and return an ERROR diagnostic iff the scheduled circuit
+    models as MORE communication than the input (the CI smoke contract)."""
+    from ..parallel.scheduler import schedule_savings
+    from .diagnostics import AnalysisCode, Severity, diag
+    report = schedule_savings(circuit, args.devices, chip=_chip(args.chip),
+                              precision=args.precision)
+    print(f"{label}: schedule savings "
+          + json.dumps(report, default=float))
+    out = []
+    if (report["comm_events_after"] > report["comm_events_before"]
+            or report["comm_bytes_after"] > report["comm_bytes_before"]):
+        out.append(diag(AnalysisCode.SCHEDULE_COMM_REGRESSION, Severity.ERROR,
+                        detail=(f"{label}: events "
+                                f"{report['comm_events_before']}->"
+                                f"{report['comm_events_after']}, bytes "
+                                f"{report['comm_bytes_before']}->"
+                                f"{report['comm_bytes_after']}")))
+    return out
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m quest_tpu.analysis",
@@ -60,6 +87,9 @@ def main(argv=None) -> int:
                         help="analyze an N-qubit depth-DEPTH random circuit")
     parser.add_argument("--circuit", metavar="MODULE:ATTR",
                         help="import and analyze a Circuit (or factory)")
+    parser.add_argument("--schedule", action="store_true",
+                        help="run the comm-aware scheduler on each circuit "
+                             "and report predicted comm savings")
     parser.add_argument("--devices", type=int, default=1,
                         help="mesh size for the deployment model (default 1)")
     parser.add_argument("--precision", type=int, default=1, choices=(1, 2),
@@ -99,6 +129,8 @@ def main(argv=None) -> int:
                                 chip=_chip(args.chip),
                                 hints=not args.no_hints)
         found += check_abstract_eval(circuit, dtype=_dtype(args.precision))
+        if args.schedule:
+            found += _schedule_report(label, circuit, args)
         diagnostics += found
         print(f"{label}: {len(circuit.ops)} ops, "
               f"{len(found)} finding(s)")

@@ -59,6 +59,13 @@ def _eager_mrz(state, op):
                                     op.targets)
 
 
+def _eager_bitperm(state, op):
+    # scheduler-only op (no eager API twin): the contract is the kernel's
+    # own static-wire signature on both paths
+    return _ap.apply_bit_permutation(state, op.targets,
+                                     tuple(int(d) for d in op.matrix))
+
+
 # the eager API's dispatch, kind by kind (mirrors api.py); tests monkeypatch
 # entries to seed violations
 EAGER_MIRROR = {
@@ -69,6 +76,7 @@ EAGER_MIRROR = {
     "y*": _eager_y_conj,
     "swap": _eager_swap,
     "mrz": _eager_mrz,
+    "bitperm": _eager_bitperm,
 }
 
 # Per-operand dtype contracts at kernel entry.  Dense/diagonal payloads are

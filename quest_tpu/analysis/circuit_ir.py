@@ -23,7 +23,8 @@ from .diagnostics import AnalysisCode, Diagnostic, Severity, diag
 # kinds whose payload is a dense unitary / unit-modulus diagonal
 _DENSE_KINDS = ("matrix",)
 _DIAG_KINDS = ("diagonal",)
-_KNOWN_KINDS = ("matrix", "diagonal", "x", "y", "y*", "swap", "mrz")
+_KNOWN_KINDS = ("matrix", "diagonal", "x", "y", "y*", "swap", "mrz",
+                "bitperm")
 
 
 def _op_matrix(op) -> np.ndarray | None:
@@ -74,6 +75,16 @@ def _check_wires(i: int, op, n: int, out: list) -> None:
 
 
 def _check_payload(i: int, op, eps: float, out: list) -> None:
+    if op.kind == "bitperm":
+        # payload is the destination-wire list of a qubit permutation
+        # (parallel/scheduler.py), not a matrix: the only validity condition
+        # is that it permutes exactly the target wires
+        dests = tuple(int(d) for d in (op.matrix or ()))
+        if sorted(dests) != sorted(op.targets):
+            out.append(diag(AnalysisCode.INVALID_BIT_PERMUTATION,
+                            Severity.ERROR, op_index=i,
+                            detail=f"targets {op.targets} -> {dests}"))
+        return
     mat = _op_matrix(op)
     if mat is not None:
         dim = 1 << len(op.targets)

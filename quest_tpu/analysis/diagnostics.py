@@ -31,6 +31,8 @@ class AnalysisCode:
     # circuit-level projections (no runtime exception twin)
     STATE_EXCEEDS_MESH_MEMORY = "A_STATE_EXCEEDS_MESH_MEMORY"
     UNKNOWN_GATE_KIND = "A_UNKNOWN_GATE_KIND"
+    INVALID_BIT_PERMUTATION = "A_INVALID_BIT_PERMUTATION"
+    SCHEDULE_COMM_REGRESSION = "A_SCHEDULE_COMM_REGRESSION"
     # eager-vs-compiled abstract-eval drift
     EAGER_COMPILED_DTYPE_MISMATCH = "A_EAGER_COMPILED_DTYPE_MISMATCH"
     EAGER_COMPILED_SHAPE_MISMATCH = "A_EAGER_COMPILED_SHAPE_MISMATCH"
@@ -54,6 +56,14 @@ ANALYSIS_MESSAGES = {
         "to precision 1.",
     AnalysisCode.UNKNOWN_GATE_KIND:
         "Unknown gate kind: _apply_one would raise ValueError at trace time.",
+    AnalysisCode.INVALID_BIT_PERMUTATION:
+        "A 'bitperm' op's destination payload is not a permutation of its "
+        "target wires: apply_bit_permutation would fail its permutation "
+        "assertion at trace time.",
+    AnalysisCode.SCHEDULE_COMM_REGRESSION:
+        "The comm-aware scheduler produced a circuit the planner models as "
+        "MORE communication than the input (collectives or bytes over ICI "
+        "increased): a scheduler cost-model regression.",
     AnalysisCode.EAGER_COMPILED_DTYPE_MISMATCH:
         "Eager and compiled paths disagree on the output dtype of this op; "
         "the two paths would produce numerically different states.",

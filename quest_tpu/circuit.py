@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -180,11 +180,30 @@ class Circuit:
         adjacent/commuting gates and kron-packs runs of parallel gates into
         multi-target gates of up to ``max_pack`` qubits (7 = one 128-wide
         MXU tile), so the compiled program makes far fewer HBM passes.
-        No-op if the native library is unavailable."""
+        No-op if the native library is unavailable.
+
+        Mutates ``self.ops`` IN PLACE and returns ``self`` (builder-style
+        chaining — the return value is not a copy).  The rewrite invalidates
+        every derived artefact: ``key()`` reflects the fused list on the
+        next call, and the density-matrix shadow cache is dropped so a
+        subsequent ``apply_circuit`` on a density register rebuilds its
+        conjugated twin list from the fused ops."""
         from .native import fuse_ops
         self.ops = fuse_ops(self.ops, max_pack=max_pack)
         self._shadow_cache = None
         return self
+
+    def schedule(self, num_devices: int, **kwargs) -> "Circuit":
+        """Comm-aware scheduled copy of this circuit for a ``num_devices``-
+        way amplitude mesh (parallel/scheduler.py): commutation-DAG
+        reordering groups cross-shard dense gates into shared permutation
+        epochs, swap networks are fused into single bit-permutation
+        collectives, and a greedy logical->physical placement search scored
+        by the ICI time model (parallel/planner.py) may relabel the circuit.
+        Returns a NEW equivalent Circuit; ``self`` is unmodified.  See
+        docs/SCHEDULER.md."""
+        from .parallel import scheduler as _sched
+        return _sched.schedule(self, num_devices, **kwargs)
 
 
 def op_operands(op: GateOp, state_dtype) -> dict:
@@ -222,6 +241,11 @@ def _apply_one(state: jax.Array, op: GateOp) -> jax.Array:
         return _ap.swap_qubit_amps(state, op.targets[0], op.targets[1])
     if op.kind == "mrz":
         return _ap.apply_multi_rotate_z(state, operands["angle"], op.targets)
+    if op.kind == "bitperm":
+        # fused qubit permutation (scheduler-emitted): content of bit
+        # targets[i] moves to position matrix[i] — one transpose collective
+        return _ap.apply_bit_permutation(
+            state, op.targets, tuple(int(d) for d in op.matrix))
     raise ValueError(f"unknown gate kind {op.kind}")
 
 
@@ -232,6 +256,10 @@ def _shadow_op(op: GateOp, n: int) -> GateOp:
     conj_matrix = op.matrix
     if op.kind == "mrz":
         conj_matrix = (-op.matrix[0],)  # conj(exp(-i a/2 Z..Z)) = same at -a
+    elif op.kind == "bitperm":
+        # payload is the destination-wire list, not a matrix: shift it to the
+        # column side with the targets (a real permutation is its own conj)
+        conj_matrix = tuple(float(int(d) + n) for d in op.matrix)
     elif op.matrix is not None:
         p = op.payload()
         conj_matrix = tuple(np.stack([p[0], -p[1]]).ravel())
@@ -249,6 +277,12 @@ def _apply_one_routed(state: jax.Array, op: GateOp, perm: tuple):
         u = jnp.asarray(op.payload(), dtype=state.dtype)
         return _ap.apply_matrix_routed(state, u, op.targets, op.controls,
                                        op.control_states, perm)
+    if op.kind == "bitperm":
+        # both the source wires AND the destination payload are logical:
+        # translate each through the live routing permutation
+        t = tuple(perm[q] for q in op.targets)
+        d = tuple(perm[int(x)] for x in op.matrix)
+        return _ap.apply_bit_permutation(state, t, d), perm
     t = tuple(perm[q] for q in op.targets)
     c = tuple(perm[q] for q in op.controls)
     if t != op.targets or c != op.controls:
@@ -273,16 +307,37 @@ def _run_ops(state: jax.Array, ops: tuple) -> jax.Array:
     return _run_ops_routed(state, ops)
 
 
-def compile_circuit(circuit: Circuit, donate: bool = False):
+@lru_cache(maxsize=32)
+def _donated_program(ops: tuple):
+    """One jitted donating program per op tuple.  Without this cache every
+    ``compile_circuit(donate=True)`` call built a FRESH ``run`` closure, and
+    ``jax.jit`` caches per function object — so each call carried an empty
+    jit cache and retraced/recompiled the whole circuit (measured: one full
+    XLA compile per call in an iteration loop).  Keyed on ``circuit.key()``:
+    equal op lists share one program and trace once per state signature.
+    Bounded because compiled donating executables pin device memory; an
+    evicted entry just retraces on next use."""
+    @partial(jax.jit, donate_argnums=(0,))
+    def run(state: jax.Array) -> jax.Array:
+        return _run_ops_routed(state, ops)
+    return run
+
+
+def compile_circuit(circuit: Circuit, donate: bool = False,
+                    num_devices: int | None = None):
     """Return a jitted ``state -> state`` applying the whole circuit as one
     XLA program.  ``donate=True`` reuses the input buffer (allocation-free
-    iteration) — callers must not hold other references to the state."""
+    iteration) — callers must not hold other references to the state; the
+    donated program is cached on ``circuit.key()`` (see _donated_program).
+    ``num_devices`` runs the comm-aware scheduler first
+    (:meth:`Circuit.schedule`): the compiled program is the scheduled,
+    collective-minimised equivalent for an ``num_devices``-way amplitude
+    mesh."""
+    if num_devices is not None and num_devices > 1:
+        circuit = circuit.schedule(num_devices)
     ops = circuit.key()
     if donate:
-        @partial(jax.jit, donate_argnums=(0,))
-        def run(state: jax.Array) -> jax.Array:
-            return _run_ops_routed(state, ops)
-        return run
+        return _donated_program(ops)
 
     def run(state: jax.Array) -> jax.Array:
         return _run_ops(state, ops)

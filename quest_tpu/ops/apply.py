@@ -861,17 +861,101 @@ def apply_matrix_routed(state: jax.Array, u: jax.Array, targets: tuple,
     return state, tuple(new_perm)
 
 
-def reconcile_perm(state: jax.Array, perm: tuple) -> jax.Array:
-    """Physically restore logical == physical bit order via pairwise swaps
-    (the lazy reconciliation at the end of a compiled program)."""
-    pos = list(perm)
-    for logical in range(len(pos)):
-        p = pos[logical]
-        if p == logical:
+def _perm_cycles(mapping: dict) -> list:
+    """Cycle decomposition of a content map ``{src: dst}`` (a permutation on
+    its support): each cycle ``[a1, a2, ..., ak]`` means content a1 -> a2,
+    ..., ak -> a1.  Host-side helper shared by the permutation kernels and
+    the scheduler (parallel/scheduler.py)."""
+    seen: set = set()
+    cycles = []
+    for start in sorted(mapping):
+        if start in seen or mapping[start] == start:
             continue
-        other = pos.index(logical)
-        state = swap_qubit_amps(state, p, logical)
-        pos[other], pos[logical] = p, logical
+        cyc = [start]
+        seen.add(start)
+        cur = mapping[start]
+        while cur != start:
+            cyc.append(cur)
+            seen.add(cur)
+            cur = mapping[cur]
+        cycles.append(cyc)
+    return cycles
+
+
+@partial(jax.jit, static_argnames=("wires", "dests"))
+def apply_bit_permutation(state: jax.Array, wires: tuple,
+                          dests: tuple) -> jax.Array:
+    """Move the amplitude-index bit at position ``wires[i]`` to position
+    ``dests[i]`` — the scheduler's fused permutation op (epoch boundaries,
+    fused swap networks, placement boundaries; parallel/scheduler.py).
+
+    When every involved position is a prefix qubit this is ONE grouped-view
+    axis transpose: zero arithmetic, and on a sharded state GSPMD lowers
+    every cross-shard move of the single transpose into one all-to-all —
+    where the equivalent pairwise ``swap_qubit_amps`` chain pays one
+    collective per swap (the comm the scheduler exists to save).  Positions
+    inside the minor (lane/sublane) blocks cannot be transposed without
+    breaking the (8, 128) tile, so such permutations fall back to pairwise
+    swaps through the matrix engine."""
+    n = num_qubits_of(state)
+    wires = tuple(int(w) for w in wires)
+    dests = tuple(int(d) for d in dests)
+    assert sorted(wires) == sorted(dests), \
+        f"bit permutation {wires} -> {dests} is not a permutation"
+    mapping = {w: d for w, d in zip(wires, dests) if w != d}
+    if not mapping:
+        return state
+    l, s = _blocks(n)
+    if min(mapping) >= l + s:
+        support = tuple(sorted(mapping))
+        dims, axis_of, _, _ = grouped_shape(n, tuple((q, 1) for q in support))
+        t = state.reshape((2,) + dims)
+        axes = list(range(t.ndim))
+        for w, d in mapping.items():
+            # the output axis indexing bit d carries the input axis of bit w
+            axes[1 + axis_of[d]] = 1 + axis_of[w]
+        return jnp.transpose(t, axes).reshape(2, -1)
+    for cyc in _perm_cycles(mapping):
+        # content a1 -> a2 -> ... -> ak -> a1 via swaps (a1,a2),(a1,a3),...
+        for x in cyc[1:]:
+            state = swap_qubit_amps(state, cyc[0], x)
+    return state
+
+
+def split_prefix_cycles(mapping: dict, lo: int) -> tuple:
+    """Split a content map into ``(fused, rest)``: cycles living entirely on
+    prefix wires (``>= lo``) merge into one transposable map (the fused
+    ``bitperm`` form), everything else stays for pairwise swaps.  The ONE
+    definition of that split — shared by :func:`reconcile_perm` and the
+    scheduler's static lowering (parallel/scheduler.py), so the two can
+    never diverge on what fuses."""
+    fused: dict = {}
+    rest: dict = {}
+    for cyc in _perm_cycles(mapping):
+        tgt = fused if min(cyc) >= lo else rest
+        for i, x in enumerate(cyc):
+            tgt[x] = cyc[(i + 1) % len(cyc)]
+    return fused, rest
+
+
+def reconcile_perm(state: jax.Array, perm: tuple) -> jax.Array:
+    """Physically restore logical == physical bit order (the lazy
+    reconciliation at the end of a compiled program).  Cycles living
+    entirely on prefix qubits are fused into one bit-permutation transpose
+    (one collective on a sharded state — see :func:`apply_bit_permutation`);
+    cycles touching the minor blocks keep the pairwise-swap form."""
+    n = len(perm)
+    # logical bit q sits at physical position perm[q] and must return to q
+    mapping = {p: q for q, p in enumerate(perm) if p != q}
+    if not mapping:
+        return state
+    fused, rest = split_prefix_cycles(mapping, sum(_blocks(n)))
+    if fused:
+        state = apply_bit_permutation(state, tuple(sorted(fused)),
+                                      tuple(fused[w] for w in sorted(fused)))
+    for cyc in _perm_cycles(rest):
+        for x in cyc[1:]:
+            state = swap_qubit_amps(state, cyc[0], x)
     return state
 
 

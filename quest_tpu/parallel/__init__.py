@@ -14,10 +14,17 @@ levels:
 3. diagnostic — :mod:`.planner` reports which gates of a circuit are
    shard-local vs cross-shard for a given mesh, the analogue of the
    reference's halfMatrixBlockFitsInChunk decision procedure
-   (QuEST_cpu_distributed.c:356-361).
+   (QuEST_cpu_distributed.c:356-361);
+4. optimizer — :mod:`.scheduler` consumes the planner's cost model to
+   REWRITE circuits: commutation-DAG reordering, permutation epochs, fused
+   swap networks and a greedy placement search (Circuit.schedule /
+   compile_circuit(num_devices=...), docs/SCHEDULER.md).
 """
 
 from .mesh import make_amps_mesh, amp_sharding, replicated_sharding  # noqa: F401
 from .collectives import (pairwise_exchange, global_sum,  # noqa: F401
                           gather_full_state)
-from .planner import comm_plan, is_shard_local  # noqa: F401
+from .planner import (comm_plan, comm_summary, is_shard_local,  # noqa: F401
+                      local_qubit_count, time_model)
+from .scheduler import (commutation_dag, greedy_placement,  # noqa: F401
+                        schedule, schedule_savings)

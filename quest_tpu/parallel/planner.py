@@ -13,13 +13,20 @@ from __future__ import annotations
 import dataclasses
 
 
+def local_qubit_count(num_qubits: int, num_devices: int) -> int:
+    """Number of shard-local qubits of an ``num_devices``-way amplitude mesh:
+    positions ``>= local_qubit_count`` index the sharded prefix of the
+    amplitude axis (ref: the chunk-size arithmetic around
+    halfMatrixBlockFitsInChunk, QuEST_cpu_distributed.c:356-361)."""
+    if num_devices <= 1:
+        return num_qubits
+    return num_qubits - (num_devices.bit_length() - 1)
+
+
 def is_shard_local(target: int, num_qubits: int, num_devices: int) -> bool:
     """A gate on ``target`` touches only in-shard amplitude pairs iff the
     target lies below the sharded range (ref: halfMatrixBlockFitsInChunk)."""
-    if num_devices <= 1:
-        return True
-    local_qubits = num_qubits - (num_devices.bit_length() - 1)
-    return target < local_qubits
+    return target < local_qubit_count(num_qubits, num_devices)
 
 
 @dataclasses.dataclass
@@ -54,6 +61,19 @@ def comm_plan(circuit, num_devices: int, bytes_per_amp: int = 8) -> list:
             # parity-phase rotation: iota+popcount elementwise multiply
             # (ops/apply.py apply_multi_rotate_z) — comm-free on any sharding
             plans.append(GatePlan(i, op.kind, op.targets, True, "none", 0))
+            continue
+
+        if op.kind == "bitperm":
+            # fused qubit permutation (parallel/scheduler.py): one grouped
+            # transpose.  All cross-shard moves ride ONE all-to-all (the
+            # whole point of fusing a swap network), so a bitperm touching
+            # the sharded range costs one reshard total; a shard-local one
+            # is pure local data movement
+            if cross:
+                plans.append(GatePlan(i, op.kind, op.targets, False,
+                                      "reshard", 2 * shard_amps * bytes_per_amp))
+            else:
+                plans.append(GatePlan(i, op.kind, op.targets, True, "none", 0))
             continue
 
         if op.kind == "diagonal":
@@ -92,6 +112,20 @@ def comm_plan(circuit, num_devices: int, bytes_per_amp: int = 8) -> list:
             plans.append(GatePlan(i, op.kind, op.targets, False, "reshard",
                                   2 * shard_amps * bytes_per_amp + extra))
     return plans
+
+
+def comm_summary(circuit, num_devices: int, bytes_per_amp: int = 8) -> dict:
+    """Aggregate view of :func:`comm_plan` — the scheduler's objective
+    terms: how many collectives the circuit issues on an ``num_devices``-way
+    mesh and how many bytes they move (per device, one direction)."""
+    plans = comm_plan(circuit, num_devices, bytes_per_amp)
+    return {
+        "ops": len(plans),
+        "comm_events": sum(1 for p in plans if p.comm != "none"),
+        "permute_events": sum(1 for p in plans if p.comm == "permute"),
+        "reshard_events": sum(1 for p in plans if p.comm == "reshard"),
+        "bytes_moved": sum(p.bytes_moved for p in plans),
+    }
 
 
 # ---------------------------------------------------------------------------

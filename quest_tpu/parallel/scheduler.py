@@ -1,0 +1,623 @@
+"""Comm-aware circuit scheduler: commutation DAG + placement search driving
+the routed executor.
+
+The reference pays swap-rerouting per wide gate and never fixed it (the TODO
+at QuEST_cpu_distributed.c:1376-1379); PR 1's deferred routing
+(ops/apply.py apply_matrix_routed) only amortises swaps between consecutive
+gates that happen to share a layout, and the planner's cost model
+(parallel/planner.py comm_plan / time_model) was purely diagnostic.  This
+module turns the planner into an optimizer: it reorders and rewrites a
+recorded :class:`quest_tpu.Circuit` so the compiled program issues fewer
+cross-shard collectives on an amplitude mesh, without changing the unitary
+it implements.
+
+Three cooperating passes, all pure host work over the GateOp IR:
+
+1. **Commutation DAG** (:func:`commutation_dag`).  Two ops commute whenever
+   every shared wire sees a *diagonal* action from both: diagonal/parity
+   (``diagonal``/``mrz``) payloads are diagonal on all their wires, and any
+   gate is diagonal on its control wires — so phase ladders commute with
+   each other and slide through Z-controls, while dense targets
+   (``matrix``/``x``/``y``/``swap``/``bitperm``) order against everything
+   sharing their wires.  Ops on disjoint wires commute trivially (no edge).
+
+2. **Epoch scheduling** (:func:`reorder_ops` + :func:`_lower_epochs`).
+   A topological order that (a) sinks comm-free ops eagerly between epochs
+   and (b) groups dense gates by *routing signature* — the cross-shard
+   target set plus the minor-block reroute the gate engine would perform —
+   so gates needing the same layout run back-to-back and the routed
+   executor pays each permutation once.  Grouped cross-shard runs whose
+   modeled collective cost exceeds two boundary permutations are *lowered*:
+   one fused ``bitperm`` moves the sharded targets into shard-local prefix
+   positions, the run executes comm-free on relabeled wires, and the same
+   ``bitperm`` (an involution) restores the layout.
+
+3. **Swap-network fusion** (:func:`_fuse_swap_runs`).  A run of ``swap``
+   ops (e.g. the QFT's trailing bit reversal) is one net permutation; it is
+   refactored as ``L2 . T . L1`` where ``T`` is a single prefix-axis
+   ``bitperm`` (ONE all-to-all carries every cross-shard move) and
+   ``L1``/``L2`` are shard-local staging swaps — instead of one collective
+   per cross-shard pairwise swap.
+
+4. **Placement search** (:func:`greedy_placement`).  A greedy logical->
+   physical relabeling scored by :func:`planner.time_model`'s ICI model:
+   hot dense wires are hill-climbed out of the sharded range; the
+   relabeling is applied as boundary ``bitperm`` ops (entry permutation +
+   one reconcile at the end), and is only adopted when the modeled end-to-
+   end time — boundary collectives included — improves, so circuits with
+   uniformly hot wires keep the identity placement.
+
+Entry points: :meth:`quest_tpu.Circuit.schedule`,
+``compile_circuit(..., num_devices=...)``, and :func:`schedule_savings`
+(the before/after report behind ``python -m quest_tpu.analysis
+--schedule``).  See docs/SCHEDULER.md.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+
+from . import planner as _planner
+
+__all__ = ["commutation_dag", "reorder_ops", "schedule", "schedule_savings",
+           "greedy_placement", "apply_placement"]
+
+# kinds whose payload acts diagonally on every wire they touch
+_DIAG_KINDS = ("diagonal", "mrz")
+# dense-on-target kinds the placement weight tracks
+_DENSE_KINDS = ("matrix", "x", "y", "y*", "swap", "bitperm")
+
+
+def _op_wires(op) -> tuple:
+    return op.targets + op.controls
+
+
+def _acts_diagonally(op, wire: int) -> bool:
+    """True iff ``op``'s action on ``wire`` is diagonal in the computational
+    basis: control wires always (a controlled gate is block-diagonal in its
+    control basis, whatever the control state), and diagonal/parity payloads
+    on their targets too."""
+    if op.kind in _DIAG_KINDS:
+        return True
+    return wire in op.controls
+
+
+@dataclasses.dataclass
+class CommutationDAG:
+    """Dependency DAG over a GateOp list: an edge i -> j means op j must not
+    be reordered before op i (they share a wire on which at least one acts
+    densely)."""
+    preds: list
+    succs: list
+
+    def __len__(self) -> int:
+        return len(self.preds)
+
+
+def commutation_dag(ops) -> CommutationDAG:
+    """Build the commutation DAG.  Per wire we keep the last densely-acting
+    op and the diagonally-acting ops recorded since: a new diagonal op on
+    the wire depends only on the last dense one (diagonals commute among
+    themselves and slide through controls); a new dense op depends on the
+    last dense op AND every diagonal recorded since (it would not commute
+    past any of them)."""
+    preds: list = [set() for _ in ops]
+    succs: list = [set() for _ in ops]
+
+    def edge(a: int, b: int) -> None:
+        if a != b:
+            succs[a].add(b)
+            preds[b].add(a)
+
+    last_dense: dict = {}
+    diag_since: dict = {}
+    for i, op in enumerate(ops):
+        for w in dict.fromkeys(_op_wires(op)):
+            d = last_dense.get(w)
+            if _acts_diagonally(op, w):
+                if d is not None:
+                    edge(d, i)
+                diag_since.setdefault(w, []).append(i)
+            else:
+                if d is not None:
+                    edge(d, i)
+                for j in diag_since.get(w, ()):
+                    edge(j, i)
+                last_dense[w] = i
+                diag_since[w] = []
+    return CommutationDAG(preds, succs)
+
+
+def _cross_targets(op, n: int, num_devices: int) -> tuple:
+    return tuple(t for t in op.targets
+                 if not _planner.is_shard_local(t, n, num_devices))
+
+
+def _reroute_sig(op, n: int) -> tuple:
+    """The minor-block reroute the gate engine would perform for this dense
+    gate at identity layout (ops/apply.py _gate_plan) — gates sharing it can
+    share one physical routing in the routed executor."""
+    if op.kind != "matrix":
+        return ()
+    from ..ops import apply as _ap
+    cs = op.control_states or (1,) * len(op.controls)
+    try:
+        plan = _ap._gate_plan(n, op.targets, op.controls, tuple(cs), False)
+    except Exception:
+        return ()  # unroutable gates are the validation layer's finding
+    return plan.reroute
+
+
+def _epoch_sig(op, n: int, num_devices: int):
+    """Routing-signature grouping key, or None for routing-neutral ops
+    (comm-free, or position-agnostic under the executor's live perm)."""
+    if op.kind != "matrix":
+        return None
+    cross = _cross_targets(op, n, num_devices)
+    reroute = _reroute_sig(op, n)
+    if not cross and not reroute:
+        return None
+    return (cross, reroute)
+
+
+def reorder_ops(ops, n: int, num_devices: int) -> list:
+    """Greedy topological order over the commutation DAG: routing-neutral
+    ops are emitted as soon as they are ready (sunk between epochs), and
+    among routing-carrying ops the current epoch's signature is preferred,
+    so same-layout gates run back-to-back.  Deterministic: ties break on
+    the original op index."""
+    dag = commutation_dag(ops)
+    indeg = [len(p) for p in dag.preds]
+    ready = sorted(i for i, d in enumerate(indeg) if d == 0)
+    sigs = [_epoch_sig(op, n, num_devices) for op in ops]
+    out: list = []
+    current = None
+    while ready:
+        pick = None
+        for i in ready:  # routing-neutral first
+            if sigs[i] is None:
+                pick = i
+                break
+        if pick is None and current is not None:
+            for i in ready:  # then the open epoch
+                if sigs[i] == current:
+                    pick = i
+                    break
+        if pick is None:
+            pick = ready[0]  # open the next epoch at the earliest ready op
+            current = sigs[pick]
+        ready.remove(pick)
+        out.append(ops[pick])
+        for j in sorted(dag.succs[pick]):
+            indeg[j] -= 1
+            if indeg[j] == 0:
+                bisect.insort(ready, j)  # ready stays sorted for stable ties
+    assert len(out) == len(ops)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# permutation lowering: content maps -> IR ops
+# ---------------------------------------------------------------------------
+
+def _cycles(mapping: dict) -> list:
+    from ..ops.apply import _perm_cycles
+    return _perm_cycles({k: v for k, v in mapping.items() if k != v})
+
+
+def _bitperm_op(mapping: dict):
+    """One fused ``bitperm`` GateOp realizing a prefix content map."""
+    from ..circuit import GateOp
+    support = tuple(sorted(mapping))
+    return GateOp("bitperm", support, (), (),
+                  tuple(float(mapping[w]) for w in support), None)
+
+
+def _swap_ops(mapping: dict) -> list:
+    """Pairwise-swap GateOps realizing a content map (cycle a1->a2->...->ak
+    as swaps (a1,a2),(a1,a3),...,(a1,ak))."""
+    from ..circuit import GateOp
+    out = []
+    for cyc in _cycles(mapping):
+        for x in cyc[1:]:
+            out.append(GateOp("swap", (cyc[0], x)))
+    return out
+
+
+def _perm_to_ops(n: int, cmap: dict, num_devices: int) -> list:
+    """Lower a content permutation (``cmap[src] = dst``) to IR ops paying at
+    most ONE cross-shard collective.
+
+    Factors ``perm = L2 . T . L1``: ``L1`` stages minor-block content bound
+    for the sharded range at shard-local prefix positions (pairwise swaps
+    through the matrix engine — comm-free), ``T`` is one prefix-only
+    ``bitperm`` finalising every sharded position (one transpose, one
+    all-to-all), and ``L2`` = ``perm . (T . L1)^-1`` touches only
+    shard-local wires (prefix-local cycles fuse into a second comm-free
+    ``bitperm``; minor cycles stay pairwise swaps)."""
+    cmap = {k: v for k, v in cmap.items() if k != v}
+    if not cmap:
+        return []
+    from ..ops.apply import _blocks
+    l, s = _blocks(n)
+    lo = l + s
+    local_q = _planner.local_qubit_count(n, num_devices)
+    support = set(cmap) | set(cmap.values())
+    full = {w: cmap.get(w, w) for w in support}
+
+    if local_q <= lo or all(max(cyc) < local_q for cyc in _cycles(full)):
+        # nothing crosses the sharded range (or there is no prefix room to
+        # stage through): emit the local form directly
+        return _local_perm_ops(full, lo)
+
+    # L1: stage minor content destined for a sharded position.  A staging
+    # wire may itself be part of the permutation — L2 absorbs the
+    # displacement exactly — as long as its OWN content stays shard-local
+    # (otherwise T would have to pick it up from a minor position)
+    free = [q for q in range(local_q - 1, lo - 1, -1) if q not in support]
+    busy_ok = [q for q in range(local_q - 1, lo - 1, -1)
+               if q in support and full[q] < local_q]
+    staging = free + busy_ok
+    needs_staging = [o for o in sorted(full)
+                     if o < lo and full[o] >= local_q]
+    if len(staging) < len(needs_staging):
+        return _local_perm_ops(full, lo)  # no room: plain pairwise form
+    l1: dict = {}
+    for o in needs_staging:
+        st = staging.pop(0)
+        l1[o] = st
+        l1[st] = o
+    after_l1 = {w: l1.get(w, w) for w in set(full) | set(l1)}
+
+    # T: finalise every sharded position in one prefix transpose
+    t_map: dict = {}
+    for p in sorted(full.values()):
+        if p >= local_q:
+            src = after_l1[next(o for o, d in full.items() if d == p)]
+            assert src >= lo, (src, p)
+            t_map[src] = p
+    # close T into a permutation of prefix wires: positions receiving new
+    # content whose own content has no assignment yet drain into the wires
+    # content left (all shard-local, see docs/SCHEDULER.md)
+    open_dst = sorted(set(t_map.values()) - set(t_map))
+    open_src = sorted(set(t_map) - set(t_map.values()))
+    for p, d in zip(open_dst, open_src):
+        assert d < local_q, (p, d)
+        t_map[p] = d
+
+    # L2 = perm . (T . L1)^-1, computed by simulating content positions
+    pos: dict = {}
+    for o in support | set(l1):
+        c = l1.get(o, o)
+        pos[o] = t_map.get(c, c)
+    l2 = {}
+    for o, p in pos.items():
+        want = full.get(o, o)
+        if p != want:
+            l2[p] = want
+    assert all(max(cyc) < local_q for cyc in _cycles(l2)), l2
+
+    ops = _swap_ops(l1)
+    ops.append(_bitperm_op(t_map))
+    ops += _local_perm_ops(l2, lo)
+    return ops
+
+
+def _local_perm_ops(cmap: dict, lo: int) -> list:
+    """Shard-local permutation: prefix-only cycles fuse into one comm-free
+    ``bitperm`` pass; cycles touching the minor blocks stay pairwise.  The
+    split is :func:`ops.apply.split_prefix_cycles` — the same rule the
+    routed executor's reconcile_perm applies at runtime."""
+    from ..ops.apply import split_prefix_cycles
+    fused, rest = split_prefix_cycles(
+        {k: v for k, v in cmap.items() if k != v}, lo)
+    ops = []
+    if fused:
+        ops.append(_bitperm_op(fused))
+    ops += _swap_ops(rest)
+    return ops
+
+
+# ---------------------------------------------------------------------------
+# swap-network fusion
+# ---------------------------------------------------------------------------
+
+def _net_swap_map(run) -> dict:
+    """Net content map of a run of ``swap`` ops."""
+    at: dict = {}  # position -> origin
+    for op in run:
+        a, b = op.targets
+        at[a], at[b] = at.get(b, b), at.get(a, a)
+    return {o: p for p, o in at.items() if p != o}
+
+
+def _fuse_swap_runs(ops, n: int, num_devices: int) -> list:
+    """Replace each maximal run of consecutive ``swap`` ops by the fused
+    lowering of its net permutation (:func:`_perm_to_ops`) whenever that
+    strictly reduces modeled collectives — the QFT's trailing bit reversal
+    collapses from one reshard per cross-shard pair to one all-to-all."""
+    out: list = []
+    i = 0
+    while i < len(ops):
+        if ops[i].kind != "swap":
+            out.append(ops[i])
+            i += 1
+            continue
+        j = i
+        while j < len(ops) and ops[j].kind == "swap":
+            j += 1
+        run = ops[i:j]
+        fused = _perm_to_ops(n, _net_swap_map(run), num_devices)
+        if _comm_cost(fused, n, num_devices) < _comm_cost(run, n, num_devices) \
+                or (len(run) > 1 and len(fused) < len(run)
+                    and _comm_cost(fused, n, num_devices)
+                    == _comm_cost(run, n, num_devices)):
+            out.extend(fused)
+        else:
+            out.extend(run)
+        i = j
+    return out
+
+
+def _comm_cost(ops, n: int, num_devices: int) -> tuple:
+    """(comm events, bytes moved) of an op list under the planner model."""
+    from ..circuit import Circuit
+    c = Circuit(n)
+    c.ops = list(ops)
+    s = _planner.comm_summary(c, num_devices)
+    return (s["comm_events"], s["bytes_moved"])
+
+
+# ---------------------------------------------------------------------------
+# epoch lowering: grouped cross-shard runs -> bitperm-bracketed local runs
+# ---------------------------------------------------------------------------
+
+def _relabel_op(op, mapping: dict):
+    """Wire-relabeled twin of ``op`` (bitperm payloads are wires too)."""
+    from ..circuit import GateOp
+    t = tuple(mapping.get(q, q) for q in op.targets)
+    c = tuple(mapping.get(q, q) for q in op.controls)
+    mat = op.matrix
+    if op.kind == "bitperm":
+        mat = tuple(float(mapping.get(int(d), int(d))) for d in op.matrix)
+    if t == op.targets and c == op.controls and mat == op.matrix:
+        return op
+    return GateOp(op.kind, t, c, op.control_states, mat, op.shape)
+
+
+def _op_unit_cost(op, n: int, num_devices: int) -> int:
+    """Planner comm units of one op (shard-sized passes over ICI): the
+    exact :func:`planner.comm_plan` model with bytes_per_amp=1, so reshard=2,
+    permute=1, plus any slice-style sharded-control surcharge."""
+    from ..circuit import Circuit
+    c = Circuit(n)
+    c.ops = [op]
+    plan = _planner.comm_plan(c, num_devices, 1)[0]
+    shard_amps = (1 << n) // num_devices
+    return plan.bytes_moved // shard_amps
+
+
+def _epoch_member_wires(op, n: int, num_devices: int) -> tuple:
+    """Sharded wires a dense gate would stop paying for if relabeled into
+    the shard-local range: cross targets AND cross controls (a slice-style
+    control on a sharded axis exchanges too — planner.comm_plan)."""
+    return tuple(w for w in _op_wires(op)
+                 if not _planner.is_shard_local(w, n, num_devices))
+
+
+def _lower_epochs(ops, n: int, num_devices: int) -> list:
+    """Bracket grouped cross-shard dense runs between two fused ``bitperm``
+    boundary permutations that pull every sharded wire of the run into a
+    shard-local prefix position: the bracketed gates execute comm-free on
+    relabeled wires, and the layout is restored by the same involution.
+    Applied only when the planner-model savings strictly beat the two
+    boundary collectives (2 units each)."""
+    from ..ops.apply import _blocks
+    lo = sum(_blocks(n))
+    local_q = _planner.local_qubit_count(n, num_devices)
+    if num_devices <= 1 or local_q <= lo:
+        return list(ops)
+    out: list = []
+    i = 0
+    while i < len(ops):
+        op = ops[i]
+        if op.kind != "matrix" or _op_unit_cost(op, n, num_devices) == 0:
+            out.append(op)
+            i += 1
+            continue
+        # grow a window of cross-shard dense gates and interleaved ops that
+        # stay clear of the sharded range
+        union: set = set(_epoch_member_wires(op, n, num_devices))
+        last_member = i
+        benefit = _op_unit_cost(op, n, num_devices)
+        j = i + 1
+        while j < len(ops):
+            nxt = ops[j]
+            cost = _op_unit_cost(nxt, n, num_devices)
+            if nxt.kind == "matrix" and cost:
+                cand = union | set(_epoch_member_wires(nxt, n, num_devices))
+                if len(cand) > local_q - lo:
+                    break
+                union = cand
+                benefit += cost
+                last_member = j
+            elif cost or any(w >= local_q for w in _op_wires(nxt)) \
+                    or nxt.kind == "bitperm":
+                break  # touches the sharded range some other way: barrier
+            else:
+                j += 1
+                continue
+            j += 1
+        window = ops[i:last_member + 1]
+        window_wires = set()
+        for w_op in window:
+            window_wires |= set(_op_wires(w_op))
+        dests = [q for q in range(local_q - 1, lo - 1, -1)
+                 if q not in window_wires and q not in union]
+        if benefit > 4 and len(dests) >= len(union):
+            rho = {}
+            for c_wire, d_wire in zip(sorted(union), dests):
+                rho[c_wire] = d_wire
+                rho[d_wire] = c_wire
+            boundary = _bitperm_op(rho)
+            out.append(boundary)
+            out.extend(_relabel_op(w_op, rho) for w_op in window)
+            out.append(boundary)  # rho is an involution
+        else:
+            out.extend(window)
+        i = last_member + 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# placement search
+# ---------------------------------------------------------------------------
+
+def _dense_weight(ops) -> dict:
+    """Per-wire dense-gate pressure: what the placement search tries to keep
+    out of the sharded range."""
+    w: dict = {}
+    for op in ops:
+        if op.kind in _DENSE_KINDS:
+            unit = 2 if (op.kind == "matrix" and len(op.targets) > 1) else 1
+            for t in op.targets:
+                w[t] = w.get(t, 0) + unit
+    return w
+
+
+def _model_seconds(circuit, num_devices: int, chip, precision: int) -> float:
+    return sum(t.total_s
+               for t in _planner.time_model(circuit, num_devices, chip,
+                                            precision))
+
+
+def apply_placement(circuit, sigma: tuple, num_devices: int):
+    """Relabel ``circuit`` by the placement ``sigma`` (logical wire q runs
+    on physical position sigma[q]); equivalence is preserved for ARBITRARY
+    input states by an entry permutation realizing sigma and one reconcile
+    (sigma^-1) at the end, both in the fused :func:`_perm_to_ops` form."""
+    from ..circuit import Circuit
+    n = circuit.num_qubits
+    if tuple(sigma) == tuple(range(n)):
+        out = Circuit(n)
+        out.ops = list(circuit.ops)
+        return out
+    inv = [0] * n
+    for q, p in enumerate(sigma):
+        inv[p] = q
+    mapping = {q: p for q, p in enumerate(sigma) if q != p}
+    out = Circuit(n)
+    out.ops = (_perm_to_ops(n, mapping, num_devices)
+               + [_relabel_op(op, mapping) for op in circuit.ops]
+               + _perm_to_ops(n, {p: q for q, p in mapping.items()},
+                              num_devices))
+    return out
+
+
+def greedy_placement(circuit, num_devices: int, chip=None,
+                     precision: int = 1, max_rounds: int | None = None) -> tuple:
+    """Greedy initial logical->physical placement scored by
+    :func:`planner.time_model`: repeatedly try moving the heaviest
+    still-sharded wire to the lightest shard-local position (a transposition
+    of the current placement) and keep the swap iff the modeled end-to-end
+    seconds — boundary permutations included — strictly improve.  Returns
+    the placement as a tuple (identity when nothing wins, e.g. when every
+    wire is equally hot)."""
+    chip = chip or _planner.V5E
+    n = circuit.num_qubits
+    sigma = list(range(n))
+    local_q = _planner.local_qubit_count(n, num_devices)
+    # local_q <= 0: every wire is sharded (num_devices >= 2^n, which the
+    # reference permits) — no shard-local position exists to trade with
+    if num_devices <= 1 or local_q >= n or local_q <= 0:
+        return tuple(sigma)
+    weight = _dense_weight(circuit.ops)
+    best = _model_seconds(apply_placement(circuit, tuple(sigma), num_devices),
+                          num_devices, chip, precision)
+    rounds = max_rounds if max_rounds is not None else n - local_q
+    for _ in range(rounds):
+        # heaviest logical wire currently placed in the sharded range,
+        # lightest placed shard-local
+        hot = max((q for q in range(n) if sigma[q] >= local_q),
+                  key=lambda q: (weight.get(q, 0), -q))
+        cold = min((q for q in range(n) if sigma[q] < local_q),
+                   key=lambda q: (weight.get(q, 0), q))
+        if weight.get(hot, 0) <= weight.get(cold, 0):
+            break  # already balanced: no swap can help
+        cand = list(sigma)
+        cand[hot], cand[cold] = cand[cold], cand[hot]
+        score = _model_seconds(
+            apply_placement(circuit, tuple(cand), num_devices),
+            num_devices, chip, precision)
+        if score < best:
+            sigma, best = cand, score
+        else:
+            break
+    return tuple(sigma)
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def schedule(circuit, num_devices: int, *, chip=None, precision: int = 1,
+             placement: bool = True, reorder: bool = True):
+    """Comm-aware scheduled copy of ``circuit`` for an ``num_devices``-way
+    amplitude mesh.  Pure host rewrite of the GateOp IR; the returned
+    Circuit implements the SAME unitary (every pass is an exact algebraic
+    refactoring) and is what ``compile_circuit(..., num_devices=...)``
+    feeds the routed executor."""
+    from ..circuit import Circuit
+    from ..validation import validate_num_ranks
+    validate_num_ranks(num_devices, "schedule")
+    chip = chip or _planner.V5E
+    n = circuit.num_qubits
+    ops = list(circuit.ops)
+    if reorder and num_devices > 1:
+        ops = reorder_ops(ops, n, num_devices)
+    staged = Circuit(n)
+    staged.ops = ops
+    if placement and num_devices > 1:
+        sigma = greedy_placement(staged, num_devices, chip, precision)
+        staged = apply_placement(staged, sigma, num_devices)
+        ops = staged.ops
+    ops = _fuse_swap_runs(ops, n, num_devices)
+    ops = _lower_epochs(ops, n, num_devices)
+    out = Circuit(n)
+    out.ops = ops
+    return out
+
+
+def schedule_savings(circuit, num_devices: int, *, bytes_per_amp: int = 8,
+                     chip=None, precision: int = 1, scheduled=None) -> dict:
+    """Before/after report of what scheduling buys: planner-predicted
+    collective counts, bytes over ICI, and modeled seconds.  The payload
+    behind ``python -m quest_tpu.analysis --schedule`` and the predicted
+    columns of bench.py's scheduled-vs-unscheduled rows."""
+    chip = chip or _planner.V5E
+    if scheduled is None:
+        scheduled = schedule(circuit, num_devices, chip=chip,
+                             precision=precision)
+    before = _planner.comm_summary(circuit, num_devices, bytes_per_amp)
+    after = _planner.comm_summary(scheduled, num_devices, bytes_per_amp)
+    sec_before = _model_seconds(circuit, num_devices, chip, precision)
+    sec_after = _model_seconds(scheduled, num_devices, chip, precision)
+    return {
+        "num_devices": num_devices,
+        "ops_before": before["ops"], "ops_after": after["ops"],
+        "comm_events_before": before["comm_events"],
+        "comm_events_after": after["comm_events"],
+        "reshard_events_before": before["reshard_events"],
+        "reshard_events_after": after["reshard_events"],
+        "comm_bytes_before": before["bytes_moved"],
+        "comm_bytes_after": after["bytes_moved"],
+        "model_seconds_before": sec_before,
+        "model_seconds_after": sec_after,
+        "comm_events_saved_frac": (
+            (before["comm_events"] - after["comm_events"])
+            / before["comm_events"] if before["comm_events"] else 0.0),
+        "comm_bytes_saved_frac": (
+            (before["bytes_moved"] - after["bytes_moved"])
+            / before["bytes_moved"] if before["bytes_moved"] else 0.0),
+    }

@@ -1,0 +1,428 @@
+"""The comm-aware circuit scheduler (parallel/scheduler.py) and its executor
+hooks: commutation DAG soundness, scheduled-vs-unscheduled statevector
+equivalence (the oracle the ISSUE demands), the QFT comm-savings acceptance
+bar, bit-permutation kernels, reconcile cycle handling, the routed-executor
+property test, and the compile/optimize contracts it rides along with."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import quest_tpu as qt
+from quest_tpu.circuit import (Circuit, compile_circuit, qft_circuit,
+                               random_circuit)
+from quest_tpu.ops import apply as ap
+from quest_tpu.parallel import planner
+from quest_tpu.parallel import scheduler as sched
+from oracle import random_unitary
+
+
+def _rand_state(n: int, seed: int = 0) -> jax.Array:
+    rs = np.random.RandomState(seed)
+    st = rs.randn(2, 1 << n)
+    st /= np.sqrt((st ** 2).sum())
+    return jnp.asarray(st, jnp.float64)
+
+
+def _rich_circuit(n: int = 14, seed: int = 7) -> Circuit:
+    """Every scheduler-relevant structure at once: wide reroute gates
+    (shared and conflicting routings), diagonals/mrz sunk between them,
+    controls, repeated cross-shard dense gates, and a trailing swap
+    network."""
+    rs = np.random.RandomState(seed)
+    np.random.seed(seed)
+    c = Circuit(n)
+    c.multi_qubit_unitary((0, 8, 12), random_unitary(3))
+    c.h(2)
+    c.rz(n - 1, 0.31)
+    c.multi_qubit_unitary((1, 9, 13), random_unitary(3))
+    c.multi_qubit_unitary((0, 8, 12), random_unitary(3))
+    c.multi_rotate_z(tuple(range(n - 2)), 0.7)
+    c.x(3, (11,))
+    c.y(5)
+    for _ in range(3):
+        c.multi_qubit_unitary((n - 2, n - 1), random_unitary(2))
+    c.swap(0, n - 1)
+    c.swap(1, n - 2)
+    c.swap(2, n - 3)
+    c.swap(3, n - 4)
+    return c
+
+
+# ---------------------------------------------------------------------------
+# commutation DAG
+# ---------------------------------------------------------------------------
+
+def test_dag_diagonals_commute_through_controls():
+    c = Circuit(4)
+    c.h(0)                       # 0: dense on 0
+    c.phase_shift(1, 0.3, controls=(0,))  # 1: diagonal on 0 and 1
+    c.t(0)                       # 2: diagonal on 0
+    c.z(1, controls=(0,))        # 3: diagonal on 0, 1
+    c.h(0)                       # 4: dense on 0 again
+    dag = sched.commutation_dag(c.ops)
+    # diagonals depend only on the last dense op, not on each other
+    assert dag.preds[1] == {0}
+    assert dag.preds[2] == {0}
+    assert dag.preds[3] == {0}
+    # the closing dense op orders against every diagonal recorded since
+    assert dag.preds[4] == {0, 1, 2, 3}
+
+
+def test_dag_disjoint_wires_commute():
+    c = Circuit(4).h(0).h(1).cnot(2, 3)
+    dag = sched.commutation_dag(c.ops)
+    assert all(not p for p in dag.preds)
+
+
+def test_reorder_is_a_permutation_within_dag():
+    c = _rich_circuit()
+    out = sched.reorder_ops(c.ops, c.num_qubits, 8)
+    assert sorted(map(id, out)) == sorted(map(id, c.ops))
+
+
+# ---------------------------------------------------------------------------
+# the equivalence oracle (ISSUE acceptance): scheduled == unscheduled
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("devices", [2, 4, 8])
+def test_scheduled_random_circuits_equivalent(devices):
+    for seed in range(3):
+        c = random_circuit(10, depth=2, seed=seed)
+        st = _rand_state(10, seed)
+        want = np.asarray(compile_circuit(c)(st))
+        got = np.asarray(compile_circuit(c, num_devices=devices)(st))
+        np.testing.assert_allclose(got, want, atol=1e-12)
+
+
+@pytest.mark.parametrize("devices", [2, 4, 8])
+def test_scheduled_rich_circuit_equivalent(devices):
+    c = _rich_circuit()
+    st = _rand_state(c.num_qubits, devices)
+    want = np.asarray(compile_circuit(c)(st))
+    s = c.schedule(devices)
+    assert s is not c and c.ops == _rich_circuit().ops  # input unmodified
+    got = np.asarray(compile_circuit(s)(st))
+    np.testing.assert_allclose(got, want, atol=1e-12)
+
+
+def test_scheduled_qft_matches_unscheduled():
+    c = qft_circuit(13)
+    st = _rand_state(13, 3)
+    want = np.asarray(compile_circuit(c)(st))
+    got = np.asarray(compile_circuit(c.schedule(8))(st))
+    np.testing.assert_allclose(got, want, atol=1e-12)
+
+
+def test_bitperm_shadow_on_density_qureg(env_local):
+    """bitperm ops must shadow correctly on the Choi-flattened density
+    path: the column-side twin shifts the wires AND the dest payload by n
+    (circuit.py _shadow_op's bitperm branch)."""
+    from quest_tpu.circuit import GateOp
+    n = 6
+    c = Circuit(n).h(0).cnot(0, 2)
+    # content 0 -> 2 -> 5 -> 0, as one fused permutation op
+    c.ops.append(GateOp("bitperm", (0, 2, 5), (), (), (2.0, 5.0, 0.0), None))
+    ref = Circuit(n).h(0).cnot(0, 2).swap(0, 2).swap(0, 5)  # same cycle
+    rho = qt.createDensityQureg(n, env_local)
+    want = qt.createDensityQureg(n, env_local)
+    qt.apply_circuit(rho, c)
+    qt.apply_circuit(want, ref)
+    np.testing.assert_allclose(np.asarray(rho.amps), np.asarray(want.amps),
+                               atol=1e-11)
+
+
+def test_scheduled_swap_network_on_density_qureg(env_local):
+    """A scheduled circuit whose swap network fused into bitperm + staging
+    swaps must agree with the unscheduled circuit on a density register."""
+    n = 6
+    c = Circuit(n).h(0).cnot(0, n - 1)
+    for q in range(3):
+        c.swap(q, n - 1 - q)
+    s = c.schedule(4)
+    rho = qt.createDensityQureg(n, env_local)
+    ref = qt.createDensityQureg(n, env_local)
+    qt.apply_circuit(rho, s)
+    qt.apply_circuit(ref, c)
+    np.testing.assert_allclose(np.asarray(rho.amps), np.asarray(ref.amps),
+                               atol=1e-11)
+
+
+# ---------------------------------------------------------------------------
+# comm savings (ISSUE acceptance bar)
+# ---------------------------------------------------------------------------
+
+def test_qft22_schedule_saves_20pct_collectives():
+    """Acceptance: the scheduled 22q QFT over an 8-way mesh executes >= 20%
+    fewer swap/reshard collectives than unscheduled, asserted via the
+    comm_plan of the scheduled circuit."""
+    c = qft_circuit(22)
+    r = sched.schedule_savings(c, 8)
+    assert r["comm_events_after"] <= 0.8 * r["comm_events_before"], r
+    assert r["comm_bytes_after"] < r["comm_bytes_before"], r
+    assert r["reshard_events_after"] < r["reshard_events_before"], r
+
+
+def test_schedule_never_adds_comm_on_bench_workloads():
+    for c in (qft_circuit(16), random_circuit(16, depth=2, seed=1)):
+        for devices in (2, 8):
+            r = sched.schedule_savings(c, devices)
+            assert r["comm_events_after"] <= r["comm_events_before"], r
+            assert r["comm_bytes_after"] <= r["comm_bytes_before"], r
+
+
+def test_epoch_lowering_localises_repeated_cross_gates():
+    """>= 3 dense gates on the same sharded targets get bracketed between
+    two fused bitperms and run shard-local in between."""
+    np.random.seed(0)
+    n, devices = 14, 4  # local range [0, 12), prefix-local wires 10, 11
+    c = Circuit(n)
+    for _ in range(3):
+        c.multi_qubit_unitary((n - 2, n - 1), random_unitary(2))
+    s = c.schedule(devices)
+    kinds = [op.kind for op in s.ops]
+    assert kinds.count("bitperm") == 2, kinds
+    plans = planner.comm_plan(s, devices)
+    # the three dense gates are now comm-free; only the brackets communicate
+    assert sum(p.comm != "none" for p in plans) == 2, plans
+    st = _rand_state(n, 5)
+    np.testing.assert_allclose(np.asarray(compile_circuit(s)(st)),
+                               np.asarray(compile_circuit(c)(st)),
+                               atol=1e-12)
+
+
+@pytest.mark.parametrize("devices", [1, 2, 8])
+def test_overlapping_swap_run_fusion_equivalent(devices):
+    """Swap runs whose swaps SHARE wires compose into cycles (not just
+    transpositions); the fused lowering must realise the exact net
+    permutation."""
+    n = 13
+    c = Circuit(n).h(0)
+    c.swap(0, 12)
+    c.swap(12, 11)
+    c.swap(11, 1)
+    c.swap(2, 10)
+    st = _rand_state(n, devices)
+    want = np.asarray(compile_circuit(c)(st))
+    got = np.asarray(compile_circuit(c.schedule(devices))(st))
+    np.testing.assert_allclose(got, want, atol=1e-12)
+
+
+def test_comm_summary_totals():
+    c = qft_circuit(12)
+    s = planner.comm_summary(c, 4)
+    plans = planner.comm_plan(c, 4)
+    assert s["ops"] == len(plans)
+    assert s["comm_events"] == s["permute_events"] + s["reshard_events"]
+    assert s["bytes_moved"] == sum(p.bytes_moved for p in plans)
+
+
+# ---------------------------------------------------------------------------
+# placement search
+# ---------------------------------------------------------------------------
+
+def test_placement_identity_when_uniform():
+    """Uniformly hot wires (QFT: every qubit gets H + swap) must keep the
+    identity placement — boundary permutations would be pure cost."""
+    c = qft_circuit(14)
+    assert sched.greedy_placement(c, 8) == tuple(range(14))
+
+
+def test_placement_moves_hot_wire_off_the_sharded_range():
+    """A circuit hammering one sharded wire with dense gates relabels it
+    shard-local, and the placed circuit stays equivalent."""
+    np.random.seed(1)
+    n, devices = 13, 8  # sharded range: wires 10, 11, 12
+    c = Circuit(n)
+    for _ in range(12):
+        c.unitary(n - 1, random_unitary(1))
+    sigma = sched.greedy_placement(c, devices)
+    assert sigma[n - 1] < planner.local_qubit_count(n, devices)
+    s = c.schedule(devices)
+    r = sched.schedule_savings(c, devices, scheduled=s)
+    assert r["comm_events_after"] < r["comm_events_before"], r
+    st = _rand_state(n, 2)
+    np.testing.assert_allclose(np.asarray(compile_circuit(s)(st)),
+                               np.asarray(compile_circuit(c)(st)),
+                               atol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# bit-permutation kernel + reconcile cycles
+# ---------------------------------------------------------------------------
+
+def _apply_perm_oracle(st: np.ndarray, mapping: dict) -> np.ndarray:
+    """numpy oracle: content of bit position w moves to mapping[w]."""
+    n = int(st.shape[1]).bit_length() - 1
+    idx = np.arange(1 << n)
+    dst = np.zeros_like(idx)
+    for b in range(n):
+        dst |= ((idx >> b) & 1) << mapping.get(b, b)
+    out = np.zeros_like(st)
+    out[:, dst] = st
+    return out
+
+
+@pytest.mark.parametrize("mapping", [
+    {10: 11, 11: 10},                      # prefix transposition
+    {10: 11, 11: 12, 12: 10},              # prefix 3-cycle (transpose path)
+    {0: 11, 11: 0},                        # minor<->prefix (swap fallback)
+    {1: 3, 3: 8, 8: 11, 11: 1},            # mixed 4-cycle
+])
+def test_apply_bit_permutation_matches_oracle(mapping):
+    n = 13
+    st = np.asarray(_rand_state(n, sum(mapping)))
+    wires = tuple(sorted(mapping))
+    dests = tuple(mapping[w] for w in wires)
+    got = np.asarray(ap.apply_bit_permutation(jnp.asarray(st), wires, dests))
+    np.testing.assert_allclose(got, _apply_perm_oracle(st, mapping),
+                               atol=1e-15)
+
+
+@pytest.mark.parametrize("perm", [
+    (1, 0, 2, 3, 4, 5, 6, 7, 8, 9, 11, 10, 12),        # 2-cycles
+    (3, 1, 2, 0, 4, 5, 6, 7, 8, 9, 11, 12, 10),        # prefix 3-cycle
+    (12, 1, 2, 3, 4, 5, 6, 7, 8, 0, 11, 10, 9),        # mixed 3+ cycles
+])
+def test_reconcile_perm_restores_logical_order(perm):
+    """reconcile_perm on 3+ cycles (incl. the fused prefix-bitperm path):
+    applying the permutation then reconciling is the identity."""
+    n = len(perm)
+    st = _rand_state(n, len(perm))
+    # put logical bit q at physical position perm[q]
+    moved = ap.apply_bit_permutation(
+        st, tuple(range(n)), tuple(perm))
+    got = np.asarray(ap.reconcile_perm(moved, tuple(perm)))
+    np.testing.assert_allclose(got, np.asarray(st), atol=1e-15)
+
+
+# ---------------------------------------------------------------------------
+# routed-executor property test (ISSUE satellite): _run_ops_routed vs a
+# non-routed per-gate reference, including non-identity trailing perms
+# ---------------------------------------------------------------------------
+
+def _eager_reference(st: jax.Array, ops) -> jax.Array:
+    """Per-gate reference: every op through the eager engine (wide gates
+    pay their swap-in/swap-out per gate — no routing deferral)."""
+    from quest_tpu.circuit import _apply_one
+    for op in ops:
+        st = _apply_one(st, op)
+    return st
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_routed_executor_property(seed):
+    """Random circuits with wide minor-block gates: the deferred-routing
+    whole-program path must equal the per-gate reference to f64 tolerance.
+    The conflicting-routing gate pairs leave a non-identity perm with 3+
+    cycles at the end of the op chain, exercising reconcile_perm's cycle
+    handling."""
+    np.random.seed(seed)
+    rs = np.random.RandomState(seed)
+    n = 14
+    c = Circuit(n)
+    wide = [(0, 8, 10), (1, 9, 11), (2, 8, 12)]
+    for layer in range(3):
+        c.multi_qubit_unitary(wide[layer % len(wide)], random_unitary(3))
+        q = int(rs.randint(0, n))
+        c.unitary(q, random_unitary(1))
+        c.rz(int(rs.randint(0, n)), float(rs.randn()))
+        if layer % 2:
+            c.swap(int(rs.randint(0, n // 2)),
+                   int(n // 2 + rs.randint(0, n // 2)))
+    # end on a wide gate so the live perm is non-identity at reconcile time
+    c.multi_qubit_unitary(wide[seed % len(wide)], random_unitary(3))
+    st = _rand_state(n, 100 + seed)
+    got = np.asarray(compile_circuit(c)(st))
+    want = np.asarray(_eager_reference(st, c.key()))
+    np.testing.assert_allclose(got, want, atol=1e-12)
+
+
+def test_routed_perm_has_three_plus_cycle():
+    """The conflicting wide gates in the property test really do leave a
+    3+ cycle for reconcile_perm (not just transpositions)."""
+    n = 14
+    perm = tuple(range(n))
+    st = _rand_state(n, 0)
+    np.random.seed(0)
+    u = random_unitary(3)
+    for targets in ((0, 8, 10), (1, 9, 11), (2, 8, 12)):
+        st, perm = ap.apply_matrix_routed(
+            st, jnp.asarray(np.stack([u.real, u.imag])), targets, (), (),
+            perm)
+    mapping = {p: q for q, p in enumerate(perm) if p != q}
+    cycles = ap._perm_cycles(mapping)
+    assert any(len(cyc) >= 3 for cyc in cycles), (perm, cycles)
+
+
+# ---------------------------------------------------------------------------
+# ride-along contracts: donated-program cache, optimize() in-place fusion
+# ---------------------------------------------------------------------------
+
+def test_compile_donate_caches_program(monkeypatch):
+    """compile_circuit(donate=True) must not rebuild its jitted program per
+    call: two compiles of EQUAL circuits applied twice each trace once."""
+    import quest_tpu.circuit as circuit_mod
+
+    traces = {"n": 0}
+    real = circuit_mod._run_ops_routed
+
+    def counting(state, ops):
+        traces["n"] += 1
+        return real(state, ops)
+
+    monkeypatch.setattr(circuit_mod, "_run_ops_routed", counting)
+    # unique circuit so no earlier test pre-populated the donated cache
+    c1 = random_circuit(6, depth=2, seed=987_123)
+    c2 = random_circuit(6, depth=2, seed=987_123)
+    assert c1.key() == c2.key() and c1 is not c2
+    run1 = compile_circuit(c1, donate=True)
+    run2 = compile_circuit(c2, donate=True)
+
+    def fresh():
+        return jnp.zeros((2, 64), jnp.float64).at[0, 0].set(1.0)
+
+    np.testing.assert_allclose(
+        float(jnp.sum(np.asarray(run1(fresh())) ** 2)), 1.0, atol=1e-12)
+    run1(fresh())
+    run2(fresh())
+    run2(fresh())
+    assert traces["n"] == 1, f"donated program retraced {traces['n']} times"
+
+
+def test_optimize_returns_self_and_invalidates_shadow(env_local):
+    """optimize() mutates in place, returns self, and a density-matrix
+    apply_circuit after fusion uses the FUSED ops (shadow cache rebuilt)."""
+    n = 4
+    c = Circuit(n).h(0).rz(0, 0.4).ry(0, -0.2).x(1).cnot(1, 2)
+    rho = qt.createDensityQureg(n, env_local)
+    qt.apply_circuit(rho, c)          # primes the shadow cache (pre-fusion)
+    before_ops = list(c.ops)
+    ret = c.optimize()
+    assert ret is c                   # documented return-self contract
+    assert getattr(c, "_shadow_cache", "unset") is None
+    ref = qt.createDensityQureg(n, env_local)
+    for op in before_ops:
+        p = op.payload()
+        if op.kind == "matrix":
+            qt.multiQubitUnitary(ref, list(op.targets), len(op.targets),
+                                 p[0] + 1j * p[1])
+        elif op.kind == "diagonal":
+            qt.multiQubitUnitary(ref, list(op.targets), len(op.targets),
+                                 np.diag(p[0] + 1j * p[1]))
+        elif op.kind == "x":
+            if op.controls:
+                qt.controlledNot(ref, op.controls[0], op.targets[0])
+            else:
+                qt.pauliX(ref, op.targets[0])
+    rho2 = qt.createDensityQureg(n, env_local)
+    qt.apply_circuit(rho2, c)         # must rebuild the shadow from fused ops
+    assert c._shadow_cache is not None
+    assert c._shadow_cache[1] == c.key()
+    assert len(c._shadow_cache[2]) == 2 * len(c.ops)
+    np.testing.assert_allclose(np.asarray(rho2.amps), np.asarray(ref.amps),
+                               atol=1e-11)
