@@ -1,0 +1,37 @@
+"""Importable circuit factories for the examples — and for the analyzer.
+
+The example scripts build their circuits inline (and run them); this
+module exposes the same circuits as zero-argument factories so
+``python -m quest_tpu.analysis --circuit circuits:NAME`` can analyze,
+schedule and translation-validate them without executing a simulation —
+CI's ``--verify-schedule`` smoke runs every factory here.
+"""
+
+from __future__ import annotations
+
+from quest_tpu.circuit import Circuit, qft_circuit
+
+
+def distributed_qft() -> Circuit:
+    """The circuit of examples/distributed_qft.py: a 16-qubit QFT (fused by
+    the native engine when available), scheduled over the 8-device mesh by
+    the example itself."""
+    return qft_circuit(16).optimize()
+
+
+def bernstein_vazirani(num_qubits: int = 16, secret: int = 2 ** 4 + 1) -> Circuit:
+    """The circuit of examples/bernstein_vazirani_circuit.py as a recorded
+    Circuit: ancilla flip + one CNOT per secret bit.  The example script
+    runs 9 qubits; the factory defaults to 16 so the CI mesh smoke
+    analyzes a deployment-sized register (a 9-qubit state over 8 devices
+    is 64 amps per shard — smaller than one lane/sublane tile, a layout
+    regime the planner's wire-position comm model deliberately does not
+    cover and the lowered-program audit rightly flags)."""
+    c = Circuit(num_qubits)
+    c.x(0)
+    bits = secret
+    for qb in range(1, num_qubits):
+        bit, bits = bits % 2, bits // 2
+        if bit:
+            c.cnot(0, qb)
+    return c

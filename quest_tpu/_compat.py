@@ -10,6 +10,14 @@ from __future__ import annotations
 
 import jax
 
+# complex128 support requires x64 mode; enable it once, here.  float32
+# quregs are still first-class (dtype is per-Qureg), x64 only widens what
+# JAX *allows*, not what we allocate.  This module is the ONE allowlisted
+# site for import-time jax.config mutation — the purity lint
+# (analysis/purity.py P_IMPORT_TIME_STATE_MUTATION) flags it anywhere else
+# in the package, so the compatibility decision cannot quietly spread.
+jax.config.update("jax_enable_x64", True)
+
 try:  # jax >= 0.5
     from jax import shard_map as _shard_map
 except ImportError:  # pragma: no cover - 0.4.x

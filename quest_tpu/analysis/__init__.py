@@ -1,8 +1,8 @@
 """quest_tpu.analysis — static analysis for circuits and the codebase.
 
-Three cooperating passes, all pure host work (no device allocation, no
-compilation), mirroring the role QuEST_validation.c plays in the reference
-but *ahead* of run time:
+Five cooperating passes, all pure host work (no device allocation; the
+jaxpr audit optionally compiles but never executes), mirroring the role
+QuEST_validation.c plays in the reference but *ahead* of run time:
 
 1. :func:`analyze_circuit` — whole-circuit IR checks: wire bounds,
    payload unitarity, shard fit, memory footprint vs the target mesh
@@ -13,9 +13,17 @@ but *ahead* of run time:
    per-operand dtype contracts (the multiRotateZ f32-angle bug class).
 3. :func:`lint_paths` / :func:`lint_package` — AST purity lint over the
    source tree for jit-unsafe host-Python patterns.
+4. :func:`check_equivalence` / :func:`verify_schedule` — translation
+   validation of scheduler/optimizer rewrites (Pauli tableau, phase
+   polynomial, dense-window domains; ``V_*`` codes) without touching a
+   2^n state.
+5. :func:`audit_dispatch` / :func:`audit_schedule_pair` — lowered-jaxpr /
+   compiled-HLO collective and donation audit against the planner's comm
+   model.
 
-CLI: ``python -m quest_tpu.analysis --self-lint`` (the tier-1 CI gate),
-see ``python -m quest_tpu.analysis --help`` and docs/ANALYSIS.md.
+CLI: ``python -m quest_tpu.analysis --self-lint`` (the tier-1 CI gate) and
+``--verify-schedule`` (the scheduler translation-validation smoke), see
+``python -m quest_tpu.analysis --help`` and docs/ANALYSIS.md.
 """
 
 from .diagnostics import (AnalysisCode, Diagnostic, Severity,  # noqa: F401
@@ -23,9 +31,16 @@ from .diagnostics import (AnalysisCode, Diagnostic, Severity,  # noqa: F401
 from .circuit_ir import analyze_circuit  # noqa: F401
 from .abstract_eval import check_abstract_eval  # noqa: F401
 from .purity import lint_package, lint_paths, lint_source  # noqa: F401
+from .equivalence import check_equivalence, verify_schedule  # noqa: F401
+from .jaxpr_audit import (audit_dispatch, audit_schedule_pair,  # noqa: F401
+                          count_hlo_collectives, count_jaxpr_collectives,
+                          donation_aliased)
 
 __all__ = [
     "AnalysisCode", "Diagnostic", "Severity", "max_severity", "message_for",
     "analyze_circuit", "check_abstract_eval",
     "lint_source", "lint_paths", "lint_package",
+    "check_equivalence", "verify_schedule",
+    "audit_dispatch", "audit_schedule_pair", "count_jaxpr_collectives",
+    "count_hlo_collectives", "donation_aliased",
 ]

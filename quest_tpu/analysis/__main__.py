@@ -12,9 +12,20 @@ Modes (combinable; exit status is 1 iff any ERROR-severity diagnostic):
   before/after comm report; a scheduled circuit the model rates as MORE
   communication is an ERROR (A_SCHEDULE_COMM_REGRESSION) — the CI smoke
   gate that scheduling savings stay nonnegative.
+- ``--verify-schedule``: translation-validate each circuit's scheduled
+  rewrite (analysis/equivalence.py: Pauli tableau + phase polynomial +
+  dense windows; ``V_*`` codes) AND audit the lowered dispatch path
+  against the planner's comm model (analysis/jaxpr_audit.py: collective
+  counts, unexpected gathers, donation aliasing) — the CI scheduler-
+  correctness smoke.  Implies ``--schedule``'s scheduling step.
 
 Circuit modes run the IR pass and the eager/compiled abstract-eval pass
 against the deployment described by ``--devices/--precision/--chip``.
+
+``--json`` switches stdout to ONE machine-readable JSON document —
+``{"diagnostics": [...], "circuits": [...], "schedule": [...],
+"verify": [...], "summary": {...}}`` — so CI gates parse severities
+instead of grepping text.  Exit status is unchanged.
 """
 
 from __future__ import annotations
@@ -51,16 +62,16 @@ def _dtype(precision: int):
     return jnp.float32 if precision == 1 else jnp.float64
 
 
-def _schedule_report(label: str, circuit, args) -> list:
-    """Run the comm-aware scheduler, print the planner-predicted savings as
-    one JSON line, and return an ERROR diagnostic iff the scheduled circuit
-    models as MORE communication than the input (the CI smoke contract)."""
+def _schedule_report(label: str, circuit, args, scheduled, echo) -> tuple:
+    """Planner-predicted savings of ``scheduled`` vs ``circuit``; an ERROR
+    diagnostic iff the scheduled circuit models as MORE communication than
+    the input (the CI smoke contract)."""
     from ..parallel.scheduler import schedule_savings
     from .diagnostics import AnalysisCode, Severity, diag
     report = schedule_savings(circuit, args.devices, chip=_chip(args.chip),
-                              precision=args.precision)
-    print(f"{label}: schedule savings "
-          + json.dumps(report, default=float))
+                              precision=args.precision, scheduled=scheduled)
+    report["label"] = label
+    echo(f"{label}: schedule savings " + json.dumps(report, default=float))
     out = []
     if (report["comm_events_after"] > report["comm_events_before"]
             or report["comm_bytes_after"] > report["comm_bytes_before"]):
@@ -70,7 +81,32 @@ def _schedule_report(label: str, circuit, args) -> list:
                                 f"{report['comm_events_after']}, bytes "
                                 f"{report['comm_bytes_before']}->"
                                 f"{report['comm_bytes_after']}")))
-    return out
+    return report, out
+
+
+def _verify_report(label: str, circuit, args, scheduled, echo) -> tuple:
+    """Translation validation + lowered-program audit of one scheduled
+    rewrite (the --verify-schedule payload)."""
+    from .equivalence import check_equivalence
+    from .jaxpr_audit import audit_dispatch, audit_schedule_pair
+    found = check_equivalence(circuit, scheduled)
+    report = {
+        "label": label,
+        "devices": args.devices,
+        "ops_in": len(circuit.ops),
+        "ops_scheduled": len(scheduled.ops),
+        "equivalence_diagnostics": len(found),
+        "proven_equivalent": not found,
+    }
+    audit, d2 = audit_dispatch(scheduled, args.devices,
+                               dtype=_dtype(args.precision), label=label)
+    pair, d3 = audit_schedule_pair(circuit, scheduled, args.devices,
+                                   dtype=_dtype(args.precision), label=label)
+    report["dispatch_audit"] = audit
+    report["hlo_pair"] = {k: pair[k]
+                          for k in ("unscheduled_hlo", "scheduled_hlo")}
+    echo(f"{label}: verify-schedule " + json.dumps(report, default=float))
+    return report, found + d2 + d3
 
 
 def main(argv=None) -> int:
@@ -85,11 +121,16 @@ def main(argv=None) -> int:
                         help="analyze an N-qubit QFT circuit")
     parser.add_argument("--random", nargs=2, type=int, metavar=("N", "DEPTH"),
                         help="analyze an N-qubit depth-DEPTH random circuit")
-    parser.add_argument("--circuit", metavar="MODULE:ATTR",
-                        help="import and analyze a Circuit (or factory)")
+    parser.add_argument("--circuit", metavar="MODULE:ATTR", action="append",
+                        help="import and analyze a Circuit (or factory); "
+                             "repeatable")
     parser.add_argument("--schedule", action="store_true",
                         help="run the comm-aware scheduler on each circuit "
                              "and report predicted comm savings")
+    parser.add_argument("--verify-schedule", action="store_true",
+                        dest="verify_schedule",
+                        help="translation-validate each circuit's scheduled "
+                             "rewrite and audit the lowered dispatch path")
     parser.add_argument("--devices", type=int, default=1,
                         help="mesh size for the deployment model (default 1)")
     parser.add_argument("--precision", type=int, default=1, choices=(1, 2),
@@ -100,8 +141,16 @@ def main(argv=None) -> int:
     parser.add_argument("--strict", action="store_true",
                         help="fail on WARNING as well as ERROR")
     parser.add_argument("--json", action="store_true", dest="as_json",
-                        help="emit diagnostics as JSON lines")
+                        help="emit ONE machine-readable JSON document "
+                             "instead of text lines")
     args = parser.parse_args(argv)
+
+    doc: dict = {"circuits": [], "schedule": [], "verify": [],
+                 "diagnostics": [], "summary": {}}
+
+    def echo(line: str) -> None:
+        if not args.as_json:
+            print(line)
 
     diagnostics = []
     ran = False
@@ -120,8 +169,8 @@ def main(argv=None) -> int:
         from ..circuit import random_circuit
         n, depth = args.random
         circuits.append((f"random({n},{depth})", random_circuit(n, depth)))
-    if args.circuit:
-        circuits.append((args.circuit, _load_circuit(args.circuit)))
+    for spec in args.circuit or ():
+        circuits.append((spec, _load_circuit(spec)))
     for label, circuit in circuits:
         ran = True
         found = analyze_circuit(circuit, num_devices=args.devices,
@@ -129,28 +178,51 @@ def main(argv=None) -> int:
                                 chip=_chip(args.chip),
                                 hints=not args.no_hints)
         found += check_abstract_eval(circuit, dtype=_dtype(args.precision))
-        if args.schedule:
-            found += _schedule_report(label, circuit, args)
+        if args.schedule or args.verify_schedule:
+            scheduled = circuit.schedule(args.devices, chip=_chip(args.chip),
+                                         precision=args.precision)
+            report, extra = _schedule_report(label, circuit, args, scheduled,
+                                             echo)
+            doc["schedule"].append(report)
+            found += extra
+            if args.verify_schedule:
+                report, extra = _verify_report(label, circuit, args,
+                                               scheduled, echo)
+                doc["verify"].append(report)
+                found += extra
         diagnostics += found
-        print(f"{label}: {len(circuit.ops)} ops, "
-              f"{len(found)} finding(s)")
+        doc["circuits"].append({"label": label, "ops": len(circuit.ops),
+                                "findings": len(found)})
+        echo(f"{label}: {len(circuit.ops)} ops, {len(found)} finding(s)")
 
     if not ran:
         parser.print_usage()
         return 2
 
     fail_at = Severity.WARNING if args.strict else Severity.ERROR
+    if args.no_hints:
+        # drop hints everywhere at once so the JSON document stays
+        # internally consistent (diagnostics array == summary counts)
+        diagnostics = [d for d in diagnostics
+                       if d.severity != Severity.HINT]
     for d in diagnostics:
-        if args.no_hints and d.severity == Severity.HINT:
-            continue
-        if args.as_json:
-            print(json.dumps({"code": d.code, "severity": d.severity.name,
-                              "location": d.location, "message": d.message}))
-        else:
-            print(d.format())
+        doc["diagnostics"].append(
+            {"code": d.code, "severity": d.severity.name,
+             "location": d.location, "message": d.message})
+        echo(d.format())
     n_err = sum(d.severity >= fail_at for d in diagnostics)
-    print(f"{len(diagnostics)} diagnostic(s), {n_err} at/above "
-          f"{fail_at.name.lower()}")
+    doc["summary"] = {
+        "diagnostics": len(diagnostics),
+        "fail_at": fail_at.name,
+        "failing": n_err,
+        "counts": {s.name: sum(d.severity == s for d in diagnostics)
+                   for s in Severity},
+    }
+    echo(f"{len(diagnostics)} diagnostic(s), {n_err} at/above "
+         f"{fail_at.name.lower()}")
+    if args.as_json:
+        json.dump(doc, sys.stdout, indent=1, default=float)
+        print()
     return 1 if n_err else 0
 
 

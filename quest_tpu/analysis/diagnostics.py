@@ -7,7 +7,9 @@ runtime failure carries the exact ``ErrorCode`` the op would raise, so a CI
 log line maps 1:1 onto the exception a production run would have died with.
 Findings with no runtime twin (memory projections, eager/compiled drift,
 purity lint) use analysis-only code families: ``A_*`` for circuit/abstract
-analysis, ``H_*`` for optimization hints, ``P_*`` for source purity rules.
+analysis, ``H_*`` for optimization hints, ``P_*`` for source purity rules,
+and ``V_*`` for the scheduler translation validator
+(analysis/equivalence.py).
 """
 
 from __future__ import annotations
@@ -38,6 +40,13 @@ class AnalysisCode:
     EAGER_COMPILED_SHAPE_MISMATCH = "A_EAGER_COMPILED_SHAPE_MISMATCH"
     EAGER_COMPILED_SHARDING_MISMATCH = "A_EAGER_COMPILED_SHARDING_MISMATCH"
     OPERAND_DTYPE_DRIFT = "A_OPERAND_DTYPE_DRIFT"
+    # translation validation of scheduler/optimizer rewrites (equivalence.py)
+    SEMANTICS_CHANGED = "V_SEMANTICS_CHANGED"
+    UNVERIFIED_REGION = "V_UNVERIFIED_REGION"
+    # lowered-jaxpr / compiled-HLO audit (jaxpr_audit.py)
+    COLLECTIVE_COUNT_MISMATCH = "A_COLLECTIVE_COUNT_MISMATCH"
+    UNEXPECTED_ALLGATHER = "A_UNEXPECTED_ALLGATHER"
+    DONATION_UNUSED = "A_DONATION_UNUSED"
     # optimization hints
     ADJACENT_INVERSE_PAIR = "H_ADJACENT_INVERSE_PAIR"
     FUSABLE_1Q_RUN = "H_FUSABLE_1Q_RUN"
@@ -47,6 +56,7 @@ class AnalysisCode:
     NUMPY_ON_TRACED = "P_NUMPY_ON_TRACED"
     ANGLE_NOT_F64 = "P_ANGLE_NOT_F64"
     CALLBACK_IN_SHARD_MAP = "P_HOST_CALLBACK_IN_SHARD_MAP"
+    IMPORT_TIME_STATE_MUTATION = "P_IMPORT_TIME_STATE_MUTATION"
 
 
 ANALYSIS_MESSAGES = {
@@ -75,6 +85,29 @@ ANALYSIS_MESSAGES = {
         "The compiled path feeds this kernel an operand of a different dtype "
         "than the eager API contract; eager and compiled states would drift "
         "(the circuit.py multiRotateZ f32-angle bug class).",
+    AnalysisCode.SEMANTICS_CHANGED:
+        "The rewritten circuit provably implements a DIFFERENT unitary than "
+        "its input: a scheduler/optimizer correctness bug.  The abstract "
+        "domains (Pauli tableau / phase polynomial / dense window) found a "
+        "concrete disagreement witness.",
+    AnalysisCode.UNVERIFIED_REGION:
+        "The translation validator could not prove this rewritten region "
+        "equivalent: every abstract domain lost precision (non-Clifford, "
+        "non-diagonal, window too wide for the dense check).  Not a proven "
+        "bug — but this rewrite is running without a semantics proof.",
+    AnalysisCode.COLLECTIVE_COUNT_MISMATCH:
+        "The lowered program contains MORE collectives than the planner's "
+        "comm model predicts for this circuit: the comm model and XLA's "
+        "partitioner disagree, so scheduler decisions are being made "
+        "against a wrong cost model.",
+    AnalysisCode.UNEXPECTED_ALLGATHER:
+        "The lowered program gathers state-sized data although the planner "
+        "models the circuit as communication-free: a sharding annotation "
+        "has been lost and the state is round-tripping through a gather.",
+    AnalysisCode.DONATION_UNUSED:
+        "A donate=True program compiled WITHOUT an input/output buffer "
+        "alias: the donation is silently ignored and iteration pays a full "
+        "extra state allocation per step.",
     AnalysisCode.ADJACENT_INVERSE_PAIR:
         "Adjacent gates on identical wires compose to the identity and can "
         "be cancelled.",
@@ -101,6 +134,11 @@ ANALYSIS_MESSAGES = {
     AnalysisCode.CALLBACK_IN_SHARD_MAP:
         "Host callback inside a shard_map region: the callback runs "
         "per-shard on every device and serialises the collective schedule.",
+    AnalysisCode.IMPORT_TIME_STATE_MUTATION:
+        "Module-import-time mutation of jax.config or global RNG state: "
+        "import order silently changes numerics for every consumer of the "
+        "process.  Only quest_tpu/_compat.py may do this (the single "
+        "allowlisted site).",
 }
 
 

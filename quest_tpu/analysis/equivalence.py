@@ -1,0 +1,775 @@
+"""Pass 4: translation validation for scheduler/optimizer rewrites.
+
+PR 2 turned the planner into an optimizer: ``Circuit.schedule()`` reorders
+ops along a commutation DAG, fuses swap networks into ``bitperm``
+collectives and relabels wires through placement permutations — and its
+correctness was attested only by randomized statevector tests.  This pass
+proves ``schedule()``/``optimize()`` output equivalent to its input
+*without touching a 2^n state*, the same move QuEST_validation.c makes for
+inputs but applied to the compiler's own rewrites (classic translation
+validation: validate each emitted program, not the rewriter).
+
+The proof is compositional, over three abstract domains:
+
+1. **Permutation normalization** (:func:`_normalize_perms`).  ``swap`` and
+   ``bitperm`` ops are permutation matrices; both circuits are rewritten
+   into (relabeled core ops) x (one residual wire permutation), exactly.
+   Residual permutations must agree bit-for-bit — this discharges swap-
+   network fusion, placement relabeling and epoch brackets symbolically.
+
+2. **Trace matching** (:func:`_match_cores`).  Core ops are matched 1:1
+   across the two circuits under a *semantic* commutation oracle (disjoint
+   wires; diagonal-family pairs; shared-wires-are-controls; else a dense
+   commutator check on the <= ``max_window_qubits``-wire union).  Matched
+   pairs cancel by the Mazurkiewicz-trace argument: each matched op
+   commutes past every unmatched op before it, on both sides.
+
+3. **Residue windows.**  Whatever fails to match is split into wire-
+   connected components and each window is proven equivalent by the first
+   domain that keeps precision: the *phase-polynomial domain* for the
+   diagonal family (rz / phase_shift / multiRotateZ merge and commute,
+   chi-basis polynomial or pointwise product diagonal — exact), the
+   *Clifford/Pauli domain* (conjugating symbolic Pauli generators through
+   H/X/Y/Z/S/CNOT/CZ and any payload recognized as Clifford — exact up to
+   global phase, which one agreeing window-state probe then pins), and —
+   only where both lose precision — a dense-matrix check on the window
+   (product of the <= k-wire payloads, never the full state).  Windows too
+   wide even for that are probed with random window STATES (2^w vectors,
+   still never the full 2^n state): a probe disagreement is an exact
+   disproof witness; probe agreement alone stays unverified.
+
+A disproof emits ``V_SEMANTICS_CHANGED`` (ERROR) with a witness; a window
+no domain can decide emits ``V_UNVERIFIED_REGION`` (WARNING).  An empty
+diagnostic list is a *proof* of equivalence (up to the float tolerance of
+the dense/probe certificates).
+
+Entry points: :func:`check_equivalence`, :func:`verify_schedule`, the CLI
+``--verify-schedule`` mode, and ``QUEST_TPU_VALIDATE_SCHEDULE=1`` (which
+makes ``Circuit.schedule()`` self-validate).  See docs/ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterable, Sequence
+
+import numpy as np
+
+from .diagnostics import AnalysisCode, Diagnostic, Severity, diag
+
+__all__ = ["check_equivalence", "verify_schedule"]
+
+# dense windows: 2^10 x 2^10 complex is the largest matrix worth building
+_MAX_WINDOW_QUBITS = 10
+# diagonal windows compare as a 2^w product VECTOR — much wider is fine
+_MAX_DIAG_QUBITS = 20
+# random-vector window probes cost one 2^w VECTOR per side — wider still
+_MAX_PROBE_QUBITS = 22
+# commutator checks run inside the matcher's inner loop: keep them smaller
+_MAX_COMMUTE_QUBITS = 8
+_EPS = 1e-9
+
+_DIAG_FAMILY = ("diagonal", "mrz")
+
+
+# ---------------------------------------------------------------------------
+# dense gate algebra (numpy, oracle conventions: qubit j of an op's local
+# wire list (targets first, then controls) is bit j of the payload index)
+# ---------------------------------------------------------------------------
+
+def _op_base(op) -> np.ndarray:
+    """Complex matrix of ``op`` on its TARGET wires only (no controls)."""
+    if op.kind == "matrix":
+        p = op.payload()
+        return p[0] + 1j * p[1]
+    if op.kind == "diagonal":
+        p = op.payload()
+        return np.diag(p[0] + 1j * p[1])
+    if op.kind == "x":
+        return np.array([[0, 1], [1, 0]], dtype=complex)
+    if op.kind == "y":
+        return np.array([[0, -1j], [1j, 0]])
+    if op.kind == "y*":
+        return np.array([[0, 1j], [-1j, 0]])
+    if op.kind == "swap":
+        return np.array([[1, 0, 0, 0], [0, 0, 1, 0],
+                         [0, 1, 0, 0], [0, 0, 0, 1]], dtype=complex)
+    if op.kind == "mrz":
+        k = len(op.targets)
+        if k > _MAX_WINDOW_QUBITS:
+            raise _TooWide(k)
+        par = np.array([bin(b).count("1") & 1 for b in range(1 << k)])
+        return np.diag(np.exp(-0.5j * float(op.matrix[0]) * (1 - 2 * par)))
+    raise _TooWide(len(op.targets))  # unknown kinds: treat as opaque
+
+
+class _TooWide(Exception):
+    """An op/window too wide for the dense domain."""
+
+
+def _embed_unitary(w: int, base: np.ndarray, target_pos: Sequence[int],
+                   control_pos: Sequence[int] = (),
+                   control_states: Sequence[int] = ()) -> np.ndarray:
+    """Full 2^w x 2^w operator of a controlled gate whose targets sit at
+    window bit positions ``target_pos`` (oracle full_operator, local form)."""
+    if w > _MAX_WINDOW_QUBITS:
+        raise _TooWide(w)
+    states = list(control_states) or [1] * len(control_pos)
+    dim = 1 << w
+    k = len(target_pos)
+    out = np.zeros((dim, dim), dtype=complex)
+    for col in range(dim):
+        if not all(((col >> c) & 1) == s
+                   for c, s in zip(control_pos, states)):
+            out[col, col] = 1.0
+            continue
+        in_sub = 0
+        for j, t in enumerate(target_pos):
+            in_sub |= ((col >> t) & 1) << j
+        rest = col
+        for t in target_pos:
+            rest &= ~(1 << t)
+        for out_sub in range(1 << k):
+            row = rest
+            for j, t in enumerate(target_pos):
+                row |= ((out_sub >> j) & 1) << t
+            out[row, col] = base[out_sub, in_sub]
+    return out
+
+
+def _window_unitary(ops: Iterable, support: Sequence[int]) -> np.ndarray:
+    """Dense unitary of an op list on the window ``support`` (sorted wires;
+    window bit i is wire support[i]).  Raises :class:`_TooWide` beyond the
+    dense limit."""
+    pos = {w: i for i, w in enumerate(support)}
+    u = np.eye(1 << len(support), dtype=complex)
+    for op in ops:
+        g = _embed_unitary(len(support), _op_base(op),
+                           [pos[t] for t in op.targets],
+                           [pos[c] for c in op.controls], op.control_states)
+        u = g @ u
+    return u
+
+
+# ---------------------------------------------------------------------------
+# permutation normalization: swap/bitperm ops -> one residual content map
+# ---------------------------------------------------------------------------
+
+def _normalize_perms(ops: Sequence, n: int) -> tuple[list, tuple]:
+    """Rewrite ``ops`` as (core ops, residual permutation): every ``swap``/
+    ``bitperm`` is absorbed into a running content permutation and later ops
+    have their wires translated through it.  Exact: the circuit equals
+    ``P(residual) . core`` as operators.  Returns core as (orig_index, op)
+    pairs and the residual as the tuple ``pi`` with ``pi[origin] = final
+    position of the content that started on wire origin``."""
+    from ..circuit import GateOp
+    pi = list(range(n))    # pi[origin] = current position
+    inv = list(range(n))   # inv[position] = origin
+    core: list = []
+    for idx, op in enumerate(ops):
+        if op.kind == "swap":
+            a, b = int(op.targets[0]), int(op.targets[1])
+            oa, ob = inv[a], inv[b]
+            inv[a], inv[b] = ob, oa
+            pi[oa], pi[ob] = b, a
+            continue
+        if op.kind == "bitperm":
+            src = [int(t) for t in op.targets]
+            dst = [int(d) for d in op.matrix]
+            origins = [inv[s] for s in src]
+            for o, d in zip(origins, dst):
+                pi[o] = d
+                inv[d] = o
+            continue
+        t = tuple(inv[q] for q in op.targets)
+        c = tuple(inv[q] for q in op.controls)
+        if (t, c) != (op.targets, op.controls):
+            op = GateOp(op.kind, t, c, op.control_states, op.matrix, op.shape)
+        core.append((idx, op))
+    return core, tuple(pi)
+
+
+# ---------------------------------------------------------------------------
+# the semantic commutation oracle
+# ---------------------------------------------------------------------------
+
+def _wires(op) -> tuple:
+    return op.targets + op.controls
+
+
+def _overall_diagonal(op) -> bool:
+    """True iff the op's full matrix (controls included) is diagonal."""
+    return op.kind in _DIAG_FAMILY
+
+
+def _commutes(a, b, eps: float = _EPS) -> bool:
+    """Conservative semantic commutation: True only when provable.  Fast
+    exact rules first (disjoint wires; two diagonal matrices; one diagonal
+    whose shared wires are all the other's controls — block-diagonality);
+    then a dense commutator check on the wire union when it fits."""
+    wa, wb = set(_wires(a)), set(_wires(b))
+    shared = wa & wb
+    if not shared:
+        return True
+    if _overall_diagonal(a) and _overall_diagonal(b):
+        return True
+    if _overall_diagonal(a) and shared <= set(b.controls):
+        return True
+    if _overall_diagonal(b) and shared <= set(a.controls):
+        return True
+    union = sorted(wa | wb)
+    if len(union) > _MAX_COMMUTE_QUBITS:
+        return False
+    try:
+        ua = _window_unitary([a], union)
+        ub = _window_unitary([b], union)
+    except _TooWide:
+        return False
+    return bool(np.all(np.abs(ua @ ub - ub @ ua) < eps))
+
+
+def _op_identical(a, b, eps: float = _EPS) -> bool:
+    if (a.kind != b.kind or a.targets != b.targets or a.controls != b.controls
+            or a.control_states != b.control_states or a.shape != b.shape):
+        return False
+    if a.matrix is None or b.matrix is None:
+        return a.matrix == b.matrix
+    if a.matrix == b.matrix:
+        return True
+    ma, mb = np.asarray(a.matrix), np.asarray(b.matrix)
+    return ma.shape == mb.shape and bool(np.all(np.abs(ma - mb) < eps))
+
+
+def _match_cores(core_a: list, core_b: list) -> tuple[list, list]:
+    """Greedy trace matching of two perm-normalized op lists.  An op of A
+    may match an identical op of B only if BOTH commute past every still-
+    unmatched op before them in their own list — so matched pairs cancel
+    exactly and ``A == B  iff  residue_A == residue_B``.  Returns the two
+    residues as (orig_index, op) lists."""
+    matched = [False] * len(core_b)
+    residue_a: list = []
+    memo: dict = {}
+
+    def commutes(a, b) -> bool:
+        key = (id(a), id(b))  # content-determined, so id aliasing is safe
+        hit = memo.get(key)
+        if hit is None:
+            hit = memo[key] = _commutes(a, b)
+        return hit
+
+    for _ia, a in core_a:
+        found = None
+        for j, (_ib, b) in enumerate(core_b):
+            if matched[j] or not _op_identical(a, b):
+                continue
+            ok = all(commutes(bp, b)
+                     for jp, (_ibp, bp) in enumerate(core_b[:j])
+                     if not matched[jp])
+            if ok and all(commutes(ap, a) for _iap, ap in residue_a):
+                found = j
+            break  # identical later copies face the same blockers
+        if found is None:
+            residue_a.append((_ia, a))
+        else:
+            matched[found] = True
+    residue_b = [pair for j, pair in enumerate(core_b) if not matched[j]]
+    return residue_a, residue_b
+
+
+# ---------------------------------------------------------------------------
+# phase-polynomial domain (the diagonal family)
+# ---------------------------------------------------------------------------
+
+def _op_diag_entries(op) -> np.ndarray:
+    """Full diagonal of a diagonal-family op over its own wires (targets
+    LSB-first, then controls): entry 1 wherever the controls are
+    unsatisfied."""
+    if op.kind == "mrz":
+        k = len(op.targets)
+        if k > _MAX_DIAG_QUBITS:
+            raise _TooWide(k)
+        par = np.array([bin(b).count("1") & 1 for b in range(1 << k)])
+        return np.exp(-0.5j * float(op.matrix[0]) * (1 - 2 * par))
+    p = op.payload()
+    d = p[0] + 1j * p[1]
+    kt, kc = len(op.targets), len(op.controls)
+    if kt + kc > _MAX_DIAG_QUBITS:
+        raise _TooWide(kt + kc)
+    if not kc:
+        return d
+    states = list(op.control_states) or [1] * kc
+    out = np.ones(1 << (kt + kc), dtype=complex)
+    idx = np.arange(1 << (kt + kc))
+    ctrl_ok = np.ones(len(idx), dtype=bool)
+    for j, s in enumerate(states):
+        ctrl_ok &= ((idx >> (kt + j)) & 1) == s
+    out[ctrl_ok] = d[idx[ctrl_ok] & ((1 << kt) - 1)]
+    return out
+
+
+def _product_diagonal(ops: Iterable, support: Sequence[int]) -> np.ndarray:
+    """Pointwise product diagonal of a diagonal-family op list over the
+    window — a 2^w VECTOR, exact, no angle-branch ambiguity."""
+    w = len(support)
+    if w > _MAX_DIAG_QUBITS:
+        raise _TooWide(w)
+    pos = {q: i for i, q in enumerate(support)}
+    idx = np.arange(1 << w)
+    d = np.ones(1 << w, dtype=complex)
+    for op in ops:
+        entries = _op_diag_entries(op)
+        sub = np.zeros(len(idx), dtype=np.int64)
+        for j, q in enumerate(_wires(op)):
+            sub |= ((idx >> pos[q]) & 1) << j
+        d *= entries[sub]
+    return d
+
+
+def _chi_poly(ops: Iterable) -> dict | None:
+    """Phase polynomial of a diagonal-family op list in the chi basis:
+    ``phi(x) = sum_m c[m] * (-1)^popcount(x & m)`` with ``m`` a wire mask.
+    ``mrz`` contributes one term analytically at ANY width (the whole point
+    of this domain: multiRotateZ merges verify symbolically where the
+    2^k product vector would not fit); small ``diagonal`` payloads are
+    Walsh-decomposed from their principal-branch angles.  None when some op
+    has no exact chi form (non-unit entries, too wide)."""
+    poly: dict = {}
+
+    def add(mask: int, coeff: float) -> None:
+        c = poly.get(mask, 0.0) + coeff
+        if abs(c) < 1e-15:
+            poly.pop(mask, None)
+        else:
+            poly[mask] = c
+
+    for op in ops:
+        if op.kind == "mrz":
+            mask = 0
+            for t in op.targets:
+                mask |= 1 << t
+            add(mask, -0.5 * float(op.matrix[0]))
+            continue
+        wires = _wires(op)
+        if op.kind != "diagonal" or len(wires) > 8:
+            return None
+        entries = _op_diag_entries(op)
+        if np.any(np.abs(np.abs(entries) - 1.0) > 1e-9):
+            return None  # not a pure phase: leave to the dense domains
+        theta = np.angle(entries)
+        k = len(wires)
+        sub = np.arange(1 << k)
+        for m_local in range(1 << k):
+            signs = 1 - 2 * (np.array(
+                [bin(s & m_local).count("1") & 1 for s in sub]))
+            c = float(np.dot(theta, signs)) / (1 << k)
+            if abs(c) < 1e-15:
+                continue
+            mask = 0
+            for j, q in enumerate(wires):
+                if (m_local >> j) & 1:
+                    mask |= 1 << q
+            add(mask, c)
+    return poly
+
+
+def _poly_diff_verdict(pa: dict, pb: dict, eps: float) -> tuple[str, str]:
+    """('equal'|'changed'|'unknown', detail) for two chi polynomials."""
+    diff: dict = dict(pa)
+    for m, c in pb.items():
+        diff[m] = diff.get(m, 0.0) - c
+    diff = {m: c for m, c in diff.items()
+            if (abs(math.remainder(c, 2 * math.pi)) > eps if m == 0
+                else abs(c) > eps)}
+    if not diff:
+        return "equal", ""
+    # the difference only depends on wires appearing in its masks: evaluate
+    # it pointwise there (mod 2pi) when that restriction is narrow enough
+    wires = sorted({q for m in diff for q in range(m.bit_length())
+                    if (m >> q) & 1})
+    if len(wires) <= _MAX_DIAG_QUBITS:
+        pos = {q: i for i, q in enumerate(wires)}
+        vals = np.zeros(1 << len(wires))
+        for m, c in diff.items():
+            lm = 0
+            for q in pos:
+                if (m >> q) & 1:
+                    lm |= 1 << pos[q]
+            par = np.array([bin(x & lm).count("1") & 1
+                            for x in range(len(vals))])
+            vals += c * (1 - 2 * par)
+        off = np.abs(np.remainder(vals + math.pi, 2 * math.pi) - math.pi)
+        if np.all(off < 1e-7):
+            return "equal", ""
+        x = int(np.argmax(off))
+        return "changed", (f"phase polynomials differ by "
+                           f"{vals[x]:+.6g} rad at basis assignment {x:#x} "
+                           f"over wires {tuple(wires)}")
+    return "unknown", (f"phase-polynomial residual over {len(wires)} wires "
+                       "is too wide to evaluate pointwise")
+
+
+# ---------------------------------------------------------------------------
+# Clifford / Pauli domain
+# ---------------------------------------------------------------------------
+# A Pauli is (x_mask, z_mask, ph) meaning i^ph * prod_q X_q^x Z_q^z (X left
+# of Z per wire).  Conjugation tables are derived NUMERICALLY from each
+# op's dense payload on its own <=3 wires — no hand-written phase rules to
+# get wrong, and any payload that happens to be Clifford (H, S, CZ, CNOT,
+# controlled-X, Haar accidents) is recognized automatically.
+
+def _pmul(a: tuple, b: tuple) -> tuple:
+    ax, az, ap = a
+    bx, bz, bp = b
+    ph = (ap + bp + 2 * bin(az & bx).count("1")) & 3
+    return (ax ^ bx, az ^ bz, ph)
+
+
+def _pauli_matrix(k: int, x: int, z: int) -> np.ndarray:
+    singles = {
+        (0, 0): np.eye(2, dtype=complex),
+        (1, 0): np.array([[0, 1], [1, 0]], dtype=complex),
+        (0, 1): np.array([[1, 0], [0, -1]], dtype=complex),
+        (1, 1): np.array([[0, -1], [1, 0]], dtype=complex),  # XZ
+    }
+    m = np.eye(1, dtype=complex)
+    for j in range(k - 1, -1, -1):  # bit j of the index <-> wire j (LSB)
+        m = np.kron(m, singles[((x >> j) & 1, (z >> j) & 1)])
+    return m
+
+
+_clifford_cache: dict = {}
+
+
+def _clifford_action(op) -> dict | None:
+    """Images of the single-wire generators X_j / Z_j under conjugation by
+    ``op`` (local wire order: targets then controls), or None when the op
+    is not Clifford or too wide to decide."""
+    key = (op.kind, len(op.targets), len(op.controls), op.control_states,
+           op.matrix)
+    if key in _clifford_cache:
+        return _clifford_cache[key]
+    k = len(op.targets) + len(op.controls)
+    action: dict | None = {}
+    if k > 3:
+        action = None
+    else:
+        try:
+            u = _embed_unitary(k, _op_base(op), range(len(op.targets)),
+                               range(len(op.targets), k), op.control_states)
+        except _TooWide:
+            u = None
+        if u is None:
+            action = None
+        else:
+            for j in range(k):
+                for name, (gx, gz) in (("X", (1 << j, 0)),
+                                       ("Z", (0, 1 << j))):
+                    m = u @ _pauli_matrix(k, gx, gz) @ u.conj().T
+                    img = _decompose_pauli(k, m)
+                    if img is None:
+                        action = None
+                        break
+                    action[(j, name)] = img
+                if action is None:
+                    break
+    _clifford_cache[key] = action
+    return action
+
+
+def _decompose_pauli(k: int, m: np.ndarray) -> tuple | None:
+    """(x, z, ph) with m == i^ph X^x Z^z, or None if m is not a phased
+    Pauli string."""
+    dim = 1 << k
+    for x in range(dim):
+        for z in range(dim):
+            c = np.trace(_pauli_matrix(k, x, z).conj().T @ m) / dim
+            if abs(abs(c) - 1.0) < 1e-7:
+                ph = int(round(np.angle(c) / (math.pi / 2))) & 3
+                if abs(c - 1j ** ph) < 1e-7:
+                    return (x, z, ph)
+                return None
+    return None
+
+
+def _conjugate(p: tuple, op, pos: dict) -> tuple | None:
+    """Image of window Pauli ``p`` under conjugation by ``op`` (window wire
+    positions via ``pos``), or None when the op is not Clifford."""
+    x, z, ph = p
+    wires = _wires(op)
+    local = [(j, pos[q]) for j, q in enumerate(wires)]
+    if not any(((x >> wp) | (z >> wp)) & 1 for _, wp in local):
+        return p
+    action = _clifford_action(op)
+    if action is None:
+        return None
+    img = (0, 0, 0)
+    rest_x, rest_z = x, z
+    for j, wp in local:
+        xb, zb = (x >> wp) & 1, (z >> wp) & 1
+        rest_x &= ~(1 << wp)
+        rest_z &= ~(1 << wp)
+        if xb:
+            img = _pmul(img, _shift(action[(j, "X")], local))
+        if zb:
+            img = _pmul(img, _shift(action[(j, "Z")], local))
+    return _pmul((rest_x, rest_z, ph), img)
+
+
+def _shift(p_local: tuple, local: list) -> tuple:
+    """Map an op-local Pauli onto window bit positions."""
+    lx, lz, ph = p_local
+    x = z = 0
+    for j, wp in local:
+        x |= ((lx >> j) & 1) << wp
+        z |= ((lz >> j) & 1) << wp
+    return (x, z, ph)
+
+
+def _pauli_equiv(ops_a: list, ops_b: list,
+                 support: Sequence[int]) -> bool | None:
+    """Conjugate every generator X_i / Z_i of the window through both op
+    lists; equal images on all generators prove the window unitaries equal
+    up to one global phase.  None when some op is not Clifford."""
+    pos = {q: i for i, q in enumerate(support)}
+    for i in range(len(support)):
+        for gen in ((1 << i, 0, 0), (0, 1 << i, 0)):
+            pa: tuple | None = gen
+            for op in ops_a:
+                pa = _conjugate(pa, op, pos)
+                if pa is None:
+                    return None
+            pb: tuple | None = gen
+            for op in ops_b:
+                pb = _conjugate(pb, op, pos)
+                if pb is None:
+                    return None
+            if pa != pb:
+                return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# random-vector window probes: sound REFUTATION for windows too wide for a
+# dense matrix — one 2^w vector per side, never a 2^w x 2^w matrix (and
+# never the full 2^n state: windows are residue components only).  A probe
+# disagreement is an exact witness that the window unitaries differ; probe
+# agreement alone proves nothing, but combined with matching Pauli
+# tableaux it pins the one remaining global-phase degree of freedom.
+# ---------------------------------------------------------------------------
+
+def _apply_op_vec(vec: np.ndarray, op, pos: dict, w: int) -> np.ndarray:
+    """Apply one op to a 2^w window vector (window bit p = wire with
+    pos[wire] = p), diagonal kinds as vectorized entry multiplies, dense
+    kinds as a k-wire tensor contraction."""
+    wires = _wires(op)
+    if _overall_diagonal(op):
+        idx = np.arange(1 << w)
+        if op.kind == "mrz":
+            mask = 0
+            for t in op.targets:
+                mask |= 1 << pos[t]
+            par = np.zeros(1 << w, dtype=np.int64)
+            m = mask
+            while m:
+                bpos = (m & -m).bit_length() - 1
+                par ^= (idx >> bpos) & 1
+                m &= m - 1
+            return vec * np.exp(-0.5j * float(op.matrix[0]) * (1 - 2 * par))
+        entries = _op_diag_entries(op)
+        sub = np.zeros(1 << w, dtype=np.int64)
+        for j, q in enumerate(wires):
+            sub |= ((idx >> pos[q]) & 1) << j
+        return vec * entries[sub]
+    k = len(wires)
+    g = _embed_unitary(k, _op_base(op), range(len(op.targets)),
+                       range(len(op.targets), k), op.control_states)
+    t = vec.reshape([2] * w)
+    src = [w - 1 - pos[q] for q in wires]     # axis of op wire j
+    dst = [k - 1 - j for j in range(k)]       # wire j -> bit j of the rows
+    t = np.moveaxis(t, src, dst)
+    t = (g @ t.reshape(1 << k, -1)).reshape([2] * w)
+    return np.moveaxis(t, dst, src).reshape(-1)
+
+
+def _probe_window(ops_a: list, ops_b: list, support: Sequence[int],
+                  probes: int = 2) -> tuple[bool, float] | None:
+    """Apply both op lists to shared random window states; returns
+    (all probes agree, max |delta|), or None when the window is too wide
+    even for vectors."""
+    w = len(support)
+    if w > _MAX_PROBE_QUBITS:
+        return None
+    pos = {q: i for i, q in enumerate(support)}
+    rng = np.random.RandomState(1234 + w)
+    worst = 0.0
+    for _ in range(probes):
+        v = rng.randn(1 << w) + 1j * rng.randn(1 << w)
+        v /= np.linalg.norm(v)
+        va, vb = v, v
+        try:
+            for op in ops_a:
+                va = _apply_op_vec(va, op, pos, w)
+            for op in ops_b:
+                vb = _apply_op_vec(vb, op, pos, w)
+        except _TooWide:
+            return None
+        worst = max(worst, float(np.max(np.abs(va - vb))) if w else 0.0)
+    return worst < 1e-8, worst
+
+
+# ---------------------------------------------------------------------------
+# residue windows
+# ---------------------------------------------------------------------------
+
+def _components(residue_a: list, residue_b: list) -> list:
+    """Split both residues into wire-connected components (ops in different
+    components commute exactly, so each window verifies independently)."""
+    parent: dict = {}
+
+    def find(w: int) -> int:
+        while parent.setdefault(w, w) != w:
+            parent[w] = parent[parent[w]]
+            w = parent[w]
+        return w
+
+    for _, op in residue_a + residue_b:
+        ws = _wires(op)
+        for q in ws[1:]:
+            parent[find(ws[0])] = find(q)
+    comps: dict = {}
+    for side, residue in (("a", residue_a), ("b", residue_b)):
+        for idx, op in residue:
+            root = find(_wires(op)[0])
+            comps.setdefault(root, {"a": [], "b": []})[side].append((idx, op))
+    return list(comps.values())
+
+
+def _verify_window(ops_a: list, ops_b: list, eps: float) -> list[Diagnostic]:
+    """Prove one residue window equivalent, trying the domains in precision
+    order: phase polynomial (diagonal family, exact at any width), dense
+    window (exact, <= _MAX_WINDOW_QUBITS wires), Pauli tableau (exact up to
+    global phase, any width)."""
+    support = sorted({q for _, op in ops_a + ops_b for q in _wires(op)})
+    where = (f"ops {[i for i, _ in ops_a]} (input) vs "
+             f"{[i for i, _ in ops_b]} (rewrite) on wires {tuple(support)}")
+    first = ops_a[0][0] if ops_a else (ops_b[0][0] if ops_b else None)
+    la, lb = [op for _, op in ops_a], [op for _, op in ops_b]
+
+    if all(_overall_diagonal(op) for op in la + lb):
+        pa, pb = _chi_poly(la), _chi_poly(lb)
+        if pa is not None and pb is not None:
+            verdict, detail = _poly_diff_verdict(pa, pb, eps)
+            if verdict == "equal":
+                return []
+            if verdict == "changed":
+                return [diag(AnalysisCode.SEMANTICS_CHANGED, Severity.ERROR,
+                             op_index=first, detail=f"{where}: {detail}")]
+        try:
+            da = _product_diagonal(la, support)
+            db = _product_diagonal(lb, support)
+        except _TooWide:
+            pass
+        else:
+            err = float(np.max(np.abs(da - db))) if len(da) else 0.0
+            if err < eps:
+                return []
+            x = int(np.argmax(np.abs(da - db)))
+            return [diag(AnalysisCode.SEMANTICS_CHANGED, Severity.ERROR,
+                         op_index=first,
+                         detail=(f"{where}: product diagonals differ by "
+                                 f"{err:.3g} at window index {x:#x}"))]
+        probe = _probe_window(la, lb, support)
+        if probe is not None and not probe[0]:
+            return [diag(AnalysisCode.SEMANTICS_CHANGED, Severity.ERROR,
+                         op_index=first,
+                         detail=(f"{where}: random window-state probes "
+                                 f"differ (max |delta| = {probe[1]:.3g})"))]
+        return [diag(AnalysisCode.UNVERIFIED_REGION, Severity.WARNING,
+                     op_index=first,
+                     detail=f"{where}: diagonal window too wide for both "
+                            "the chi polynomial and the product vector")]
+
+    if len(support) <= _MAX_WINDOW_QUBITS:
+        try:
+            ua = _window_unitary(la, support)
+            ub = _window_unitary(lb, support)
+        except _TooWide:
+            pass
+        else:
+            err = float(np.max(np.abs(ua - ub)))
+            if err < max(eps, 1e-10 * ua.shape[0]):
+                return []
+            return [diag(AnalysisCode.SEMANTICS_CHANGED, Severity.ERROR,
+                         op_index=first,
+                         detail=(f"{where}: dense window unitaries differ "
+                                 f"(max |delta| = {err:.3g})"))]
+
+    verdict = _pauli_equiv(la, lb, support)
+    if verdict is False:
+        return [diag(AnalysisCode.SEMANTICS_CHANGED, Severity.ERROR,
+                     op_index=first,
+                     detail=f"{where}: Pauli generator images differ")]
+    probe = _probe_window(la, lb, support)
+    if probe is not None and not probe[0]:
+        return [diag(AnalysisCode.SEMANTICS_CHANGED, Severity.ERROR,
+                     op_index=first,
+                     detail=(f"{where}: random window-state probes differ "
+                             f"(max |delta| = {probe[1]:.3g})"))]
+    if verdict is True and probe is not None and probe[0]:
+        # tableau equality leaves exactly one global-phase degree of
+        # freedom; one agreeing nonzero probe vector pins it to 1: proven
+        return []
+    if verdict is True:
+        return [diag(AnalysisCode.UNVERIFIED_REGION, Severity.WARNING,
+                     op_index=first,
+                     detail=(f"{where}: Clifford tableaux agree (equal up "
+                             "to global phase) but the window is too wide "
+                             "for the phase certificate"))]
+    return [diag(AnalysisCode.UNVERIFIED_REGION, Severity.WARNING,
+                 op_index=first,
+                 detail=(f"{where}: window exceeds the dense limit"
+                         + ("; random window-state probes agree"
+                            if probe is not None else "")))]
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def check_equivalence(before, after, *, eps: float = _EPS) -> list[Diagnostic]:
+    """Translation-validate ``after`` against ``before`` (two
+    :class:`quest_tpu.Circuit`\\ s).  Pure host work, never a 2^n state.
+    Returns [] iff the circuits are PROVEN to implement the same unitary;
+    ``V_SEMANTICS_CHANGED`` (ERROR) diagnostics carry a disagreement
+    witness, ``V_UNVERIFIED_REGION`` (WARNING) marks rewrites no abstract
+    domain could decide."""
+    if before.num_qubits != after.num_qubits:
+        return [diag(AnalysisCode.SEMANTICS_CHANGED, Severity.ERROR,
+                     detail=(f"qubit counts differ: {before.num_qubits} vs "
+                             f"{after.num_qubits}"))]
+    core_a, perm_a = _normalize_perms(before.ops, before.num_qubits)
+    core_b, perm_b = _normalize_perms(after.ops, after.num_qubits)
+    if perm_a != perm_b:
+        moved = [q for q in range(len(perm_a)) if perm_a[q] != perm_b[q]]
+        return [diag(AnalysisCode.SEMANTICS_CHANGED, Severity.ERROR,
+                     detail=(f"residual wire permutations differ on wires "
+                             f"{tuple(moved)}: input "
+                             f"{tuple(perm_a[q] for q in moved)} vs rewrite "
+                             f"{tuple(perm_b[q] for q in moved)}"))]
+    residue_a, residue_b = _match_cores(core_a, core_b)
+    out: list[Diagnostic] = []
+    for comp in _components(residue_a, residue_b):
+        out.extend(_verify_window(comp["a"], comp["b"], eps))
+    return out
+
+
+def verify_schedule(circuit, scheduled=None, num_devices: int | None = None,
+                    **schedule_kwargs) -> list[Diagnostic]:
+    """Schedule ``circuit`` (unless ``scheduled`` is given) and translation-
+    validate the result.  The programmatic form of the CLI's
+    ``--verify-schedule`` and of ``QUEST_TPU_VALIDATE_SCHEDULE=1``."""
+    if scheduled is None:
+        if num_devices is None:
+            raise ValueError("verify_schedule needs scheduled= or num_devices=")
+        scheduled = circuit.schedule(num_devices, **schedule_kwargs)
+    return check_equivalence(circuit, scheduled)

@@ -1,0 +1,302 @@
+"""Pass 5: lowered-program audit of the compiled dispatch path.
+
+The planner (parallel/planner.py) predicts, per gate, what GSPMD will do
+on an amplitude mesh — and the scheduler (parallel/scheduler.py) now makes
+REWRITE decisions against that model.  Nothing so far checked the model
+against what XLA actually lowers: a partitioner regression (or a planner
+bug) would silently mis-cost every scheduling decision.  This pass closes
+the loop statically:
+
+1. :func:`count_jaxpr_collectives` traces the dispatch path with
+   ``jax.make_jaxpr`` (abstract — no device work) and walks every eqn,
+   recursing through pjit/scan/cond/shard_map sub-jaxprs, counting the
+   explicit collective primitives (``ppermute`` / ``psum`` /
+   ``all_gather`` / ``all_to_all`` ...).  The GSPMD gate path must contain
+   NONE (its collectives are partitioner-inserted); the shard_map kernels
+   (parallel/collectives.py) show exactly their documented ones.
+
+2. :func:`audit_dispatch` additionally lowers and compiles the program
+   against a real ``num_devices`` mesh (when that many devices exist) and
+   counts the state-sized collectives in the compiled HLO — tiny scalar
+   reductions are latency noise, so ops moving less than half a shard row
+   are ignored, the same threshold tests/test_distributed_lowering.py
+   gates on.  The count is cross-checked against
+   ``planner.comm_summary``'s prediction.  One *logical* exchange event of
+   the model legitimately lowers to a handful of HLO collectives (GSPMD
+   spells a pairwise exchange as all-gather + all-reduce partial-sum
+   pairs, per SoA plane), so the gate is a factor bound: more than
+   ``_HLO_OPS_PER_EVENT`` HLO collectives per predicted event is
+   ``A_COLLECTIVE_COUNT_MISMATCH`` (the comm model undercosts this
+   circuit); ANY state-sized collective on a circuit the planner models as
+   comm-FREE is ``A_UNEXPECTED_ALLGATHER`` (a lost sharding annotation —
+   the full-state round-trip failure mode).  :func:`audit_schedule_pair`
+   runs the sharper scheduler-level check: the SCHEDULED program must not
+   compile to more state-sized collectives than the unscheduled one — the
+   HLO-level twin of the planner-level ``A_SCHEDULE_COMM_REGRESSION``
+   gate, over exactly the pair bench.py measures.
+
+3. The same compiled artifact is audited for donation:
+   ``donate=True`` programs must compile with an ``input_output_alias``
+   entry, else the donation is silently ignored and every iteration pays a
+   full extra state allocation (``A_DONATION_UNUSED``).
+
+CLI: part of ``--verify-schedule`` (docs/ANALYSIS.md); the CI smoke runs
+it on the scheduled 22q QFT over the 8-virtual-device mesh — the same
+pair bench.py measures.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import numpy as np
+
+from .diagnostics import AnalysisCode, Diagnostic, Severity, diag
+
+__all__ = ["count_jaxpr_collectives", "count_hlo_collectives",
+           "donation_aliased", "audit_dispatch", "audit_schedule_pair"]
+
+# how many HLO collectives one planner comm event may legitimately lower
+# to: a pairwise exchange spells as an (all-gather, all-reduce) partial-sum
+# pair per SoA plane plus a layout permute — measured on the scheduled
+# QFT pairs, the partitioner stays well under this
+_HLO_OPS_PER_EVENT = 6
+
+# explicit jaxpr-level collective primitives (shard_map / manual kernels)
+JAXPR_COLLECTIVES = ("ppermute", "pbroadcast", "psum", "psum2", "pmax",
+                     "pmin", "all_gather", "all_to_all", "pgather",
+                     "psum_scatter", "reduce_scatter")
+
+# partitioner-inserted HLO collectives (bench.py counts the same set)
+HLO_COLLECTIVES = ("collective-permute", "all-gather", "all-to-all",
+                   "all-reduce", "reduce-scatter")
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking
+# ---------------------------------------------------------------------------
+
+def _sub_jaxprs(value: Any):
+    """Yield every jaxpr reachable from one eqn param value (ClosedJaxpr,
+    raw Jaxpr, or containers of either — covers pjit/cond/scan/shard_map)."""
+    try:
+        from jax._src import core as _core
+    except ImportError:  # pragma: no cover - jax moved the module
+        from jax import core as _core  # type: ignore[no-redef]
+    if isinstance(value, _core.Jaxpr):
+        yield value
+    elif hasattr(value, "jaxpr") and isinstance(getattr(value, "jaxpr", None),
+                                                _core.Jaxpr):
+        yield value.jaxpr
+    elif isinstance(value, (list, tuple)):
+        for item in value:
+            yield from _sub_jaxprs(item)
+
+
+def count_jaxpr_collectives(jaxpr) -> dict:
+    """Histogram of explicit collective primitives in a (Closed)Jaxpr,
+    recursing through every sub-jaxpr.  Accepts the return value of
+    ``jax.make_jaxpr(f)(*args)``."""
+    counts: dict = {}
+
+    def walk(jx) -> None:
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            if name in JAXPR_COLLECTIVES:
+                counts[name] = counts.get(name, 0) + 1
+            for value in eqn.params.values():
+                for sub in _sub_jaxprs(value):
+                    walk(sub)
+
+    walk(jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr)
+    return counts
+
+
+def make_dispatch_jaxpr(circuit, dtype=None):
+    """Abstract trace of the compiled dispatch path for ``circuit`` — the
+    exact program ``compile_circuit`` runs, traced via ShapeDtypeStruct
+    (no device allocation)."""
+    import jax
+    import jax.numpy as jnp
+    from ..circuit import _run_ops_routed
+    ops = circuit.key()
+    spec = jax.ShapeDtypeStruct((2, 1 << circuit.num_qubits),
+                                dtype or jnp.float32)
+    return jax.make_jaxpr(lambda s: _run_ops_routed(s, ops))(spec)
+
+
+# ---------------------------------------------------------------------------
+# compiled-HLO collective counting (size-filtered)
+# ---------------------------------------------------------------------------
+
+_SHAPE_RE = re.compile(r"\w\d*\[([0-9,]+)\]")
+
+
+def count_hlo_collectives(compiled_text: str, min_elems: int = 0) -> dict:
+    """Histogram of HLO collectives moving >= ``min_elems`` elements.
+    Size-filtering drops factor-side scalar reductions (f64[2] psums) that
+    are latency, not data motion — the planner models data motion."""
+    counts: dict = {}
+    for line in compiled_text.splitlines():
+        for op in HLO_COLLECTIVES:
+            if f"{op}(" not in line and f"{op}-start(" not in line:
+                continue
+            sizes = [int(np.prod([int(d) for d in dims.split(",")]))
+                     for dims in _SHAPE_RE.findall(line)]
+            if not min_elems or (sizes and max(sizes) >= min_elems):
+                counts[op] = counts.get(op, 0) + 1
+            break
+    return counts
+
+
+def donation_aliased(compiled_text: str) -> bool:
+    """True iff the compiled module aliases an input buffer to the output
+    (the executable form a ``donate_argnums`` promise must take)."""
+    return "input_output_alias" in compiled_text
+
+
+# ---------------------------------------------------------------------------
+# the audit
+# ---------------------------------------------------------------------------
+
+def audit_dispatch(circuit, num_devices: int = 1, *, dtype=None,
+                   donate: bool = True,
+                   label: str = "circuit") -> tuple[dict, list[Diagnostic]]:
+    """Audit the lowered dispatch path of ``circuit`` against the planner's
+    comm model for an ``num_devices``-way amplitude mesh.
+
+    Always performs the abstract jaxpr walk; additionally lowers + compiles
+    against a real mesh when the process has ``num_devices`` devices
+    (CI uses the 8-virtual-device CPU mesh), cross-checking the state-sized
+    collective count against ``planner.comm_summary`` and auditing buffer
+    donation.  Returns ``(report, diagnostics)``."""
+    import jax
+    import jax.numpy as jnp
+    from ..circuit import _run_ops_routed
+    from ..parallel import planner as _planner
+
+    n = circuit.num_qubits
+    dtype = dtype or jnp.float32
+    ops = circuit.key()
+    jaxpr_counts = count_jaxpr_collectives(make_dispatch_jaxpr(circuit, dtype))
+    predicted = _planner.comm_summary(
+        circuit, num_devices,
+        bytes_per_amp=8 if jnp.dtype(dtype) == jnp.float32 else 16)
+    report: dict = {
+        "label": label,
+        "num_devices": num_devices,
+        "jaxpr_collectives": jaxpr_counts,
+        "predicted_comm_events": predicted["comm_events"],
+        "predicted_reshard_events": predicted["reshard_events"],
+        "hlo_collectives": None,
+        "donation_aliased": None,
+    }
+    out: list[Diagnostic] = []
+
+    # the GSPMD gate path must carry no explicit collectives of its own:
+    # any here would double whatever the partitioner inserts
+    if jaxpr_counts:
+        out.append(diag(
+            AnalysisCode.COLLECTIVE_COUNT_MISMATCH, Severity.ERROR,
+            detail=(f"{label}: explicit collectives {jaxpr_counts} in the "
+                    "traced dispatch path (GSPMD inserts its own on top)")))
+
+    devices = jax.devices()
+    if num_devices <= 1 or len(devices) < num_devices:
+        return report, out
+
+    text = _compiled_text(circuit, num_devices, dtype, donate)
+    shard_amps = (1 << n) // num_devices
+    hlo = count_hlo_collectives(text, min_elems=shard_amps // 2)
+    measured = sum(hlo.values())
+    report["hlo_collectives"] = hlo
+    report["donation_aliased"] = donation_aliased(text)
+
+    if predicted["comm_events"] == 0 and measured:
+        out.append(diag(
+            AnalysisCode.UNEXPECTED_ALLGATHER, Severity.ERROR,
+            detail=(f"{label}: planner models this circuit comm-free on "
+                    f"{num_devices} devices but the compiled program moves "
+                    f"state-sized data: {hlo}")))
+    elif measured > _HLO_OPS_PER_EVENT * predicted["comm_events"]:
+        out.append(diag(
+            AnalysisCode.COLLECTIVE_COUNT_MISMATCH, Severity.WARNING,
+            detail=(f"{label}: compiled HLO has {measured} state-sized "
+                    f"collectives ({hlo}) vs {predicted['comm_events']} "
+                    f"planner-predicted comm events (> "
+                    f"{_HLO_OPS_PER_EVENT}x: the model undercosts this "
+                    "circuit)")))
+
+    if donate and not report["donation_aliased"]:
+        out.append(diag(
+            AnalysisCode.DONATION_UNUSED, Severity.WARNING,
+            detail=(f"{label}: donate=True compiled without an "
+                    "input_output_alias — the state buffer is NOT reused")))
+    return report, out
+
+
+def _compiled_text(circuit, num_devices: int, dtype, donate: bool,
+                   per_op: bool = False) -> str:
+    import jax
+    from ..circuit import _apply_one, _run_ops_routed
+    from ..parallel.mesh import amp_sharding, make_amps_mesh
+    mesh = make_amps_mesh(jax.devices()[:num_devices])
+    sharding = amp_sharding(mesh)
+    ops = circuit.key()
+
+    def run_routed(s):
+        return _run_ops_routed(s, ops)
+
+    def run_per_op(s):
+        # bench.py's pair methodology: one eager-shaped kernel per op, so
+        # scheduling deltas stay visible (the routed executor would defer
+        # both variants' permutations into the same trailing reconcile)
+        for op in ops:
+            s = _apply_one(s, op)
+        return s
+
+    # output sharding pinned to the input's, exactly like bench.py's pairs:
+    # otherwise the partitioner may virtualise a trailing permutation into
+    # an output-layout relabel and the counts stop being comparable
+    fn = jax.jit(run_per_op if per_op else run_routed,
+                 out_shardings=sharding,
+                 donate_argnums=(0,) if donate else ())
+    spec = jax.ShapeDtypeStruct((2, 1 << circuit.num_qubits), dtype,
+                                sharding=sharding)
+    return fn.lower(spec).compile().as_text()
+
+
+def audit_schedule_pair(circuit, scheduled, num_devices: int, *,
+                        dtype=None,
+                        label: str = "pair") -> tuple[dict, list[Diagnostic]]:
+    """HLO-level scheduler regression gate: compile BOTH members of an
+    (unscheduled, scheduled) pair against the mesh and require the
+    scheduled program to contain no more state-sized collectives than the
+    unscheduled one — the partitioner-observed twin of the planner-level
+    ``A_SCHEDULE_COMM_REGRESSION`` check, over the same pair bench.py
+    measures.  Host + compile work only; nothing executes."""
+    import jax
+    import jax.numpy as jnp
+    dtype = dtype or jnp.float32
+    report: dict = {"label": label, "num_devices": num_devices,
+                    "unscheduled_hlo": None, "scheduled_hlo": None}
+    out: list[Diagnostic] = []
+    if num_devices <= 1 or len(jax.devices()) < num_devices:
+        return report, out
+    shard_amps = (1 << circuit.num_qubits) // num_devices
+    before = count_hlo_collectives(
+        _compiled_text(circuit, num_devices, dtype, False, per_op=True),
+        min_elems=shard_amps // 2)
+    after = count_hlo_collectives(
+        _compiled_text(scheduled, num_devices, dtype, False, per_op=True),
+        min_elems=shard_amps // 2)
+    report["unscheduled_hlo"] = before
+    report["scheduled_hlo"] = after
+    if sum(after.values()) > sum(before.values()):
+        out.append(diag(
+            AnalysisCode.COLLECTIVE_COUNT_MISMATCH, Severity.ERROR,
+            detail=(f"{label}: scheduling INCREASED compiled state-sized "
+                    f"collectives {sum(before.values())} -> "
+                    f"{sum(after.values())} ({before} -> {after})")))
+    return report, out

@@ -23,6 +23,11 @@ Rules
 - ``P_HOST_CALLBACK_IN_SHARD_MAP``: ``jax.debug.callback`` /
   ``pure_callback`` / ``io_callback`` / ``host_callback`` inside a
   shard_map-decorated function.
+- ``P_IMPORT_TIME_STATE_MUTATION``: module-import-time mutation of
+  ``jax.config`` or global RNG state (``np.random.seed`` /
+  ``random.seed``): import order silently changes numerics process-wide.
+  Only ``quest_tpu/_compat.py`` is allowlisted — the single site where the
+  package-wide x64 default is set.
 """
 
 from __future__ import annotations
@@ -35,6 +40,17 @@ from .diagnostics import AnalysisCode, Diagnostic, Severity, diag
 _HOST_CASTS = ("float", "int", "bool")
 _CALLBACK_NAMES = ("callback", "pure_callback", "io_callback", "host_callback")
 _F64_NAMES = ("float64",)
+
+# import-time global-state mutators (calls) and the config objects whose
+# attribute assignment mutates process state
+_IMPORT_MUTATOR_CALLS = ("jax.config.update", "config.update",
+                         "np.random.seed", "numpy.random.seed",
+                         "random.seed", "np.random.set_state",
+                         "numpy.random.set_state")
+_IMPORT_MUTATOR_TARGETS = ("jax.config", "config")
+# the single module allowed to mutate global config at import time — a
+# full path suffix, so a stray _compat.py elsewhere is NOT exempt
+_IMPORT_MUTATION_ALLOWLIST = ("quest_tpu/_compat.py",)
 
 
 def _dotted(node: ast.AST) -> str:
@@ -218,12 +234,51 @@ class _Linter(ast.NodeVisitor):
                        f"angle cast to {dtype_name}")
 
 
+def _lint_import_time(tree: ast.Module, filename: str) -> list[Diagnostic]:
+    """Flag global-state mutation that executes at module import: walks
+    every statement reachable WITHOUT entering a function body (class
+    bodies, if/try/with blocks and loops all run at import).
+    ``quest_tpu/_compat.py`` is the single allowlisted site (the
+    package-wide x64 default)."""
+    normalized = os.path.normpath(filename).replace(os.sep, "/")
+    if normalized.endswith(_IMPORT_MUTATION_ALLOWLIST):
+        return []
+    out: list[Diagnostic] = []
+
+    def scan(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue  # runs at call time, not import time
+            if isinstance(child, ast.Call):
+                name = _dotted(child.func)
+                if name in _IMPORT_MUTATOR_CALLS:
+                    out.append(diag(
+                        AnalysisCode.IMPORT_TIME_STATE_MUTATION,
+                        Severity.ERROR, file=filename, line=child.lineno,
+                        detail=f"{name}(...) at module import time"))
+            elif isinstance(child, ast.Assign):
+                for target in child.targets:
+                    if (isinstance(target, ast.Attribute)
+                            and _dotted(target.value)
+                            in _IMPORT_MUTATOR_TARGETS):
+                        out.append(diag(
+                            AnalysisCode.IMPORT_TIME_STATE_MUTATION,
+                            Severity.ERROR, file=filename, line=child.lineno,
+                            detail=(f"assignment to {_dotted(target.value)}."
+                                    f"{target.attr} at module import time")))
+            scan(child)
+
+    scan(tree)
+    return out
+
+
 def lint_source(source: str, filename: str = "<string>") -> list[Diagnostic]:
     """Lint one module's source text; returns purity diagnostics."""
     tree = ast.parse(source, filename=filename)
     linter = _Linter(filename)
     linter.visit(tree)
-    return linter.out
+    return linter.out + _lint_import_time(tree, filename)
 
 
 def lint_paths(paths) -> list[Diagnostic]:

@@ -200,7 +200,14 @@ class Circuit:
         epochs, swap networks are fused into single bit-permutation
         collectives, and a greedy logical->physical placement search scored
         by the ICI time model (parallel/planner.py) may relabel the circuit.
-        Returns a NEW equivalent Circuit; ``self`` is unmodified.  See
+        Returns a NEW equivalent Circuit; ``self`` is unmodified.
+
+        Inputs are validated with the runtime layer's codes: a bad
+        ``num_devices`` (non-integer, < 1, or not a power of two) raises
+        ``E_INVALID_NUM_RANKS`` and an unknown keyword raises
+        ``E_INVALID_SCHEDULE_OPTION``.  Set
+        ``QUEST_TPU_VALIDATE_SCHEDULE=1`` to translation-validate every
+        scheduled circuit against its input (analysis/equivalence.py); see
         docs/SCHEDULER.md."""
         from .parallel import scheduler as _sched
         return _sched.schedule(self, num_devices, **kwargs)

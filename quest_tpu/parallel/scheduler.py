@@ -562,14 +562,35 @@ def greedy_placement(circuit, num_devices: int, chip=None,
 # ---------------------------------------------------------------------------
 
 def schedule(circuit, num_devices: int, *, chip=None, precision: int = 1,
-             placement: bool = True, reorder: bool = True):
+             placement: bool = True, reorder: bool = True, **unknown):
     """Comm-aware scheduled copy of ``circuit`` for an ``num_devices``-way
     amplitude mesh.  Pure host rewrite of the GateOp IR; the returned
     Circuit implements the SAME unitary (every pass is an exact algebraic
     refactoring) and is what ``compile_circuit(..., num_devices=...)``
-    feeds the routed executor."""
+    feeds the routed executor.
+
+    Invalid deployments are rejected with validation-layer codes before
+    any rewriting: a non-integer, < 1 or non-power-of-two ``num_devices``
+    raises ``E_INVALID_NUM_RANKS`` (the amplitude mesh shards the 2^n axis
+    in halves), an unknown keyword raises ``E_INVALID_SCHEDULE_OPTION``
+    instead of silently proceeding.  With ``QUEST_TPU_VALIDATE_SCHEDULE=1``
+    the output is translation-validated against the input
+    (analysis/equivalence.py) and a disproof raises ``QuESTError``
+    ``V_SEMANTICS_CHANGED``; unverifiable regions warn."""
+    import os
+    import warnings
+
     from ..circuit import Circuit
-    from ..validation import validate_num_ranks
+    from ..validation import ErrorCode, QuESTError, validate_num_ranks
+    if unknown:
+        from ..validation import MESSAGES
+        raise QuESTError(ErrorCode.INVALID_SCHEDULE_OPTION,
+                         MESSAGES[ErrorCode.INVALID_SCHEDULE_OPTION]
+                         + f" Got: {sorted(unknown)}.", "schedule")
+    if not isinstance(num_devices, int) or isinstance(num_devices, bool):
+        from ..validation import MESSAGES
+        raise QuESTError(ErrorCode.INVALID_NUM_RANKS,
+                         MESSAGES[ErrorCode.INVALID_NUM_RANKS], "schedule")
     validate_num_ranks(num_devices, "schedule")
     chip = chip or _planner.V5E
     n = circuit.num_qubits
@@ -586,6 +607,19 @@ def schedule(circuit, num_devices: int, *, chip=None, precision: int = 1,
     ops = _lower_epochs(ops, n, num_devices)
     out = Circuit(n)
     out.ops = ops
+    if os.environ.get("QUEST_TPU_VALIDATE_SCHEDULE") == "1":
+        from ..analysis.diagnostics import Severity
+        from ..analysis.equivalence import check_equivalence
+        found = check_equivalence(circuit, out)
+        errors = [d for d in found if d.severity >= Severity.ERROR]
+        if errors:
+            raise QuESTError(errors[0].code,
+                             "schedule() produced a non-equivalent circuit: "
+                             + "; ".join(d.message for d in errors),
+                             "schedule")
+        for d in found:
+            warnings.warn(f"schedule(): {d.format()}", RuntimeWarning,
+                          stacklevel=2)
     return out
 
 

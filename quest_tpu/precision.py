@@ -13,13 +13,14 @@ from __future__ import annotations
 
 import os
 
-import jax
 import jax.numpy as jnp
 
-# complex128 support requires x64 mode; enable it once at import.  float32
-# quregs are still first-class (dtype is per-Qureg), x64 only widens what JAX
-# *allows*, not what we allocate.
-jax.config.update("jax_enable_x64", True)
+# complex128 support requires x64 mode; _compat enables it at import (the
+# one allowlisted import-time jax.config mutation — see analysis/purity.py
+# P_IMPORT_TIME_STATE_MUTATION).  float32 quregs are still first-class
+# (dtype is per-Qureg), x64 only widens what JAX *allows*, not what we
+# allocate.
+from . import _compat  # noqa: F401  (x64 side effect)
 
 # REAL_EPS per precision (ref: QuEST_precision.h:35,49,64)
 _REAL_EPS = {1: 1e-5, 2: 1e-13, 4: 1e-14}

@@ -354,6 +354,63 @@ def test_lint_statics_and_metadata_are_clean():
     assert lint_codes(src) == []
 
 
+def test_lint_import_time_config_mutation(tmp_path):
+    """Module-import-time jax.config / RNG mutation is flagged; the same
+    code inside a function is not; _compat.py is the allowlisted site."""
+    bad = (
+        "import jax\n"
+        "import numpy as np\n"
+        "jax.config.update('jax_enable_x64', True)\n"
+        "np.random.seed(0)\n"
+        "if True:\n"
+        "    jax.config.update('jax_platforms', 'cpu')\n")
+    found = lint_codes(bad)
+    assert found == [AnalysisCode.IMPORT_TIME_STATE_MUTATION] * 3
+    ok = (
+        "import jax\n"
+        "def configure():\n"
+        "    jax.config.update('jax_enable_x64', True)\n")
+    assert lint_codes(ok) == []
+    # attribute assignment counts as mutation too
+    assign = "import jax\njax.config.jax_enable_x64 = True\n"
+    assert lint_codes(assign) == [AnalysisCode.IMPORT_TIME_STATE_MUTATION]
+    # fixture at the allowlisted PATH quest_tpu/_compat.py: exempt
+    pkg = tmp_path / "quest_tpu"
+    pkg.mkdir()
+    fixture = pkg / "_compat.py"
+    fixture.write_text(bad)
+    assert an.lint_paths([str(fixture)]) == []
+    # a stray _compat.py elsewhere is NOT exempt (suffix match, not name)
+    stray = tmp_path / "_compat.py"
+    stray.write_text(bad)
+    assert len(an.lint_paths([str(stray)])) == 3
+    other = pkg / "other.py"
+    other.write_text(bad)
+    assert len(an.lint_paths([str(other)])) == 3
+
+
+def test_compat_is_the_only_import_time_config_mutation_site():
+    """The satellite contract itself: quest_tpu/_compat.py (allowlisted)
+    holds the one import-time jax.config.update; linting the tree with the
+    allowlist DISABLED flags exactly that site and nothing else."""
+    import os
+
+    from quest_tpu.analysis import purity as pmod
+
+    pkg_root = os.path.dirname(os.path.abspath(an.__file__))
+    pkg_root = os.path.dirname(pkg_root)
+    diags = [d for d in an.lint_paths([pkg_root])
+             if d.code == AnalysisCode.IMPORT_TIME_STATE_MUTATION]
+    assert diags == []
+    src = os.path.join(pkg_root, "_compat.py")
+    with open(src, encoding="utf-8") as fh:
+        found = an.lint_source(fh.read(), "renamed_away_from_allowlist.py")
+    hits = [d for d in found
+            if d.code == AnalysisCode.IMPORT_TIME_STATE_MUTATION]
+    assert len(hits) == 1, [d.format() for d in found]
+    assert pmod._IMPORT_MUTATION_ALLOWLIST == ("quest_tpu/_compat.py",)
+
+
 def test_lint_self_clean():
     """The quest_tpu tree itself is clean under the purity rules — the CI
     gate (`python -m quest_tpu.analysis --self-lint`) stays green."""
@@ -392,6 +449,53 @@ def test_cli_lint_flags_bad_file(tmp_path, capsys):
 def test_cli_no_mode_is_usage_error():
     from quest_tpu.analysis.__main__ import main
     assert main([]) == 2
+
+
+def test_cli_json_is_one_parseable_document(capsys):
+    """--json emits ONE JSON document with diagnostics + summary — the
+    machine-readable contract the CI gates parse (no text grepping)."""
+    import json
+
+    from quest_tpu.analysis.__main__ import main
+    assert main(["--self-lint", "--qft", "4", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["summary"]["counts"]["ERROR"] == 0
+    assert doc["summary"]["fail_at"] == "ERROR"
+    assert any(c["label"] == "qft(4)" for c in doc["circuits"])
+    assert isinstance(doc["diagnostics"], list)
+
+
+def test_cli_json_carries_severities(tmp_path, capsys):
+    import json
+
+    from quest_tpu.analysis.__main__ import main
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(x)\n")
+    assert main(["--lint", str(bad), "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["summary"]["counts"]["ERROR"] == 1
+    assert doc["diagnostics"][0]["code"] == AnalysisCode.HOST_CAST_ON_TRACED
+    assert doc["diagnostics"][0]["severity"] == "ERROR"
+
+
+def test_cli_verify_schedule_mode(capsys):
+    """--verify-schedule runs the translation validator + lowered audit and
+    reports a proven-equivalent rewrite for the shipped scheduler."""
+    import json
+
+    from quest_tpu.analysis.__main__ import main
+    assert main(["--qft", "10", "--devices", "4", "--verify-schedule",
+                 "--no-hints", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert len(doc["verify"]) == 1
+    v = doc["verify"][0]
+    assert v["proven_equivalent"] is True
+    assert v["equivalence_diagnostics"] == 0
+    assert len(doc["schedule"]) == 1  # --verify-schedule implies scheduling
 
 
 # ---------------------------------------------------------------------------

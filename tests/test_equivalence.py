@@ -1,0 +1,375 @@
+"""Translation validation of scheduler rewrites (analysis/equivalence.py).
+
+Four layers, per the ISSUE:
+
+1. Domain soundness: the dense-window evaluator matches the jax kernels
+   bit-for-bit on every IR kind (the convention anchor), the Pauli domain
+   conjugates Cliffords exactly, the phase-polynomial domain merges
+   multiRotateZ symbolically at widths no dense check could touch.
+2. Acceptance: every rewrite the SHIPPED scheduler performs — 22q QFT x8,
+   randomized circuits, the rich scheduler-structure circuit, optimize()'s
+   native fusion — verifies with zero diagnostics.
+3. The adversarial mutation harness: seeded bugs injected into scheduler
+   output (dropped op, swapped wire, wrong bitperm cycle, perturbed angle)
+   are each flagged V_SEMANTICS_CHANGED.
+4. The soundness oracle: across random scheduled+mutated circuits, the
+   checker NEVER returns "proven equivalent" when an f64 statevector
+   comparison disagrees (global-phase differences included).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from quest_tpu.analysis import AnalysisCode, Severity, check_equivalence
+from quest_tpu.analysis.equivalence import (_normalize_perms, _pauli_equiv,
+                                            _window_unitary)
+from quest_tpu.circuit import (Circuit, GateOp, compile_circuit, qft_circuit,
+                               random_circuit)
+from oracle import random_unitary
+
+
+def codes(diags):
+    return [d.code for d in diags]
+
+
+def has_error(diags):
+    return any(d.severity >= Severity.ERROR for d in diags)
+
+
+def _rand_state(n: int, seed: int = 0) -> jax.Array:
+    rs = np.random.RandomState(seed)
+    st = rs.randn(2, 1 << n)
+    st /= np.sqrt((st ** 2).sum())
+    return jnp.asarray(st, jnp.float64)
+
+
+def _states_agree(a: Circuit, b: Circuit, seed: int = 0,
+                  atol: float = 1e-10) -> bool:
+    st = _rand_state(a.num_qubits, seed)
+    sa = np.asarray(compile_circuit(a)(st))
+    sb = np.asarray(compile_circuit(b)(st))
+    return bool(np.max(np.abs(sa - sb)) < atol)
+
+
+# ---------------------------------------------------------------------------
+# 1. domain soundness
+# ---------------------------------------------------------------------------
+
+def test_window_unitary_matches_kernels():
+    """The dense-window evaluator and the jax kernels agree on every IR
+    kind — the convention anchor the whole validator rests on."""
+    from quest_tpu.circuit import _apply_one
+    np.random.seed(0)
+    n = 4
+    st = np.asarray(_rand_state(n, 1))
+    vec = st[0] + 1j * st[1]
+    c = Circuit(n)
+    c.multi_qubit_unitary((2, 0), random_unitary(2), controls=(3,),
+                          control_states=(0,))
+    c.h(1)
+    c.x(0, controls=(2,))
+    c.y(3)
+    c.swap(1, 3)
+    c.phase_shift(2, 0.7, controls=(0,))
+    c.ops.append(GateOp("mrz", (0, 1, 3), (), (), (0.9,), None))
+    c.ops.append(GateOp("y*", (2,), (1,), (1,)))
+    for op in c.ops:
+        got = np.asarray(_apply_one(jnp.asarray(st, jnp.float64), op))
+        want = _window_unitary([op], list(range(n))) @ vec
+        np.testing.assert_allclose(got[0] + 1j * got[1], want, atol=1e-12)
+
+
+def test_normalize_perms_absorbs_swaps_and_bitperms():
+    """bitperm cycle 0->2->5->0 equals swap(0,2);swap(0,5): both normalize
+    to the same residual permutation with identical cores."""
+    n = 6
+    a = Circuit(n).h(0).cnot(0, 2)
+    a.ops.append(GateOp("bitperm", (0, 2, 5), (), (), (2.0, 5.0, 0.0), None))
+    b = Circuit(n).h(0).cnot(0, 2).swap(0, 2).swap(0, 5)
+    core_a, perm_a = _normalize_perms(a.ops, n)
+    core_b, perm_b = _normalize_perms(b.ops, n)
+    assert perm_a == perm_b != tuple(range(n))
+    assert [op for _, op in core_a] == [op for _, op in core_b]
+    assert check_equivalence(a, b) == []
+
+
+def test_ops_after_permutation_relabel():
+    """An op recorded after a swap acts on post-swap positions: the
+    normalizer must translate it — swap;H(0) == H(1);swap."""
+    a = Circuit(3).swap(0, 1).h(0)
+    b = Circuit(3).h(1)
+    b.swap(0, 1)
+    assert check_equivalence(a, b) == []
+    # and the wrong translation is caught
+    c = Circuit(3).h(0)
+    c.swap(0, 1)
+    assert has_error(check_equivalence(a, c))
+
+
+def test_global_phase_is_not_dropped():
+    """Z X = - X Z: same Pauli tableau, different unitary.  The dense
+    window must refuse equivalence (the soundness case a sign-free
+    stabilizer check would miss)."""
+    a = Circuit(2).z(0).x(0)
+    b = Circuit(2).x(0)
+    b.z(0)
+    diags = check_equivalence(a, b)
+    assert AnalysisCode.SEMANTICS_CHANGED in codes(diags)
+    assert not _states_agree(a, b)
+
+
+def test_phase_polynomial_merges_wide_mrz():
+    """Two multiRotateZ on 15 shared wires merge into one at the summed
+    angle — provable ONLY in the phase-polynomial domain (2^15 dense is
+    out of reach of the window limit)."""
+    t = tuple(range(15))
+    a = Circuit(16)
+    a.ops.append(GateOp("mrz", t, (), (), (0.3,), None))
+    a.ops.append(GateOp("mrz", t, (), (), (0.4,), None))
+    b = Circuit(16)
+    b.ops.append(GateOp("mrz", t, (), (), (0.7,), None))
+    assert check_equivalence(a, b) == []
+    bad = Circuit(16)
+    bad.ops.append(GateOp("mrz", t, (), (), (0.8,), None))
+    assert AnalysisCode.SEMANTICS_CHANGED in codes(check_equivalence(a, bad))
+
+
+def test_phase_polynomial_commutes_rz_through_controls():
+    """rz / controlled-phase reorderings verify through the diagonal
+    domain without any dense work."""
+    a = Circuit(4).rz(0, 0.3).phase_shift(1, 0.5, controls=(0,)).t(0)
+    b = Circuit(4).t(0)
+    b.phase_shift(1, 0.5, controls=(0,))
+    b.rz(0, 0.3)
+    assert check_equivalence(a, b) == []
+
+
+def test_pauli_domain_decides_wide_clifford_window():
+    """X(0) pushed through a 12-wire CNOT ladder becomes X on every wire:
+    a connected, all-Clifford, wider-than-dense window.  The Pauli domain
+    must prove the match (up to global phase -> V_UNVERIFIED_REGION
+    warning, not an error) and refute a corrupted variant."""
+    n = 12
+    a = Circuit(n).x(0)
+    for q in range(n - 1):
+        a.cnot(q, q + 1)
+    b = Circuit(n)
+    for q in range(n - 1):
+        b.cnot(q, q + 1)
+    for q in range(n):
+        b.x(q)
+    ops_a = [(i, op) for i, op in enumerate(a.ops)]
+    ops_b = [(i, op) for i, op in enumerate(b.ops)]
+    assert _pauli_equiv([op for _, op in ops_a], [op for _, op in ops_b],
+                        list(range(n))) is True
+    diags = check_equivalence(a, b)
+    assert not has_error(diags)
+    assert codes(diags) in ([], [AnalysisCode.UNVERIFIED_REGION])
+    # corrupt one wire of the image: tableau mismatch -> ERROR
+    bad = Circuit(n)
+    for q in range(n - 1):
+        bad.cnot(q, q + 1)
+    for q in range(n - 1):
+        bad.x(q)
+    assert AnalysisCode.SEMANTICS_CHANGED in codes(check_equivalence(a, bad))
+
+
+# ---------------------------------------------------------------------------
+# 2. acceptance: every shipped rewrite verifies
+# ---------------------------------------------------------------------------
+
+def test_shipped_scheduler_verifies_qft22_x8():
+    """ISSUE acceptance: the scheduled 22q QFT x8 (the bench.py pair)
+    verifies with ZERO diagnostics — proven equivalent, host-only."""
+    c = qft_circuit(22)
+    assert check_equivalence(c, c.schedule(8)) == []
+
+
+@pytest.mark.parametrize("devices", [2, 4, 8])
+def test_shipped_scheduler_verifies_random_circuits(devices):
+    for seed in range(3):
+        c = random_circuit(10, depth=2, seed=seed)
+        assert check_equivalence(c, c.schedule(devices)) == []
+
+
+def test_shipped_scheduler_verifies_rich_structure():
+    """Every scheduler-relevant structure at once (the test_scheduler rich
+    circuit): epoch lowering, placement, swap fusion, sunk diagonals."""
+    from test_scheduler import _rich_circuit
+    c = _rich_circuit()
+    for devices in (2, 8):
+        assert check_equivalence(c, c.schedule(devices)) == []
+
+
+def test_optimize_fusion_verifies():
+    """optimize()'s native gate fusion (merged payloads — nothing matches
+    1:1) is proven by the dense-window domain."""
+    pytest.importorskip("ctypes")
+    c = random_circuit(6, depth=2, seed=3)
+    before = Circuit(6)
+    before.ops = list(c.ops)
+    c.optimize()
+    if len(c.ops) == len(before.ops):
+        pytest.skip("native fusion library unavailable")
+    assert check_equivalence(before, c) == []
+
+
+def test_validate_schedule_env_hook(monkeypatch):
+    """QUEST_TPU_VALIDATE_SCHEDULE=1 translation-validates inside
+    schedule() and raises QuESTError V_SEMANTICS_CHANGED on a seeded
+    scheduler bug."""
+    from quest_tpu.parallel import scheduler as sched
+    from quest_tpu.validation import QuESTError
+
+    monkeypatch.setenv("QUEST_TPU_VALIDATE_SCHEDULE", "1")
+    c = qft_circuit(12)
+    s = c.schedule(8)  # clean pass validates silently
+    assert len(s.ops) == len(c.ops)
+
+    real = sched._fuse_swap_runs
+
+    def buggy(ops, n, num_devices):
+        out = real(ops, n, num_devices)
+        return out[:-1]  # drop the last op: a classic rewrite bug
+
+    monkeypatch.setattr(sched, "_fuse_swap_runs", buggy)
+    with pytest.raises(QuESTError) as err:
+        c.schedule(8)
+    assert err.value.code == AnalysisCode.SEMANTICS_CHANGED
+
+
+# ---------------------------------------------------------------------------
+# 3. the adversarial mutation harness
+# ---------------------------------------------------------------------------
+
+def _mutate(ops: list, rng: np.random.RandomState, kind: str) -> list | None:
+    """Inject one seeded scheduler bug into an op list; None if this op
+    list has no site for the mutation kind."""
+    ops = list(ops)
+    if kind == "drop":
+        victims = [i for i, op in enumerate(ops) if op.kind != "bitperm"]
+        if not victims:
+            return None
+        del ops[victims[rng.randint(len(victims))]]
+        return ops
+    if kind == "wire":
+        n = max(max(op.targets + op.controls, default=0) for op in ops) + 1
+        for i in rng.permutation(len(ops)):
+            op = ops[i]
+            if op.kind == "bitperm" or not op.targets:
+                continue
+            used = set(op.targets) | set(op.controls)
+            free = [q for q in range(n) if q not in used]
+            if not free:
+                continue
+            j = rng.randint(len(op.targets))
+            t = list(op.targets)
+            t[j] = free[rng.randint(len(free))]
+            ops[i] = GateOp(op.kind, tuple(t), op.controls,
+                            op.control_states, op.matrix, op.shape)
+            return ops
+        return None
+    if kind == "bitperm":
+        for i, op in enumerate(ops):
+            if op.kind == "bitperm" and len(op.targets) >= 2:
+                dests = list(op.matrix)
+                rolled = tuple(dests[1:] + dests[:1])  # wrong cycle
+                if rolled == op.matrix:
+                    continue
+                ops[i] = GateOp(op.kind, op.targets, op.controls,
+                                op.control_states, rolled, op.shape)
+                return ops
+        return None
+    if kind == "angle":
+        for i in rng.permutation(len(ops)):
+            op = ops[i]
+            if op.kind == "mrz":
+                ops[i] = GateOp(op.kind, op.targets, op.controls,
+                                op.control_states,
+                                (float(op.matrix[0]) + 0.31,), op.shape)
+                return ops
+            if op.kind == "diagonal" and op.shape == (2, 2):
+                p = op.payload()
+                d = (p[0] + 1j * p[1]) * np.exp([0.0, 0.41j])
+                dp = np.stack([d.real, d.imag])
+                ops[i] = GateOp(op.kind, op.targets, op.controls,
+                                op.control_states, tuple(dp.ravel()),
+                                dp.shape)
+                return ops
+        return None
+    raise AssertionError(kind)
+
+
+@pytest.mark.parametrize("kind", ["drop", "wire", "bitperm", "angle"])
+def test_mutation_harness_catches_injected_bugs(kind):
+    """Every seeded bug class injected into real scheduler OUTPUT is
+    flagged V_SEMANTICS_CHANGED, across circuits and seeds.  qft(16) x8 is
+    the smallest QFT whose swap network fuses into a bitperm collective."""
+    circuits = [qft_circuit(16), random_circuit(10, depth=2, seed=1)]
+    caught = 0
+    for ci, c in enumerate(circuits):
+        s = c.schedule(8)
+        for seed in range(3):
+            rng = np.random.RandomState(100 * ci + seed)
+            mutated_ops = _mutate(s.ops, rng, kind)
+            if mutated_ops is None:
+                continue
+            bad = Circuit(c.num_qubits)
+            bad.ops = mutated_ops
+            diags = check_equivalence(c, bad)
+            assert has_error(diags), (kind, ci, seed, codes(diags))
+            caught += 1
+    assert caught, f"no mutation site for {kind!r} in any test circuit"
+
+
+def test_mutated_scheduler_pass_is_caught_end_to_end():
+    """A bug injected into a scheduler PASS (not its output) is caught:
+    placement relabeling applied without its entry permutation."""
+    from quest_tpu.parallel import scheduler as sched
+    c = Circuit(13)
+    np.random.seed(1)
+    for _ in range(12):
+        c.unitary(12, random_unitary(1))
+    s = sched.schedule(c, 8)
+    assert check_equivalence(c, s) == []
+    # strip the entry bitperm the placement search inserted
+    assert s.ops[0].kind in ("bitperm", "swap")
+    bad = Circuit(13)
+    bad.ops = [op for op in s.ops[1:]]
+    assert has_error(check_equivalence(c, bad))
+
+
+# ---------------------------------------------------------------------------
+# 4. the soundness oracle
+# ---------------------------------------------------------------------------
+
+def test_checker_never_passes_a_statevector_disagreement():
+    """Across scheduled and randomly-mutated circuits: whenever the checker
+    returns ZERO diagnostics ("proven equivalent"), the f64 statevectors
+    agree.  The contrapositive — states differ => diagnostics — is the
+    soundness contract; false ALARMS are allowed, silence is not."""
+    n = 8
+    kinds = ["drop", "wire", "angle", "bitperm", None]
+    checked_equal = 0
+    for seed in range(6):
+        c = random_circuit(n, depth=2, seed=seed)
+        s = c.schedule([2, 4, 8][seed % 3])
+        rng = np.random.RandomState(seed)
+        kind = kinds[seed % len(kinds)]
+        ops = _mutate(s.ops, rng, kind) if kind else list(s.ops)
+        if ops is None:
+            ops = list(s.ops)
+        cand = Circuit(n)
+        cand.ops = ops
+        diags = check_equivalence(c, cand)
+        agree = _states_agree(c, cand, seed)
+        assert not (diags == [] and not agree), \
+            f"checker silently passed a semantic change (seed {seed})"
+        if diags == []:
+            checked_equal += 1
+    assert checked_equal, "oracle never exercised the 'equivalent' verdict"

@@ -1,0 +1,127 @@
+"""Lowered-jaxpr / compiled-HLO audit (analysis/jaxpr_audit.py): collective
+counting at both levels, the planner cross-check, and the donation audit —
+on the same 8-virtual-device CPU mesh the rest of the suite uses."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from quest_tpu.analysis import AnalysisCode, Severity
+from quest_tpu.analysis.jaxpr_audit import (audit_dispatch,
+                                            audit_schedule_pair,
+                                            count_hlo_collectives,
+                                            count_jaxpr_collectives,
+                                            donation_aliased)
+from quest_tpu.circuit import Circuit, qft_circuit
+
+
+def codes(diags):
+    return [d.code for d in diags]
+
+
+# ---------------------------------------------------------------------------
+# jaxpr-level counting
+# ---------------------------------------------------------------------------
+
+def test_gspmd_dispatch_path_has_no_explicit_collectives():
+    """The compiled gate path relies on the partitioner: its traced jaxpr
+    must contain ZERO explicit collective primitives."""
+    from quest_tpu.analysis.jaxpr_audit import make_dispatch_jaxpr
+    c = qft_circuit(10)
+    assert count_jaxpr_collectives(make_dispatch_jaxpr(c)) == {}
+
+
+def test_shard_map_collectives_are_counted(env_dist):
+    """The manual shard_map kernels (parallel/collectives.py) show exactly
+    their documented primitives through the recursive eqn walk."""
+    from quest_tpu.parallel import collectives as coll
+    mesh = env_dist.mesh
+    st = jnp.zeros((2, 1 << 12), jnp.float32)
+    jx = jax.make_jaxpr(lambda s: coll.pairwise_exchange(s, mesh, 1))(st)
+    assert count_jaxpr_collectives(jx) == {"ppermute": 1}
+    jx = jax.make_jaxpr(lambda s: coll.global_sum(s, mesh))(st)
+    counts = count_jaxpr_collectives(jx)
+    # the psum primitive is spelled psum2 on some jax versions
+    assert counts.get("psum", 0) + counts.get("psum2", 0) >= 1, counts
+
+
+# ---------------------------------------------------------------------------
+# HLO-level counting helpers (pure text parsing)
+# ---------------------------------------------------------------------------
+
+_FAKE_HLO = """\
+HloModule m, input_output_alias={ {}: (0, {}, may-alias) }
+%all-gather = f32[2,4096]{1,0} all-gather(f32[2,512]{1,0} %p0)
+%all-reduce.1 = f32[8]{0} all-reduce(f32[8]{0} %small)
+%collective-permute = f32[2,512]{1,0} collective-permute(f32[2,512]{1,0} %x)
+"""
+
+
+def test_hlo_collective_count_filters_small_ops():
+    all_ops = count_hlo_collectives(_FAKE_HLO)
+    assert all_ops == {"all-gather": 1, "all-reduce": 1,
+                       "collective-permute": 1}
+    big = count_hlo_collectives(_FAKE_HLO, min_elems=256)
+    assert big == {"all-gather": 1, "collective-permute": 1}
+
+
+def test_donation_alias_detection():
+    assert donation_aliased(_FAKE_HLO)
+    assert not donation_aliased("HloModule m\n%add = f32[2] add(...)")
+
+
+# ---------------------------------------------------------------------------
+# the audit against the planner model
+# ---------------------------------------------------------------------------
+
+def test_local_circuit_audits_clean(env_dist):
+    """A circuit the planner models comm-free must compile with zero
+    state-sized collectives — no A_UNEXPECTED_ALLGATHER."""
+    c = Circuit(12).h(0).cnot(0, 1).t(2)
+    report, diags = audit_dispatch(c, 8, label="local")
+    assert report["predicted_comm_events"] == 0
+    assert report["hlo_collectives"] == {}
+    assert diags == []
+    assert report["donation_aliased"]
+
+
+def test_sharded_circuit_audit_within_model_bound(env_dist):
+    """The scheduled QFT's compiled collective count stays within the
+    per-event lowering bound of the planner prediction (the acceptance
+    cross-check, at the 12q size the suite can afford to compile)."""
+    from quest_tpu.analysis.jaxpr_audit import _HLO_OPS_PER_EVENT
+    c = qft_circuit(12)
+    s = c.schedule(8)
+    report, diags = audit_dispatch(s, 8, label="qft12")
+    measured = sum(report["hlo_collectives"].values())
+    assert measured > 0  # the mesh really communicates
+    assert measured <= _HLO_OPS_PER_EVENT * report["predicted_comm_events"], \
+        report
+    assert AnalysisCode.COLLECTIVE_COUNT_MISMATCH not in codes(diags)
+    assert AnalysisCode.UNEXPECTED_ALLGATHER not in codes(diags)
+
+
+def test_schedule_pair_audit_no_hlo_regression(env_dist):
+    """HLO-level scheduler gate: the scheduled member of the 16q QFT pair
+    (the smallest whose swap network fuses) compiles to no MORE state-sized
+    collectives than the unscheduled one."""
+    c = qft_circuit(16)
+    s = c.schedule(8)
+    report, diags = audit_schedule_pair(c, s, 8, label="qft16")
+    assert diags == [], [d.format() for d in diags]
+    assert (sum(report["scheduled_hlo"].values())
+            <= sum(report["unscheduled_hlo"].values())), report
+
+
+def test_audit_skips_hlo_when_mesh_too_small():
+    """Requesting more devices than exist degrades to the host-only audit
+    (jaxpr walk + predictions), not an error."""
+    c = Circuit(10).h(9)
+    report, diags = audit_dispatch(c, 1024, label="huge")
+    assert report["hlo_collectives"] is None
+    assert report["donation_aliased"] is None
+    assert diags == []

@@ -360,6 +360,40 @@ def test_routed_perm_has_three_plus_cycle():
 
 
 # ---------------------------------------------------------------------------
+# schedule() input validation (ISSUE satellite): E_* codes, not silence
+# ---------------------------------------------------------------------------
+
+def test_schedule_rejects_unknown_kwargs():
+    """Unknown kwargs raise the validation layer's E_INVALID_SCHEDULE_OPTION
+    instead of silently proceeding (or a bare TypeError)."""
+    from quest_tpu.validation import ErrorCode, QuESTError
+    c = qft_circuit(6)
+    with pytest.raises(QuESTError) as err:
+        c.schedule(4, optimize_harder=True)
+    assert err.value.code == ErrorCode.INVALID_SCHEDULE_OPTION
+    assert "optimize_harder" in str(err.value)
+    # the documented options still work
+    assert c.schedule(4, placement=False, reorder=False).num_qubits == 6
+
+
+@pytest.mark.parametrize("bad", [0, -1, 3, 12, True, 2.0, "8"])
+def test_schedule_rejects_bad_num_devices(bad):
+    """num_devices < 1, non-power-of-two, or non-integer raises
+    E_INVALID_NUM_RANKS (the amplitude mesh halves the 2^n axis)."""
+    from quest_tpu.validation import ErrorCode, QuESTError
+    c = qft_circuit(6)
+    with pytest.raises(QuESTError) as err:
+        c.schedule(bad)
+    assert err.value.code == ErrorCode.INVALID_NUM_RANKS
+
+
+def test_schedule_accepts_valid_num_devices():
+    c = qft_circuit(6)
+    for devices in (1, 2, 4, 8):
+        assert c.schedule(devices).num_qubits == 6
+
+
+# ---------------------------------------------------------------------------
 # ride-along contracts: donated-program cache, optimize() in-place fusion
 # ---------------------------------------------------------------------------
 
