@@ -623,36 +623,54 @@ _HLO_COLLECTIVES = ("all-to-all", "collective-permute", "all-gather",
 def _hlo_collective_count(compiled_text: str) -> int:
     """Collective instruction DEFINITIONS in compiled HLO text — the
     measured comm-pass count of a program (the static comm_plan predicts;
-    this observes what the partitioner actually emitted)."""
+    this observes what the partitioner actually emitted).  Async spellings
+    (``op-start``) count like sync ones: on TPU the overlapped executor's
+    collectives lower as start/done pairs and must not vanish from the
+    column (``-done`` is the same collective completing, not a second
+    one)."""
     import re
-    pat = re.compile(r"= \S+ (" + "|".join(_HLO_COLLECTIVES) + r")\(")
+    pat = re.compile(r"= \S+ (" + "|".join(_HLO_COLLECTIVES)
+                     + r")(-start)?\(")
     return len(pat.findall(compiled_text))
 
 
-def bench_sched_pair(circuit, devices, depth=1):
-    """Scheduled vs unscheduled execution of one circuit over a device mesh:
-    the comm-aware scheduler's (parallel/scheduler.py) measured row.
+_SCHED_PAIR_CHUNKS = 4  # pipeline depth of the overlapped bench variant
 
-    Both variants run the identical program shape (per-op chain, output
-    sharding pinned to the input's so the partitioner cannot virtualise
-    trailing permutations into an output-layout drift); the row reports the
-    planner-PREDICTED comm savings next to the MEASURED wall-time and
-    compiled-HLO collective deltas.  Value = scheduled-variant amp updates/s
-    (validation_only on a CPU mesh, like the other sharded configs)."""
+
+def bench_sched_pair(circuit, devices, depth=1):
+    """Scheduled vs unscheduled vs OVERLAPPED execution of one circuit over
+    a device mesh: the comm-aware scheduler's (parallel/scheduler.py) and
+    the pipelined executor's (parallel/executor.py) measured row.
+
+    The first two variants run the identical program shape (per-op chain,
+    output sharding pinned to the input's so the partitioner cannot
+    virtualise trailing permutations into an output-layout drift); the
+    third runs the scheduled circuit through the chunked overlapped
+    executor.  The row reports the planner-PREDICTED comm savings and
+    comm-hidden fraction next to the MEASURED wall-time, compiled-HLO
+    collective and async-start deltas.  Value = scheduled-variant amp
+    updates/s (validation_only on a CPU mesh, like the other sharded
+    configs)."""
     import numpy as np
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from quest_tpu.analysis.jaxpr_audit import count_hlo_async_collectives
     from quest_tpu.circuit import _apply_one
+    from quest_tpu.parallel import executor as _exec
     from quest_tpu.parallel.scheduler import schedule, schedule_savings
 
     n = circuit.num_qubits
     nd = len(devices)
-    sched = schedule(circuit, nd)
-    predicted = schedule_savings(circuit, nd, scheduled=sched)
+    chunks = _SCHED_PAIR_CHUNKS
+    sched = schedule(circuit, nd, overlap=True, pipeline_chunks=chunks)
+    predicted = schedule_savings(circuit, nd, scheduled=sched,
+                                 pipeline_chunks=chunks)
+    overlap_pred = _exec.predict_overlap(sched, nd, chunks)
     mesh = Mesh(np.asarray(devices), ("amps",))
     sharding = NamedSharding(mesh, P(None, "amps"))
     measured = {}
+    variants = []
     for key, circ in (("unscheduled", circuit), ("scheduled", sched)):
         ops = circ.key()
 
@@ -662,11 +680,28 @@ def bench_sched_pair(circuit, devices, depth=1):
                     s = _apply_one(s, op)
             return s
 
-        fn = jax.jit(run, out_shardings=sharding)
+        variants.append((key, jax.jit(run, out_shardings=sharding),
+                         len(ops)))
+    overlapped_fn = _exec.overlapped_program(sched, nd, chunks, mesh=mesh)
+    if depth > 1:
+        base = overlapped_fn
+
+        def overlapped_deep(s, _base=base):
+            for _ in range(depth):
+                s = _base(s)
+            return s
+
+        overlapped_fn = overlapped_deep
+    variants.append(("overlapped", overlapped_fn, len(sched.ops)))
+    for key, fn, n_ops in variants:
         state = jax.device_put(
             jnp.zeros((2, 1 << n), dtype=jnp.float32).at[0, 0].set(1.0),
             sharding)
-        colls = _hlo_collective_count(fn.lower(state).compile().as_text())
+        text = jax.jit(fn).lower(state).compile().as_text() \
+            if key == "overlapped" and depth > 1 \
+            else fn.lower(state).compile().as_text()
+        colls = _hlo_collective_count(text)
+        asyncs = count_hlo_async_collectives(text)
         out = fn(state)
         out.block_until_ready()  # compile + warm
         best = None
@@ -680,8 +715,11 @@ def bench_sched_pair(circuit, devices, depth=1):
                              + out[1].astype(jnp.float64) ** 2))
         assert abs(norm - 1.0) < 1e-2, f"norm lost ({key}): {norm}"
         measured[key] = {"seconds": best, "hlo_collectives": colls,
-                         "ops": len(ops)}
+                         "hlo_async_starts": asyncs["starts"],
+                         "hlo_async_separated": asyncs["separated"],
+                         "ops": n_ops}
     un, sc = measured["unscheduled"], measured["scheduled"]
+    ov = measured["overlapped"]
     value = (1 << n) * len(circuit) * depth / sc["seconds"]
     cfg = {
         "qubits": n, "depth": depth, "precision": 1, "devices": nd,
@@ -701,6 +739,24 @@ def bench_sched_pair(circuit, devices, depth=1):
             "scheduled_hlo_collectives": sc["hlo_collectives"],
             "hlo_collectives_saved": (un["hlo_collectives"]
                                       - sc["hlo_collectives"]),
+        },
+        # the pipelined-executor columns: model prediction next to the
+        # measured wall delta of the SAME scheduled circuit, chunked
+        "overlapped": {
+            "pipeline_chunks": chunks,
+            "predicted_hidden_frac": overlap_pred["predicted_hidden_frac"],
+            "model_seconds_serial": overlap_pred["model_seconds_serial"],
+            "model_seconds_overlapped":
+                overlap_pred["model_seconds_overlapped"],
+            "chunked_events": overlap_pred["chunked_events"],
+            "hideable_events": overlap_pred["hideable_events"],
+            "measured_seconds": ov["seconds"],
+            # fraction of the scheduled wall time the chunked pipeline
+            # recovered; on a CPU mesh (sync collectives) expect ~0
+            "measured_hidden_frac_wall": 1.0 - ov["seconds"] / sc["seconds"],
+            "hlo_collectives": ov["hlo_collectives"],
+            "hlo_async_starts": ov["hlo_async_starts"],
+            "hlo_async_separated": ov["hlo_async_separated"],
         },
         "ops_unscheduled": un["ops"], "ops_scheduled": sc["ops"],
     }
@@ -760,6 +816,26 @@ def bench_qft(n, precision=1, devices=None):
     if sharding is not None:
         state = jax.device_put(state, sharding)
 
+    comm = None
+    if devices is not None:
+        # predicted vs measured state-sized collective counts, so CPU-only
+        # CI tracks the comm trajectory between TPU rounds (the row used to
+        # be validation_only with no comm data at all)
+        from quest_tpu.analysis.jaxpr_audit import count_hlo_collectives
+        from quest_tpu.parallel import planner as _planner
+        predicted = _planner.comm_summary(c, len(devices),
+                                          8 if precision == 1 else 16)
+        text = run.lower(state, 1).compile().as_text()
+        shard_amps = (1 << n) // len(devices)
+        by_kind = count_hlo_collectives(text, min_elems=shard_amps // 2)
+        comm = {
+            "predicted_comm_events": predicted["comm_events"],
+            "predicted_reshard_events": predicted["reshard_events"],
+            "predicted_bytes_moved": predicted["bytes_moved"],
+            "measured_hlo_state_collectives": sum(by_kind.values()),
+            "measured_hlo_by_kind": by_kind,
+        }
+
     float(run(state, 1))  # compile + warm
     float(run(state, 0))  # compile the overhead-probe variant too
     t0 = time.perf_counter()
@@ -783,6 +859,7 @@ def bench_qft(n, precision=1, devices=None):
         # CPU-mesh configs validate cross-shard communication patterns, not
         # chip throughput: their amps/s is NOT comparable to the baseline
         cfg["validation_only"] = True
+        cfg["comm"] = comm
     return value, cfg
 
 

@@ -24,9 +24,11 @@ def bernstein_vazirani(num_qubits: int = 16, secret: int = 2 ** 4 + 1) -> Circui
     Circuit: ancilla flip + one CNOT per secret bit.  The example script
     runs 9 qubits; the factory defaults to 16 so the CI mesh smoke
     analyzes a deployment-sized register (a 9-qubit state over 8 devices
-    is 64 amps per shard — smaller than one lane/sublane tile, a layout
-    regime the planner's wire-position comm model deliberately does not
-    cover and the lowered-program audit rightly flags)."""
+    is 64 amps per shard — smaller than one 128-wide lane row, the layout
+    regime where the planner now charges every dense gate the 'subtile'
+    comm class and the analyzer warns ``A_SUBTILE_SHARD``; see
+    planner.sub_tile_shard — promoted from a found-by-audit note here to
+    a modeled comm class)."""
     c = Circuit(num_qubits)
     c.x(0)
     bits = secret

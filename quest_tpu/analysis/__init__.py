@@ -16,10 +16,11 @@ QuEST_validation.c plays in the reference but *ahead* of run time:
 4. :func:`check_equivalence` / :func:`verify_schedule` — translation
    validation of scheduler/optimizer rewrites (Pauli tableau, phase
    polynomial, dense-window domains; ``V_*`` codes) without touching a
-   2^n state.
-5. :func:`audit_dispatch` / :func:`audit_schedule_pair` — lowered-jaxpr /
-   compiled-HLO collective and donation audit against the planner's comm
-   model.
+   2^n state; :func:`check_overlap_plan` extends the proof to the
+   pipelined executor's chunked lowering (chunking is layout-only).
+5. :func:`audit_dispatch` / :func:`audit_schedule_pair` /
+   :func:`audit_overlap` — lowered-jaxpr / compiled-HLO collective,
+   donation and async-overlap audit against the planner's comm model.
 
 CLI: ``python -m quest_tpu.analysis --self-lint`` (the tier-1 CI gate) and
 ``--verify-schedule`` (the scheduler translation-validation smoke), see
@@ -31,8 +32,10 @@ from .diagnostics import (AnalysisCode, Diagnostic, Severity,  # noqa: F401
 from .circuit_ir import analyze_circuit  # noqa: F401
 from .abstract_eval import check_abstract_eval  # noqa: F401
 from .purity import lint_package, lint_paths, lint_source  # noqa: F401
-from .equivalence import check_equivalence, verify_schedule  # noqa: F401
-from .jaxpr_audit import (audit_dispatch, audit_schedule_pair,  # noqa: F401
+from .equivalence import (check_equivalence, check_overlap_plan,  # noqa: F401
+                          verify_schedule)
+from .jaxpr_audit import (audit_dispatch, audit_overlap,  # noqa: F401
+                          audit_schedule_pair, count_hlo_async_collectives,
                           count_hlo_collectives, count_jaxpr_collectives,
                           donation_aliased)
 
@@ -40,7 +43,8 @@ __all__ = [
     "AnalysisCode", "Diagnostic", "Severity", "max_severity", "message_for",
     "analyze_circuit", "check_abstract_eval",
     "lint_source", "lint_paths", "lint_package",
-    "check_equivalence", "verify_schedule",
-    "audit_dispatch", "audit_schedule_pair", "count_jaxpr_collectives",
-    "count_hlo_collectives", "donation_aliased",
+    "check_equivalence", "check_overlap_plan", "verify_schedule",
+    "audit_dispatch", "audit_overlap", "audit_schedule_pair",
+    "count_jaxpr_collectives", "count_hlo_collectives",
+    "count_hlo_async_collectives", "donation_aliased",
 ]

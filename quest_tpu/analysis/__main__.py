@@ -65,11 +65,13 @@ def _dtype(precision: int):
 def _schedule_report(label: str, circuit, args, scheduled, echo) -> tuple:
     """Planner-predicted savings of ``scheduled`` vs ``circuit``; an ERROR
     diagnostic iff the scheduled circuit models as MORE communication than
-    the input (the CI smoke contract)."""
+    the input, or iff the overlap-aware time model predicts the pipelined
+    executor SLOWER than the serial schedule (the CI smoke contracts)."""
     from ..parallel.scheduler import schedule_savings
     from .diagnostics import AnalysisCode, Severity, diag
     report = schedule_savings(circuit, args.devices, chip=_chip(args.chip),
-                              precision=args.precision, scheduled=scheduled)
+                              precision=args.precision, scheduled=scheduled,
+                              pipeline_chunks=args.overlap_chunks)
     report["label"] = label
     echo(f"{label}: schedule savings " + json.dumps(report, default=float))
     out = []
@@ -81,15 +83,30 @@ def _schedule_report(label: str, circuit, args, scheduled, echo) -> tuple:
                                 f"{report['comm_events_after']}, bytes "
                                 f"{report['comm_bytes_before']}->"
                                 f"{report['comm_bytes_after']}")))
+    if (report.get("model_seconds_overlapped") is not None
+            and report["model_seconds_overlapped"]
+            > report["model_seconds_after"] * (1 + 1e-9)):
+        out.append(diag(AnalysisCode.OVERLAP_MODEL_REGRESSION, Severity.ERROR,
+                        detail=(f"{label}: "
+                                f"{report['model_seconds_overlapped']:.3g}s "
+                                f"overlapped vs "
+                                f"{report['model_seconds_after']:.3g}s "
+                                "serial")))
     return report, out
 
 
 def _verify_report(label: str, circuit, args, scheduled, echo) -> tuple:
     """Translation validation + lowered-program audit of one scheduled
-    rewrite (the --verify-schedule payload)."""
-    from .equivalence import check_equivalence
-    from .jaxpr_audit import audit_dispatch, audit_schedule_pair
+    rewrite (the --verify-schedule payload).  With --overlap-chunks the
+    chunking plan is proven layout-only (check_overlap_plan) and the
+    pipelined executor's compiled program is audited (audit_overlap)."""
+    from .equivalence import check_equivalence, check_overlap_plan
+    from .jaxpr_audit import audit_dispatch, audit_overlap, \
+        audit_schedule_pair
     found = check_equivalence(circuit, scheduled)
+    plan = getattr(scheduled, "_overlap_plan", None)
+    if plan is not None:
+        found += check_overlap_plan(scheduled, plan)
     report = {
         "label": label,
         "devices": args.devices,
@@ -102,11 +119,18 @@ def _verify_report(label: str, circuit, args, scheduled, echo) -> tuple:
                                dtype=_dtype(args.precision), label=label)
     pair, d3 = audit_schedule_pair(circuit, scheduled, args.devices,
                                    dtype=_dtype(args.precision), label=label)
+    d4: list = []
+    if plan is not None:
+        overlap, d4 = audit_overlap(scheduled, args.devices,
+                                    plan.pipeline_chunks,
+                                    dtype=_dtype(args.precision),
+                                    label=label)
+        report["overlap_audit"] = overlap
     report["dispatch_audit"] = audit
     report["hlo_pair"] = {k: pair[k]
                           for k in ("unscheduled_hlo", "scheduled_hlo")}
     echo(f"{label}: verify-schedule " + json.dumps(report, default=float))
-    return report, found + d2 + d3
+    return report, found + d2 + d3 + d4
 
 
 def main(argv=None) -> int:
@@ -131,6 +155,14 @@ def main(argv=None) -> int:
                         dest="verify_schedule",
                         help="translation-validate each circuit's scheduled "
                              "rewrite and audit the lowered dispatch path")
+    parser.add_argument("--overlap-chunks", type=int, default=None,
+                        dest="overlap_chunks", metavar="C",
+                        help="schedule with the pipelined executor's "
+                             "overlap plan at C chunks per shard "
+                             "(parallel/executor.py); the schedule report "
+                             "grows overlapped model columns and "
+                             "--verify-schedule proves the chunking "
+                             "layout-only and audits the compiled program")
     parser.add_argument("--devices", type=int, default=1,
                         help="mesh size for the deployment model (default 1)")
     parser.add_argument("--precision", type=int, default=1, choices=(1, 2),
@@ -178,9 +210,10 @@ def main(argv=None) -> int:
                                 chip=_chip(args.chip),
                                 hints=not args.no_hints)
         found += check_abstract_eval(circuit, dtype=_dtype(args.precision))
-        if args.schedule or args.verify_schedule:
+        if args.schedule or args.verify_schedule or args.overlap_chunks:
             scheduled = circuit.schedule(args.devices, chip=_chip(args.chip),
-                                         precision=args.precision)
+                                         precision=args.precision,
+                                         pipeline_chunks=args.overlap_chunks)
             report, extra = _schedule_report(label, circuit, args, scheduled,
                                              echo)
             doc["schedule"].append(report)

@@ -120,6 +120,13 @@ def _check_memory(circuit, num_devices: int, precision: int,
             detail=(f"{fp['peak_shard_bytes'] / 2**30:.1f} GiB working set "
                     f"per device vs {chip.hbm_bytes / 2**30:.1f} GiB HBM "
                     f"({chip.name} x{num_devices})")))
+    if fp["sub_tile_shard"]:
+        shard_amps = (1 << circuit.num_qubits) // num_devices
+        out.append(diag(
+            AnalysisCode.SUBTILE_SHARD, Severity.WARNING,
+            detail=(f"{shard_amps} amps/shard over {num_devices} devices "
+                    "(found-by-audit in the 9q x 8-device config: dense "
+                    "kernels charged the 'subtile' comm class)")))
 
 
 def _check_shard_fit(i: int, op, circuit, num_devices: int, out: list) -> None:

@@ -35,6 +35,7 @@ class AnalysisCode:
     UNKNOWN_GATE_KIND = "A_UNKNOWN_GATE_KIND"
     INVALID_BIT_PERMUTATION = "A_INVALID_BIT_PERMUTATION"
     SCHEDULE_COMM_REGRESSION = "A_SCHEDULE_COMM_REGRESSION"
+    OVERLAP_MODEL_REGRESSION = "A_OVERLAP_MODEL_REGRESSION"
     # eager-vs-compiled abstract-eval drift
     EAGER_COMPILED_DTYPE_MISMATCH = "A_EAGER_COMPILED_DTYPE_MISMATCH"
     EAGER_COMPILED_SHAPE_MISMATCH = "A_EAGER_COMPILED_SHAPE_MISMATCH"
@@ -47,6 +48,9 @@ class AnalysisCode:
     COLLECTIVE_COUNT_MISMATCH = "A_COLLECTIVE_COUNT_MISMATCH"
     UNEXPECTED_ALLGATHER = "A_UNEXPECTED_ALLGATHER"
     DONATION_UNUSED = "A_DONATION_UNUSED"
+    COLLECTIVE_NOT_OVERLAPPED = "A_COLLECTIVE_NOT_OVERLAPPED"
+    # deployment-shape projections (parallel/planner.py)
+    SUBTILE_SHARD = "A_SUBTILE_SHARD"
     # optimization hints
     ADJACENT_INVERSE_PAIR = "H_ADJACENT_INVERSE_PAIR"
     FUSABLE_1Q_RUN = "H_FUSABLE_1Q_RUN"
@@ -74,6 +78,12 @@ ANALYSIS_MESSAGES = {
         "The comm-aware scheduler produced a circuit the planner models as "
         "MORE communication than the input (collectives or bytes over ICI "
         "increased): a scheduler cost-model regression.",
+    AnalysisCode.OVERLAP_MODEL_REGRESSION:
+        "The overlap-aware time model predicts the pipelined executor "
+        "SLOWER than the serial schedule: chunking must never cost wall "
+        "time in the model (hideable events pipeline to max(compute, comm) "
+        "+ ramp; everything else stays serial), so this is an executor "
+        "cost-model regression.",
     AnalysisCode.EAGER_COMPILED_DTYPE_MISMATCH:
         "Eager and compiled paths disagree on the output dtype of this op; "
         "the two paths would produce numerically different states.",
@@ -108,6 +118,18 @@ ANALYSIS_MESSAGES = {
         "A donate=True program compiled WITHOUT an input/output buffer "
         "alias: the donation is silently ignored and iteration pays a full "
         "extra state allocation per step.",
+    AnalysisCode.COLLECTIVE_NOT_OVERLAPPED:
+        "The compiled program issues a collective the overlap plan expected "
+        "to hide with NO async start/done separation around it: the "
+        "backend serialised communication against compute, so the "
+        "pipelined executor's chunking buys no wall time here (expected on "
+        "CPU meshes; a regression on TPU).",
+    AnalysisCode.SUBTILE_SHARD:
+        "Each per-device shard is smaller than one full 128-lane row: "
+        "kernel reshapes re-tile across devices even for gates the "
+        "wire-position comm model rates shard-local, so every dense gate "
+        "is charged the 'subtile' comm class. Use fewer devices (or more "
+        "qubits) so a shard holds at least one lane row.",
     AnalysisCode.ADJACENT_INVERSE_PAIR:
         "Adjacent gates on identical wires compose to the identity and can "
         "be cancelled.",

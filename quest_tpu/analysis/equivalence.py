@@ -57,7 +57,7 @@ import numpy as np
 
 from .diagnostics import AnalysisCode, Diagnostic, Severity, diag
 
-__all__ = ["check_equivalence", "verify_schedule"]
+__all__ = ["check_equivalence", "check_overlap_plan", "verify_schedule"]
 
 # dense windows: 2^10 x 2^10 complex is the largest matrix worth building
 _MAX_WINDOW_QUBITS = 10
@@ -763,13 +763,79 @@ def check_equivalence(before, after, *, eps: float = _EPS) -> list[Diagnostic]:
     return out
 
 
+def check_overlap_plan(circuit, plan) -> list[Diagnostic]:
+    """Prove an overlapped-executor chunking plan
+    (parallel/executor.py OverlapPlan) layout-only for ``circuit``.
+
+    The chunked lowering is equivalent by construction iff, per event, the
+    chunk bits are amplitude-index positions NO op of the window reads or
+    moves (slicing along an untouched bit commutes with every such op),
+    they lie below the sharded range (so slicing itself is shard-local),
+    and a 'pairwise' event really is the plain 1-target uncontrolled dense
+    exchange its shard_map engine implements.  A violated condition means
+    the chunk programs would compute a DIFFERENT state —
+    ``V_SEMANTICS_CHANGED``, same contract as the IR domains above."""
+    from ..parallel import planner as _planner
+    out: list[Diagnostic] = []
+    n = circuit.num_qubits
+    local_q = _planner.local_qubit_count(n, plan.num_devices)
+    for e in plan.events:
+        if not (0 <= e.start < e.stop <= len(circuit.ops)):
+            out.append(diag(AnalysisCode.SEMANTICS_CHANGED, Severity.ERROR,
+                            op_index=e.start,
+                            detail=(f"overlap plan window [{e.start}, "
+                                    f"{e.stop}) outside the op list")))
+            continue
+        window = circuit.ops[e.start:e.stop]
+        if e.kind == "pairwise":
+            op = window[0]
+            if not (len(window) == 1 and len(op.targets) == 1
+                    and not op.controls and op.targets[0] >= local_q
+                    and op.kind in ("matrix", "x", "y")):
+                out.append(diag(
+                    AnalysisCode.SEMANTICS_CHANGED, Severity.ERROR,
+                    op_index=e.start,
+                    detail=(f"pairwise overlap event on op[{e.start}] "
+                            f"({op.kind}, targets {op.targets}, controls "
+                            f"{op.controls}) is not a 1-target "
+                            "uncontrolled dense exchange")))
+            continue
+        used: set = set()
+        for op in window:
+            used |= set(op.targets) | set(op.controls)
+            if op.kind == "bitperm":
+                used |= {int(d) for d in op.matrix}
+        bad = sorted(b for b in e.chunk_bits
+                     if b in used or not 0 <= b < local_q)
+        if bad or len(set(e.chunk_bits)) != len(e.chunk_bits) \
+                or e.chunks != 1 << len(e.chunk_bits):
+            out.append(diag(
+                AnalysisCode.SEMANTICS_CHANGED, Severity.ERROR,
+                op_index=e.start,
+                detail=(f"overlap chunk bits {e.chunk_bits} of window "
+                        f"[{e.start}, {e.stop}) are not free shard-local "
+                        f"positions (window wires {tuple(sorted(used))}, "
+                        f"local range [0, {local_q}); offending {bad})")))
+    return out
+
+
 def verify_schedule(circuit, scheduled=None, num_devices: int | None = None,
                     **schedule_kwargs) -> list[Diagnostic]:
     """Schedule ``circuit`` (unless ``scheduled`` is given) and translation-
     validate the result.  The programmatic form of the CLI's
-    ``--verify-schedule`` and of ``QUEST_TPU_VALIDATE_SCHEDULE=1``."""
+    ``--verify-schedule`` and of ``QUEST_TPU_VALIDATE_SCHEDULE=1``.
+
+    ``overlap=True`` / ``pipeline_chunks=`` kwargs flow through to
+    :meth:`Circuit.schedule`; when the scheduled circuit carries an
+    overlapped-executor chunking plan, the plan is additionally proven
+    layout-only (:func:`check_overlap_plan`) so the chunked lowering is
+    covered by the same proof as the IR rewrite."""
     if scheduled is None:
         if num_devices is None:
             raise ValueError("verify_schedule needs scheduled= or num_devices=")
         scheduled = circuit.schedule(num_devices, **schedule_kwargs)
-    return check_equivalence(circuit, scheduled)
+    out = check_equivalence(circuit, scheduled)
+    plan = getattr(scheduled, "_overlap_plan", None)
+    if plan is not None:
+        out += check_overlap_plan(scheduled, plan)
+    return out

@@ -55,7 +55,8 @@ import numpy as np
 from .diagnostics import AnalysisCode, Diagnostic, Severity, diag
 
 __all__ = ["count_jaxpr_collectives", "count_hlo_collectives",
-           "donation_aliased", "audit_dispatch", "audit_schedule_pair"]
+           "count_hlo_async_collectives", "donation_aliased",
+           "audit_dispatch", "audit_schedule_pair", "audit_overlap"]
 
 # how many HLO collectives one planner comm event may legitimately lower
 # to: a pairwise exchange spells as an (all-gather, all-reduce) partial-sum
@@ -156,12 +157,53 @@ def donation_aliased(compiled_text: str) -> bool:
     return "input_output_alias" in compiled_text
 
 
+def count_hlo_async_collectives(compiled_text: str) -> dict:
+    """``{"starts": S, "separated": K}`` — async collective ``*-start``
+    instructions in compiled HLO, and how many have at least one
+    NON-COLLECTIVE instruction scheduled between the start and its own
+    ``*-done``: the separation is where the backend can hop gate compute
+    onto the chip while the chunk is on the wire.  ``separated == 0`` with
+    hideable events planned is the ``A_COLLECTIVE_NOT_OVERLAPPED``
+    signal.
+
+    Each start is paired with the done that CONSUMES its result id (the
+    token left of ``=``) when one is found, not merely the next ``-done``
+    line, and intervening start/done bookkeeping of other collectives
+    does not count as separation — a fully serialized interleaving like
+    ``start.1; start.2; done.1; done.2`` hides nothing and reports 0."""
+    lines = [ln for ln in compiled_text.splitlines() if "=" in ln]
+    starts = separated = 0
+    for i, ln in enumerate(lines):
+        if not any(f"{op}-start(" in ln for op in HLO_COLLECTIVES):
+            continue
+        starts += 1
+        lhs = ln.split("=", 1)[0].strip()
+        result_id = lhs.split()[-1] if lhs else ""
+        done_at = None
+        for j in range(i + 1, len(lines)):
+            if "-done(" in lines[j] and (not result_id
+                                         or result_id in lines[j]):
+                done_at = j
+                break
+        if done_at is None:  # no id-matched done: fall back to the next one
+            for j in range(i + 1, len(lines)):
+                if "-done(" in lines[j]:
+                    done_at = j
+                    break
+        if done_at is None:
+            continue
+        if any("-start(" not in b and "-done(" not in b
+               for b in lines[i + 1:done_at]):
+            separated += 1
+    return {"starts": starts, "separated": separated}
+
+
 # ---------------------------------------------------------------------------
 # the audit
 # ---------------------------------------------------------------------------
 
 def audit_dispatch(circuit, num_devices: int = 1, *, dtype=None,
-                   donate: bool = True,
+                   donate: bool = True, pipeline_chunks: int = 1,
                    label: str = "circuit") -> tuple[dict, list[Diagnostic]]:
     """Audit the lowered dispatch path of ``circuit`` against the planner's
     comm model for an ``num_devices``-way amplitude mesh.
@@ -170,7 +212,10 @@ def audit_dispatch(circuit, num_devices: int = 1, *, dtype=None,
     against a real mesh when the process has ``num_devices`` devices
     (CI uses the 8-virtual-device CPU mesh), cross-checking the state-sized
     collective count against ``planner.comm_summary`` and auditing buffer
-    donation.  Returns ``(report, diagnostics)``."""
+    donation.  ``pipeline_chunks`` widens the per-event lowering bound: a
+    program executed through the chunked overlapped executor legitimately
+    lowers each planned comm event to up to C chunk-sized collectives.
+    Returns ``(report, diagnostics)``."""
     import jax
     import jax.numpy as jnp
     from ..circuit import _run_ops_routed
@@ -208,7 +253,8 @@ def audit_dispatch(circuit, num_devices: int = 1, *, dtype=None,
 
     text = _compiled_text(circuit, num_devices, dtype, donate)
     shard_amps = (1 << n) // num_devices
-    hlo = count_hlo_collectives(text, min_elems=shard_amps // 2)
+    hlo = count_hlo_collectives(
+        text, min_elems=shard_amps // (2 * max(1, pipeline_chunks)))
     measured = sum(hlo.values())
     report["hlo_collectives"] = hlo
     report["donation_aliased"] = donation_aliased(text)
@@ -219,14 +265,15 @@ def audit_dispatch(circuit, num_devices: int = 1, *, dtype=None,
             detail=(f"{label}: planner models this circuit comm-free on "
                     f"{num_devices} devices but the compiled program moves "
                     f"state-sized data: {hlo}")))
-    elif measured > _HLO_OPS_PER_EVENT * predicted["comm_events"]:
+    elif measured > (_HLO_OPS_PER_EVENT * max(1, pipeline_chunks)
+                     * predicted["comm_events"]):
         out.append(diag(
             AnalysisCode.COLLECTIVE_COUNT_MISMATCH, Severity.WARNING,
             detail=(f"{label}: compiled HLO has {measured} state-sized "
                     f"collectives ({hlo}) vs {predicted['comm_events']} "
                     f"planner-predicted comm events (> "
-                    f"{_HLO_OPS_PER_EVENT}x: the model undercosts this "
-                    "circuit)")))
+                    f"{_HLO_OPS_PER_EVENT * max(1, pipeline_chunks)}x: the "
+                    "model undercosts this circuit)")))
 
     if donate and not report["donation_aliased"]:
         out.append(diag(
@@ -299,4 +346,80 @@ def audit_schedule_pair(circuit, scheduled, num_devices: int, *,
             detail=(f"{label}: scheduling INCREASED compiled state-sized "
                     f"collectives {sum(before.values())} -> "
                     f"{sum(after.values())} ({before} -> {after})")))
+    return report, out
+
+
+def audit_overlap(circuit, num_devices: int, pipeline_chunks: int, *,
+                  dtype=None,
+                  label: str = "overlap") -> tuple[dict, list[Diagnostic]]:
+    """Audit the PIPELINED executor's compiled program
+    (parallel/executor.py) against its own overlap plan.
+
+    Compiles ``circuit`` through ``overlapped_program`` on the real mesh
+    (when the process has the devices) and checks:
+
+    - the chunk-sized collective count stays within the widened per-event
+      bound (``_HLO_OPS_PER_EVENT x C`` per planned event —
+      ``A_COLLECTIVE_COUNT_MISMATCH`` beyond it);
+    - every collective the plan expects to HIDE shows async start/done
+      separation in the compiled HLO; none at all is
+      ``A_COLLECTIVE_NOT_OVERLAPPED`` (WARNING — expected on CPU meshes,
+      whose backend runs collectives synchronously; a regression on TPU).
+
+    Host + compile work only; nothing executes."""
+    import jax
+    import jax.numpy as jnp
+    from ..parallel import executor as _exec
+
+    dtype = dtype or jnp.float32
+    plan = getattr(circuit, "_overlap_plan", None)
+    if plan is None or plan.pipeline_chunks != pipeline_chunks \
+            or plan.num_devices != num_devices:
+        plan = _exec.plan_overlap(circuit, num_devices, pipeline_chunks)
+    report: dict = {
+        "label": label, "num_devices": num_devices,
+        "pipeline_chunks": pipeline_chunks,
+        "planned_events": len(plan.events),
+        "chunked_events": sum(1 for e in plan.events if e.chunks > 1),
+        "hideable_events": sum(1 for e in plan.events if e.hideable),
+        "hlo_collectives": None, "hlo_async": None,
+    }
+    out: list[Diagnostic] = []
+    if num_devices <= 1 or len(jax.devices()) < num_devices:
+        return report, out
+    from ..parallel import planner as _planner
+    fn = _exec.overlapped_program(circuit, num_devices, pipeline_chunks)
+    from ..parallel.mesh import amp_sharding, make_amps_mesh
+    sharding = amp_sharding(make_amps_mesh(jax.devices()[:num_devices]))
+    spec = jax.ShapeDtypeStruct((2, 1 << circuit.num_qubits), dtype,
+                                sharding=sharding)
+    text = fn.lower(spec).compile().as_text()
+    shard_amps = (1 << circuit.num_qubits) // num_devices
+    hlo = count_hlo_collectives(
+        text, min_elems=shard_amps // (2 * max(1, pipeline_chunks)))
+    async_counts = count_hlo_async_collectives(text)
+    report["hlo_collectives"] = hlo
+    report["hlo_async"] = async_counts
+    measured = sum(hlo.values())
+    predicted = _planner.comm_summary(
+        circuit, num_devices,
+        bytes_per_amp=8 if jnp.dtype(dtype) == jnp.float32 else 16)
+    bound = (_HLO_OPS_PER_EVENT * max(1, pipeline_chunks)
+             * predicted["comm_events"])
+    if measured > bound:
+        out.append(diag(
+            AnalysisCode.COLLECTIVE_COUNT_MISMATCH, Severity.WARNING,
+            detail=(f"{label}: overlapped program compiles to {measured} "
+                    f"chunk-sized collectives ({hlo}) vs a bound of "
+                    f"{bound} for {predicted['comm_events']} planned "
+                    f"events x {pipeline_chunks} chunks")))
+    if report["hideable_events"] and any(e.chunks > 1 and e.hideable
+                                         for e in plan.events) \
+            and async_counts["separated"] == 0:
+        out.append(diag(
+            AnalysisCode.COLLECTIVE_NOT_OVERLAPPED, Severity.WARNING,
+            detail=(f"{label}: {report['hideable_events']} event(s) "
+                    f"planned as hidden but the compiled HLO shows "
+                    f"{async_counts['starts']} async start(s) with zero "
+                    "start/done separation")))
     return report, out

@@ -202,9 +202,16 @@ class Circuit:
         by the ICI time model (parallel/planner.py) may relabel the circuit.
         Returns a NEW equivalent Circuit; ``self`` is unmodified.
 
+        ``schedule(..., overlap=True, pipeline_chunks=C)`` attaches the
+        pipelined executor's chunking plan (parallel/executor.py) so
+        ``compile_circuit(..., overlap=True)`` double-buffers chunked
+        collectives against gate compute; chunking is layout-only and the
+        op list is unchanged.
+
         Inputs are validated with the runtime layer's codes: a bad
         ``num_devices`` (non-integer, < 1, or not a power of two) raises
-        ``E_INVALID_NUM_RANKS`` and an unknown keyword raises
+        ``E_INVALID_NUM_RANKS`` and an unknown keyword or a
+        non-power-of-two ``pipeline_chunks`` raises
         ``E_INVALID_SCHEDULE_OPTION``.  Set
         ``QUEST_TPU_VALIDATE_SCHEDULE=1`` to translation-validate every
         scheduled circuit against its input (analysis/equivalence.py); see
@@ -331,7 +338,8 @@ def _donated_program(ops: tuple):
 
 
 def compile_circuit(circuit: Circuit, donate: bool = False,
-                    num_devices: int | None = None):
+                    num_devices: int | None = None, overlap: bool = False,
+                    pipeline_chunks: int | None = None):
     """Return a jitted ``state -> state`` applying the whole circuit as one
     XLA program.  ``donate=True`` reuses the input buffer (allocation-free
     iteration) — callers must not hold other references to the state; the
@@ -339,7 +347,29 @@ def compile_circuit(circuit: Circuit, donate: bool = False,
     ``num_devices`` runs the comm-aware scheduler first
     (:meth:`Circuit.schedule`): the compiled program is the scheduled,
     collective-minimised equivalent for an ``num_devices``-way amplitude
-    mesh."""
+    mesh.
+
+    ``overlap=True`` (implied by ``pipeline_chunks``) additionally lowers
+    the scheduled circuit through the pipelined executor
+    (parallel/executor.py): every cross-shard collective is split into
+    ``pipeline_chunks`` independent chunked collectives issued while the
+    gate run computes the previous chunk, so XLA's async start/done
+    scheduling hides ICI time behind HBM/MXU work.  Requires
+    ``num_devices``; a bad chunk count raises
+    ``E_INVALID_SCHEDULE_OPTION``.  Overlapped programs carry a device
+    mesh and are NOT cached on ``circuit.key()`` — hold on to the returned
+    function."""
+    if overlap or pipeline_chunks is not None:
+        from .validation import MESSAGES, ErrorCode, QuESTError
+        if num_devices is None:
+            raise QuESTError(
+                ErrorCode.INVALID_SCHEDULE_OPTION,
+                MESSAGES[ErrorCode.INVALID_SCHEDULE_OPTION]
+                + " overlap=True requires num_devices=.", "compile_circuit")
+        from .parallel import executor as _exec
+        circuit = circuit.schedule(num_devices, overlap=True,
+                                   pipeline_chunks=pipeline_chunks)
+        return _exec.overlapped_program(circuit, num_devices, donate=donate)
     if num_devices is not None and num_devices > 1:
         circuit = circuit.schedule(num_devices)
     ops = circuit.key()

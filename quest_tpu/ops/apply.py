@@ -882,9 +882,9 @@ def _perm_cycles(mapping: dict) -> list:
     return cycles
 
 
-@partial(jax.jit, static_argnames=("wires", "dests"))
+@partial(jax.jit, static_argnames=("wires", "dests", "allow_minor"))
 def apply_bit_permutation(state: jax.Array, wires: tuple,
-                          dests: tuple) -> jax.Array:
+                          dests: tuple, allow_minor: bool = False) -> jax.Array:
     """Move the amplitude-index bit at position ``wires[i]`` to position
     ``dests[i]`` — the scheduler's fused permutation op (epoch boundaries,
     fused swap networks, placement boundaries; parallel/scheduler.py).
@@ -896,7 +896,11 @@ def apply_bit_permutation(state: jax.Array, wires: tuple,
     collective per swap (the comm the scheduler exists to save).  Positions
     inside the minor (lane/sublane) blocks cannot be transposed without
     breaking the (8, 128) tile, so such permutations fall back to pairwise
-    swaps through the matrix engine."""
+    swaps through the matrix engine — unless ``allow_minor``, which forces
+    the single-transpose form at any position (the overlapped executor's
+    chunk programs run on sub-tile-sized slices already, where a per-swap
+    collective chain would multiply the very comm the chunking pipelines;
+    parallel/executor.py)."""
     n = num_qubits_of(state)
     wires = tuple(int(w) for w in wires)
     dests = tuple(int(d) for d in dests)
@@ -914,6 +918,13 @@ def apply_bit_permutation(state: jax.Array, wires: tuple,
         for w, d in mapping.items():
             # the output axis indexing bit d carries the input axis of bit w
             axes[1 + axis_of[d]] = 1 + axis_of[w]
+        return jnp.transpose(t, axes).reshape(2, -1)
+    if allow_minor:
+        # fully-factorised view: bit b is axis 1 + (n - 1 - b)
+        t = state.reshape((2,) + (2,) * n)
+        axes = list(range(t.ndim))
+        for w, d in mapping.items():
+            axes[1 + (n - 1 - d)] = 1 + (n - 1 - w)
         return jnp.transpose(t, axes).reshape(2, -1)
     for cyc in _perm_cycles(mapping):
         # content a1 -> a2 -> ... -> ak -> a1 via swaps (a1,a2),(a1,a3),...

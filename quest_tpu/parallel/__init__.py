@@ -18,13 +18,20 @@ levels:
 4. optimizer — :mod:`.scheduler` consumes the planner's cost model to
    REWRITE circuits: commutation-DAG reordering, permutation epochs, fused
    swap networks and a greedy placement search (Circuit.schedule /
-   compile_circuit(num_devices=...), docs/SCHEDULER.md).
+   compile_circuit(num_devices=...), docs/SCHEDULER.md);
+5. pipelined — :mod:`.executor` lowers the scheduled circuit with every
+   cross-shard collective chunked and double-buffered against gate compute
+   (compile_circuit(..., overlap=True), docs/SCHEDULER.md "Pipelined
+   execution").
 """
 
 from .mesh import make_amps_mesh, amp_sharding, replicated_sharding  # noqa: F401
 from .collectives import (pairwise_exchange, global_sum,  # noqa: F401
                           gather_full_state)
 from .planner import (comm_plan, comm_summary, is_shard_local,  # noqa: F401
-                      local_qubit_count, time_model)
+                      local_qubit_count, recommend_pipeline_chunks,
+                      sub_tile_shard, time_model)
 from .scheduler import (commutation_dag, greedy_placement,  # noqa: F401
                         schedule, schedule_savings)
+from .executor import (overlapped_program, plan_overlap,  # noqa: F401
+                       predict_overlap)

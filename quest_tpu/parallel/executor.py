@@ -1,0 +1,481 @@
+"""Pipelined cross-shard execution engine: chunked collectives
+double-buffered against gate compute.
+
+The reference's distributed path fully serializes pairwise exchange and
+gate arithmetic (QuEST_cpu_distributed.c: exchangeStateVectors completes,
+*then* the compute loop runs), and our default compiled path inherits the
+same structure — each scheduler-emitted boundary permutation lowers to one
+monolithic state-sized collective the chip sits idle behind.  This module
+restructures the program so XLA *can* hide ICI time behind HBM/MXU work,
+the canonical TPU optimization: every comm-carrying event is split into C
+independent per-chunk sub-programs, so the collective for chunk i+1 has no
+data dependence on the gate run over chunk i and the compiler's async
+collective start/done scheduling interleaves them.
+
+Two chunking engines, planned statically by :func:`plan_overlap`:
+
+1. **Pairwise shard_map engine** (``kind='pairwise'``).  A dense 1-target
+   gate on a sharded wire is the reference's MPI_Sendrecv exchange.  Here
+   it is lowered explicitly through ``shard_map``: the per-shard state is
+   split into C contiguous chunks, each chunk's partner half rides its own
+   ``lax.ppermute``, and the gate's combine arithmetic
+   (``out = u[b,b]*mine + u[b,1-b]*theirs`` on device bit ``b``) executes
+   on chunk i while chunk i+1 permutes.
+
+2. **Window slicing engine** (``kind='window'``).  A boundary ``bitperm``
+   (and, when the scheduler emitted an epoch sandwich
+   ``bitperm . gates . bitperm``, the WHOLE sandwich) is chunked along
+   amplitude-index bits its ops never touch: fixing those bits slices the
+   state into C interleaved sub-states on which the window acts
+   independently, so chunking is *layout-only* — each chunk runs the
+   wire-renumbered window through the ordinary engines and GSPMD lowers
+   one 1/C-sized all-to-all per chunk instead of one monolithic reshard.
+
+An event with no free chunk bits (or no compute to hide — a lone
+comm-dominated reshard) stays monolithic; the planner's overlap-aware cost
+(:class:`planner.GateTime`) and :func:`predict_overlap` charge it serially,
+and the lowered-program audit (analysis/jaxpr_audit.py) reports
+``A_COLLECTIVE_NOT_OVERLAPPED`` when a collective the plan expected to
+hide compiles without async start/done separation.
+
+Entry points: ``compile_circuit(..., num_devices=, overlap=True)``,
+``Circuit.schedule(..., overlap=True, pipeline_chunks=C)`` (kwargs
+validated through ``E_INVALID_SCHEDULE_OPTION``), and
+:func:`overlapped_program` / :func:`predict_overlap` for direct use.
+See docs/SCHEDULER.md "Pipelined execution".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import planner as _planner
+
+__all__ = ["ChunkedEvent", "OverlapPlan", "plan_overlap",
+           "overlapped_program", "predict_overlap",
+           "validate_pipeline_chunks"]
+
+
+def validate_pipeline_chunks(pipeline_chunks, func=None) -> int:
+    """A chunk count must be a power-of-two int >= 1 (the chunk axis halves
+    the shard's amplitude index like the mesh halves the global one);
+    anything else raises the validation layer's
+    ``E_INVALID_SCHEDULE_OPTION``."""
+    from ..validation import MESSAGES, ErrorCode, QuESTError
+    c = pipeline_chunks
+    if (isinstance(c, int) and not isinstance(c, bool) and c >= 1
+            and (c & (c - 1)) == 0):
+        return c
+    raise QuESTError(
+        ErrorCode.INVALID_SCHEDULE_OPTION,
+        MESSAGES[ErrorCode.INVALID_SCHEDULE_OPTION]
+        + f" pipeline_chunks must be a power-of-two integer >= 1, got "
+        f"{pipeline_chunks!r}.", func or "schedule")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChunkedEvent:
+    """One comm event the executor pipelines: ops ``[start, stop)`` of the
+    scheduled circuit run chunked.  ``chunk_bits`` are the amplitude-index
+    bit positions sliced into the chunk axis ('window' engine; empty for
+    'pairwise', which splits the shard contiguously); ``chunks`` is the
+    effective per-event chunk count after clamping to the free bits."""
+    start: int
+    stop: int
+    kind: str          # 'pairwise' | 'window'
+    chunk_bits: tuple
+    chunks: int
+    comm: str          # planner comm class of the event
+    hideable: bool     # does compute exist for the pipeline to hide comm?
+
+
+@dataclasses.dataclass(frozen=True)
+class OverlapPlan:
+    num_qubits: int
+    num_devices: int
+    pipeline_chunks: int
+    events: tuple
+
+    def event_at(self, i: int):
+        for e in self.events:
+            if e.start == i:
+                return e
+        return None
+
+
+def _op_used_bits(op) -> set:
+    """Every amplitude-index bit position an op reads or writes non-trivially
+    (bitperm destinations included: its payload names positions, not data)."""
+    used = set(op.targets) | set(op.controls)
+    if op.kind == "bitperm":
+        used |= {int(d) for d in op.matrix}
+    return used
+
+
+def plan_overlap(circuit, num_devices: int, pipeline_chunks: int) -> OverlapPlan:
+    """Static chunking plan for ``circuit`` over an ``num_devices``-way
+    amplitude mesh: walk the planner's comm plan and, per comm event,
+    choose an engine and the chunk bits.  Pure host work; the plan is part
+    of the compiled program's static structure and is what
+    ``analysis.equivalence.check_overlap_plan`` proves layout-only."""
+    c_total = validate_pipeline_chunks(pipeline_chunks, "plan_overlap")
+    n = circuit.num_qubits
+    local_q = _planner.local_qubit_count(n, num_devices)
+    events: list = []
+    if num_devices <= 1 or local_q <= 0:
+        return OverlapPlan(n, num_devices, c_total, ())
+    plans = _planner.comm_plan(circuit, num_devices)
+    ops = circuit.ops
+    shard_amps = (1 << n) // num_devices
+    want_bits = (c_total - 1).bit_length()  # log2
+    i = 0
+    while i < len(ops):
+        if plans[i].comm == "none":
+            i += 1
+            continue
+        op = ops[i]
+        if (plans[i].comm == "permute" and op.kind in ("matrix", "x", "y")
+                and len(op.targets) == 1 and not op.controls
+                and op.targets[0] >= local_q and c_total <= shard_amps):
+            events.append(ChunkedEvent(i, i + 1, "pairwise", (), c_total,
+                                       plans[i].comm, True))
+            i += 1
+            continue
+        # window engine: a lone collective op, or — when this is a
+        # scheduler epoch bracket — the whole bitperm.gates.bitperm
+        # sandwich, whose interior compute the chunk pipeline then hides
+        stop = i + 1
+        hideable = False
+        if op.kind == "bitperm":
+            j = i + 1
+            while j < len(ops) and plans[j].comm == "none":
+                j += 1
+            if j < len(ops) and j > i + 1 and ops[j] == op:
+                stop = j + 1
+                hideable = True
+        used: set = set()
+        for w_op in ops[i:stop]:
+            used |= _op_used_bits(w_op)
+        free = [b for b in range(local_q - 1, -1, -1) if b not in used]
+        from ..ops.apply import _blocks
+        lo = sum(_blocks(n))
+        # prefer tile-aligned prefix bits; minor bits still slice correctly
+        free = [b for b in free if b >= lo] + [b for b in free if b < lo]
+        c_bits = min(want_bits, len(free))
+        bits = tuple(sorted(free[:c_bits], reverse=True))
+        events.append(ChunkedEvent(i, stop, "window", bits, 1 << c_bits,
+                                   plans[i].comm, hideable))
+        i = stop
+    return OverlapPlan(n, num_devices, c_total, tuple(events))
+
+
+# ---------------------------------------------------------------------------
+# engine 1: explicit shard_map pairwise exchange, chunk-pipelined
+# ---------------------------------------------------------------------------
+
+def _pair_matrix(op) -> np.ndarray:
+    if op.kind == "matrix":
+        return op.payload()
+    if op.kind == "x":
+        return np.stack([np.array([[0.0, 1.0], [1.0, 0.0]]),
+                         np.zeros((2, 2))])
+    if op.kind == "y":
+        return np.stack([np.zeros((2, 2)),
+                         np.array([[0.0, -1.0], [1.0, 0.0]])])
+    raise ValueError(f"pairwise engine cannot lower kind {op.kind!r}")
+
+
+def _pairwise_overlapped(state: jax.Array, op, mesh, chunks: int) -> jax.Array:
+    """Dense 1-target gate on a sharded wire as C chunked explicit
+    exchanges: the reference's MPI_Sendrecv path
+    (QuEST_cpu_distributed.c:479 exchangeStateVectors +
+    statevec_unitaryDistributed), except each ``lax.ppermute`` carries one
+    chunk and the combine FMA of chunk i overlaps chunk i+1's wire time."""
+    from .._compat import shard_map
+    from ..ops.apply import num_qubits_of
+    from .mesh import AMPS_AXIS
+    from jax.sharding import PartitionSpec as P
+
+    n = num_qubits_of(state)
+    n_dev = mesh.devices.size
+    local_q = _planner.local_qubit_count(n, n_dev)
+    d = op.targets[0] - local_q
+    perm = [(r, r ^ (1 << d)) for r in range(n_dev)]
+    u = jnp.asarray(_pair_matrix(op), state.dtype)
+
+    @partial(shard_map, mesh=mesh, in_specs=P(None, AMPS_AXIS),
+             out_specs=P(None, AMPS_AXIS))
+    def run(shard):
+        rank = jax.lax.axis_index(AMPS_AXIS)
+        b = (rank >> d) & 1
+        # row b of u makes OUR half: out = u[b,b]*mine + u[b,1-b]*theirs
+        urr, uri = u[0, b, b], u[1, b, b]
+        upr, upi = u[0, b, 1 - b], u[1, b, 1 - b]
+        csz = shard.shape[1] // chunks
+        pieces = []
+        for k in range(chunks):
+            mine = jax.lax.slice_in_dim(shard, k * csz, (k + 1) * csz, axis=1)
+            theirs = jax.lax.ppermute(mine, AMPS_AXIS, perm)
+            re = (urr * mine[0] - uri * mine[1]
+                  + upr * theirs[0] - upi * theirs[1])
+            im = (urr * mine[1] + uri * mine[0]
+                  + upr * theirs[1] + upi * theirs[0])
+            pieces.append(jnp.stack([re, im]))
+        return jnp.concatenate(pieces, axis=1)
+
+    return run(state)
+
+
+# ---------------------------------------------------------------------------
+# engine 2: window slicing along untouched bits (layout-only chunking)
+# ---------------------------------------------------------------------------
+
+def _renumber(bits: tuple, n: int) -> dict:
+    """Wire map of the reduced index space after slicing out ``bits``."""
+    removed = set(bits)
+    return {q: q - sum(1 for b in bits if b < q)
+            for q in range(n) if q not in removed}
+
+
+def _controlled_payload(op) -> np.ndarray:
+    """(2, 2^m, 2^m) real pair of ``op`` over its FULL wire list (targets
+    LSB-first, then controls): controls embedded as identity blocks, the
+    same local convention as analysis/equivalence.py's oracle."""
+    p = op.payload()
+    if not op.controls:
+        return p
+    k = len(op.targets)
+    m = k + len(op.controls)
+    cs = [int(s) for s in (op.control_states or (1,) * len(op.controls))]
+    base = p[0] + 1j * p[1]
+    full = np.zeros((1 << m, 1 << m), dtype=complex)
+    for col in range(1 << m):
+        if not all(((col >> (k + j)) & 1) == s for j, s in enumerate(cs)):
+            full[col, col] = 1.0
+            continue
+        rest = col >> k << k
+        for row_sub in range(1 << k):
+            full[rest | row_sub, col] = base[row_sub, col & ((1 << k) - 1)]
+    return np.stack([full.real, full.imag])
+
+
+def _apply_dense_invariant(state: jax.Array, op) -> jax.Array:
+    """Dense gate with CHUNK-INVARIANT arithmetic: the wire axes are moved
+    to the front and contracted as one fixed-order complex matmul, so the
+    per-amplitude FMA sequence is identical at every reduced state size.
+    The ordinary engines pick reroutes and tile groupings by absolute wire
+    position — mathematically equal but floating-point DIFFERENT summation
+    orders — which would break the executor's bit-identical-across-C
+    contract (tests/test_executor.py)."""
+    from ..ops.apply import num_qubits_of
+    n = num_qubits_of(state)
+    wires = op.targets + op.controls
+    m = len(wires)
+    p = _controlled_payload(op)
+    ur = jnp.asarray(p[0], state.dtype)
+    ui = jnp.asarray(p[1], state.dtype)
+    t = state.reshape((2,) + (2,) * n)
+    # payload bit j indexes wires[j] (LSB-first): axis order MSB-first
+    src = tuple(1 + (n - 1 - q) for q in reversed(wires))
+    dst = tuple(range(1, m + 1))
+    t = jnp.moveaxis(t, src, dst)
+    shape = t.shape
+    t = t.reshape(2, 1 << m, -1)
+    xr, xi = t[0], t[1]
+    out = jnp.stack([ur @ xr - ui @ xi, ur @ xi + ui @ xr])
+    return jnp.moveaxis(out.reshape(shape), dst, src).reshape(2, -1)
+
+
+def _apply_reduced(state: jax.Array, op) -> jax.Array:
+    from ..circuit import _apply_one
+    if op.kind == "bitperm":
+        # chunk slices renumber wires below the tile boundary; force the
+        # single-transpose form so the chunked collective stays ONE
+        # all-to-all instead of a per-swap chain (apply.py allow_minor)
+        from ..ops.apply import apply_bit_permutation
+        return apply_bit_permutation(
+            state, op.targets, tuple(int(x) for x in op.matrix),
+            allow_minor=True)
+    if op.kind == "matrix":
+        return _apply_dense_invariant(state, op)
+    # every other kind is per-amplitude movement / single-multiply work,
+    # which rounds identically at any reduced size
+    return _apply_one(state, op)
+
+
+def _window_chunked(state: jax.Array, window_ops: tuple,
+                    chunk_bits: tuple) -> jax.Array:
+    """Run ``window_ops`` as 2^len(chunk_bits) independent sub-programs,
+    one per assignment of the (untouched) chunk bits.  Exact by
+    construction: ops that never read or move a bit act identically on
+    each slice along it, so this is a pure re-layout of the monolithic
+    program — the property ``analysis.equivalence.check_overlap_plan``
+    certifies per event."""
+    from ..ops.apply import num_qubits_of
+    from ..parallel.scheduler import _relabel_op
+
+    n = num_qubits_of(state)
+    if not chunk_bits:
+        for op in window_ops:
+            state = _apply_reduced(state, op)
+        return state
+    c = len(chunk_bits)
+    bits = tuple(sorted(chunk_bits, reverse=True))  # MSB-first, like dims
+    shift = _renumber(bits, n)
+    reduced = [_relabel_op(op, shift) for op in window_ops]
+    t = state.reshape((2,) + (2,) * n)
+    chunk_axes = tuple(1 + (n - 1 - b) for b in bits)
+    keep_shape = tuple(dim for a, dim in enumerate(t.shape)
+                       if a not in chunk_axes)
+    outs = []
+    for k in range(1 << c):
+        idx: list = [slice(None)] * t.ndim
+        for j, ax in enumerate(chunk_axes):
+            idx[ax] = (k >> (c - 1 - j)) & 1
+        xk = t[tuple(idx)].reshape(2, -1)
+        for op in reduced:
+            xk = _apply_reduced(xk, op)
+        outs.append(xk.reshape(keep_shape))
+    stacked = jnp.stack(outs, axis=1).reshape((2,) + (2,) * c
+                                              + keep_shape[1:])
+    merged = jnp.moveaxis(stacked, tuple(range(1, c + 1)), chunk_axes)
+    return merged.reshape(2, -1)
+
+
+# ---------------------------------------------------------------------------
+# the executor
+# ---------------------------------------------------------------------------
+
+def _run_ops_overlapped(state: jax.Array, ops: tuple, plan: OverlapPlan,
+                        mesh) -> jax.Array:
+    from ..circuit import _apply_one
+    by_start = {e.start: e for e in plan.events}
+    i = 0
+    while i < len(ops):
+        e = by_start.get(i)
+        if e is None:
+            state = _apply_one(state, ops[i])
+            i += 1
+        elif e.kind == "pairwise":
+            state = _pairwise_overlapped(state, ops[i], mesh, e.chunks)
+            i = e.stop
+        else:
+            state = _window_chunked(state, ops[e.start:e.stop], e.chunk_bits)
+            i = e.stop
+    return state
+
+
+def overlapped_program(circuit, num_devices: int,
+                       pipeline_chunks: int | None = None, *,
+                       mesh=None, donate: bool = False):
+    """Jitted ``state -> state`` running ``circuit`` through the pipelined
+    executor on an ``num_devices``-way amplitude mesh.  Uses the overlap
+    plan ``Circuit.schedule(..., overlap=True)`` attached, else plans here
+    (``pipeline_chunks=None`` takes :func:`planner.recommend_pipeline_chunks`).
+    Output sharding is pinned to the mesh's amplitude sharding so trailing
+    permutations cannot be virtualised into an output-layout drift (the
+    bench.py pair methodology).  Overlapped programs are rebuilt per call —
+    they carry a mesh — so cache the returned function, not the circuit."""
+    from ..validation import MESSAGES, ErrorCode, QuESTError, \
+        validate_num_ranks
+    from .mesh import amp_sharding, make_amps_mesh
+    validate_num_ranks(num_devices, "overlapped_program")
+    plan = getattr(circuit, "_overlap_plan", None)
+    if plan is None or plan.num_devices != num_devices or (
+            pipeline_chunks is not None
+            and plan.pipeline_chunks != pipeline_chunks):
+        if pipeline_chunks is None:
+            pipeline_chunks = _planner.recommend_pipeline_chunks(
+                circuit.num_qubits, num_devices)
+        plan = plan_overlap(circuit, num_devices,
+                            validate_pipeline_chunks(pipeline_chunks,
+                                                     "overlapped_program"))
+    if mesh is None:
+        devices = jax.devices()
+        if len(devices) < num_devices:
+            raise QuESTError(
+                ErrorCode.INVALID_NUM_RANKS,
+                MESSAGES[ErrorCode.INVALID_NUM_RANKS]
+                + f" The overlapped executor needs {num_devices} devices; "
+                f"this process has {len(devices)}.", "overlapped_program")
+        mesh = make_amps_mesh(devices[:num_devices])
+    ops = circuit.key()
+
+    def run(state: jax.Array) -> jax.Array:
+        return _run_ops_overlapped(state, ops, plan, mesh)
+
+    return jax.jit(run, out_shardings=amp_sharding(mesh),
+                   donate_argnums=(0,) if donate else ())
+
+
+# ---------------------------------------------------------------------------
+# the overlap-aware cost report (planner prediction for bench/CI)
+# ---------------------------------------------------------------------------
+
+def predict_overlap(circuit, num_devices: int,
+                    pipeline_chunks: int | None = None, *,
+                    chip=None, precision: int = 1) -> dict:
+    """Event-level overlap prediction: for each planned event, serial cost
+    is the window's summed compute + comm; pipelined cost is
+    ``max(compute, comm) + min(compute, comm)/C`` (the per-chunk ramp) when
+    the event is hideable, serial otherwise (a lone comm-dominated reshard
+    has nothing to hide behind).  ``predicted_hidden_frac`` is the fraction
+    of total comm seconds the model expects hidden — the column bench.py
+    prints next to the measured delta."""
+    chip = chip or _planner.V5E
+    if pipeline_chunks is None:
+        plan = getattr(circuit, "_overlap_plan", None)
+        pipeline_chunks = (plan.pipeline_chunks if plan is not None
+                           else _planner.recommend_pipeline_chunks(
+                               circuit.num_qubits, num_devices, chip,
+                               precision))
+    c_total = validate_pipeline_chunks(pipeline_chunks, "predict_overlap")
+    plan = plan_overlap(circuit, num_devices, c_total)
+    times = _planner.time_model(circuit, num_devices, chip, precision)
+    by_start = {e.start: e for e in plan.events}
+    serial = overlapped = comm_total = 0.0
+    events_out = []
+    i = 0
+    while i < len(times):
+        e = by_start.get(i)
+        if e is None:
+            t = times[i]
+            serial += t.compute_s + t.comm_s
+            overlapped += t.compute_s + t.comm_s
+            comm_total += t.comm_s
+            i += 1
+            continue
+        span = times[e.start:e.stop]
+        comp = sum(t.compute_s for t in span)
+        comm = sum(t.comm_s for t in span)
+        serial += comp + comm
+        comm_total += comm
+        if e.hideable and e.chunks > 1:
+            cost = max(comp, comm) + min(comp, comm) / e.chunks
+        else:
+            cost = comp + comm
+        overlapped += cost
+        events_out.append({
+            "start": e.start, "stop": e.stop, "engine": e.kind,
+            "comm": e.comm, "chunks": e.chunks, "hideable": e.hideable,
+            "compute_s": comp, "comm_s": comm, "serial_s": comp + comm,
+            "overlapped_s": cost,
+        })
+        i = e.stop
+    return {
+        "num_devices": num_devices,
+        "pipeline_chunks": c_total,
+        "events": events_out,
+        "chunked_events": sum(1 for e in plan.events if e.chunks > 1),
+        "hideable_events": sum(1 for e in plan.events if e.hideable),
+        "model_seconds_serial": serial,
+        "model_seconds_overlapped": overlapped,
+        "model_comm_seconds": comm_total,
+        "predicted_hidden_frac": ((serial - overlapped) / comm_total
+                                  if comm_total else 0.0),
+    }

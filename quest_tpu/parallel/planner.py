@@ -39,9 +39,33 @@ class GatePlan:
     bytes_moved: int   # per device, one direction
 
 
+def sub_tile_shard(num_qubits: int, num_devices: int) -> bool:
+    """True iff each per-device shard is SMALLER than one full lane row
+    (2^l amps, the minor dim of the (8, 128) register tile).  In that
+    regime the wire-position comm model above is incomplete: the kernels'
+    grouped views keep the lane block as their minor axis, so a shard that
+    cannot hold one lane row re-tiles across devices on every reshape —
+    gates that are "local" by wire position still communicate (found by
+    the PR 3 lowered-program audit on a 9-qubit register over 8 devices:
+    64 amps/shard vs the 128-wide lane; a 512-amp 12q/8-device shard
+    holds whole lane rows and audits clean).  :func:`comm_plan` charges
+    such gates the ``subtile`` comm class and the analyzer emits a
+    WARNING (``A_SUBTILE_SHARD``)."""
+    from ..ops.apply import _blocks
+    if num_devices <= 1:
+        return False
+    lane = _blocks(num_qubits)[0]
+    return (1 << num_qubits) // num_devices < (1 << lane)
+
+
 def comm_plan(circuit, num_devices: int, bytes_per_amp: int = 8) -> list:
     """Static communication plan of a :class:`quest_tpu.Circuit` over an
-    n-device amplitude mesh.  ``bytes_per_amp`` defaults to f32 SoA (8 B)."""
+    n-device amplitude mesh.  ``bytes_per_amp`` defaults to f32 SoA (8 B).
+
+    On sub-tile shards (:func:`sub_tile_shard`) every dense-kind gate is
+    charged one extra shard pass as the ``subtile`` comm class, however
+    local its wires: below one register tile the layout itself is
+    interleaved across devices and reshapes communicate."""
     from ..ops.apply import _control_style
 
     n = circuit.num_qubits
@@ -111,6 +135,13 @@ def comm_plan(circuit, num_devices: int, bytes_per_amp: int = 8) -> list:
             extra = shard_amps * bytes_per_amp if ctrl_comm else 0
             plans.append(GatePlan(i, op.kind, op.targets, False, "reshard",
                                   2 * shard_amps * bytes_per_amp + extra))
+    if sub_tile_shard(n, num_devices):
+        # below one register tile, "local" dense kernels still re-tile
+        # across devices; diagonal/mrz stay elementwise broadcasts
+        for j, p in enumerate(plans):
+            if p.comm == "none" and p.kind not in ("diagonal", "mrz"):
+                plans[j] = GatePlan(p.index, p.kind, p.targets, False,
+                                    "subtile", shard_amps * bytes_per_amp)
     return plans
 
 
@@ -124,6 +155,7 @@ def comm_summary(circuit, num_devices: int, bytes_per_amp: int = 8) -> dict:
         "comm_events": sum(1 for p in plans if p.comm != "none"),
         "permute_events": sum(1 for p in plans if p.comm == "permute"),
         "reshard_events": sum(1 for p in plans if p.comm == "reshard"),
+        "subtile_events": sum(1 for p in plans if p.comm == "subtile"),
         "bytes_moved": sum(p.bytes_moved for p in plans),
     }
 
@@ -131,9 +163,10 @@ def comm_summary(circuit, num_devices: int, bytes_per_amp: int = 8) -> dict:
 # ---------------------------------------------------------------------------
 # ICI time model (SURVEY §7.5 / BASELINE north star)
 #
-# Extends the comm plan into wall-time estimates: per gate, t is the
-# midpoint of max(compute, comm) (perfect overlap) and compute + comm (no
-# overlap) — see GateTime.total_s — with compute as HBM-roofline passes at a MEASURED
+# Extends the comm plan into wall-time estimates: per gate, t is
+# compute + comm serially, or max(compute, comm) + the per-chunk ramp when
+# the overlapped executor pipelines the event (see GateTime.total_s) —
+# with compute as HBM-roofline passes at a MEASURED
 # efficiency (calibrated against the single-chip bench rows this model can
 # check), comm as bytes over ICI links.  Chip figures are the public specs
 # used by the scaling literature (jax-ml.github.io/scaling-book): per-chip
@@ -147,10 +180,20 @@ class ChipSpec:
     ici_link_bytes_per_sec: float  # one-way, per link
     ici_links: int                 # torus degree (v5e 2-D: 4, v5p 3-D: 6)
     hbm_bytes: float
+    vmem_bytes: float = 128 * 2**20  # on-chip vector memory (both gens)
 
 
 V5E = ChipSpec("v5e", 819e9, 4.5e10, 4, 16e9)
 V5P = ChipSpec("v5p", 2765e9, 9e10, 6, 95e9)
+
+# smallest per-chunk collective worth issuing: below ~this many seconds on
+# the wire a chunk is latency- not bandwidth-bound, and further splitting
+# stops buying overlap (the per-chunk ramp of GateTime.total_s grows
+# without shrinking the hidden span)
+_MIN_CHUNK_COMM_SECONDS = 4e-6
+# pipeline depth cap: beyond this the scheduling overhead (one async
+# start/done pair per chunk) outweighs the ramp reduction
+_MAX_PIPELINE_CHUNKS = 16
 
 # Measured single-chip HBM efficiency (achieved/peak) per engine class, from
 # the recorded bench rows (BENCH_r04/r05: hbm_peak_frac of the matching
@@ -189,6 +232,7 @@ def memory_footprint(num_qubits: int, num_devices: int = 1,
         "peak_shard_bytes": int(shard_bytes * transient_factor),
         "bytes_per_amp": bytes_per_amp,
         "devices": num_devices,
+        "sub_tile_shard": sub_tile_shard(n, num_devices),
     }
 
 
@@ -199,28 +243,45 @@ class GateTime:
     comm: str
     compute_s: float
     comm_s: float
+    pipeline_chunks: int = 1   # chunks the overlapped executor splits into
+    hideable: bool = False     # can the executor pipeline comm behind compute?
 
     @property
     def total_s(self) -> float:
-        # pairwise exchange and gate compute overlap poorly in the eager
-        # engine (the exchanged halves are needed before the arithmetic);
-        # max() models perfect overlap, + models none — report the midpoint
-        return max(self.compute_s, self.comm_s) * 0.5 + \
-            (self.compute_s + self.comm_s) * 0.5
+        # The executor fully serializes pairwise exchange and gate
+        # arithmetic unless pipelined (the exchanged halves gate the FMA) —
+        # so the base cost is the SUM, not the old optimistic midpoint.  A
+        # hideable event split into C chunks pipelines to
+        # max(compute, comm) plus the per-chunk ramp min(compute, comm)/C:
+        # the first chunk's collective has nothing yet to hide behind
+        # (parallel/executor.py; docs/SCHEDULER.md "Pipelined execution").
+        if self.hideable and self.pipeline_chunks > 1:
+            return max(self.compute_s, self.comm_s) + \
+                min(self.compute_s, self.comm_s) / self.pipeline_chunks
+        return self.compute_s + self.comm_s
 
 
 def time_model(circuit, num_devices: int, chip: ChipSpec = V5E,
                precision: int = 1,
-               efficiency: float | None = None) -> list:
+               efficiency: float | None = None,
+               pipeline_chunks: int = 1) -> list:
     """Per-gate wall-time estimates for ``circuit`` over an
     ``num_devices``-chip amplitude mesh of ``chip``s.
 
     compute = passes x 2 x shard_bytes / (hbm_bw x efficiency);
-    comm    = bytes_moved / ici_link_bw ('permute': the reference's pairwise
-    exchange — one partner, one link) or bytes_moved x (D-1)/D /
-    (links x ici_link_bw) ('reshard': all-to-all spread over the torus
-    links).  Efficiency defaults to the measured single-chip value for the
-    precision's engine class (MEASURED_EFFICIENCY)."""
+    comm    = bytes_moved / ici_link_bw ('permute'/'subtile': the
+    reference's pairwise exchange — one partner, one link) or bytes_moved
+    x (D-1)/D / (links x ici_link_bw) ('reshard': all-to-all spread over
+    the torus links).  Efficiency defaults to the measured single-chip
+    value for the precision's engine class (MEASURED_EFFICIENCY).
+
+    ``pipeline_chunks > 1`` models the overlapped executor
+    (parallel/executor.py): pairwise-exchange events on plain dense
+    targets are marked hideable and costed ``max(compute, comm)`` plus the
+    per-chunk ramp instead of the serial sum.  Window-level refinement
+    (epoch sandwiches hiding a whole bracketed run) lives in
+    :func:`quest_tpu.parallel.executor.predict_overlap`, which consumes
+    these per-gate figures."""
     from ..validation import validate_num_ranks
     validate_num_ranks(num_devices, "time_model")
     bytes_per_amp = 8 if precision == 1 else 16
@@ -234,13 +295,47 @@ def time_model(circuit, num_devices: int, chip: ChipSpec = V5E,
         compute = 2.0 * shard_bytes / hbm
         if plan.comm == "none":
             comm = 0.0
-        elif plan.comm == "permute":
+        elif plan.comm in ("permute", "subtile"):
             comm = plan.bytes_moved / chip.ici_link_bytes_per_sec
         else:  # reshard: all-to-all over every torus link
             comm = (plan.bytes_moved * (num_devices - 1) / num_devices
                     / (chip.ici_links * chip.ici_link_bytes_per_sec))
-        out.append(GateTime(plan.index, plan.kind, plan.comm, compute, comm))
+        op = circuit.ops[plan.index]
+        hideable = (pipeline_chunks > 1 and plan.comm == "permute"
+                    and op.kind in ("matrix", "x", "y")
+                    and len(op.targets) == 1 and not op.controls)
+        out.append(GateTime(plan.index, plan.kind, plan.comm, compute, comm,
+                            pipeline_chunks, hideable))
     return out
+
+
+def recommend_pipeline_chunks(num_qubits: int, num_devices: int,
+                              chip: ChipSpec = V5E,
+                              precision: int = 1) -> int:
+    """Chunk count the overlapped executor should split each shard into,
+    from shard bytes vs the chip's VMEM and ICI figures.
+
+    Lower bound: two in-flight chunks (the one computing and the one on
+    the wire) plus their outputs must fit VMEM, so C >= 4 x shard_bytes /
+    vmem.  Upper bound: a chunk's pairwise exchange must stay
+    bandwidth-bound (>= _MIN_CHUNK_COMM_SECONDS on one link), else the
+    per-chunk async overhead eats the hidden span.  Power of two, clamped
+    to [1, _MAX_PIPELINE_CHUNKS]; 1 means "do not chunk" (the degenerate
+    monolithic path)."""
+    if num_devices <= 1:
+        return 1
+    shard_bytes = memory_footprint(num_qubits, num_devices,
+                                   precision)["shard_bytes"]
+    need = max(1, -(-4 * shard_bytes // int(chip.vmem_bytes)))  # ceil div
+    c = 1
+    while c < need:
+        c *= 2
+    latency_cap = max(1, int(shard_bytes
+                             / (chip.ici_link_bytes_per_sec
+                                * _MIN_CHUNK_COMM_SECONDS)))
+    while c > 1 and c > latency_cap:
+        c //= 2
+    return min(c, _MAX_PIPELINE_CHUNKS)
 
 
 def project_random_circuit(num_qubits: int, depth: int, num_devices: int,

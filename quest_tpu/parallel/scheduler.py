@@ -562,12 +562,25 @@ def greedy_placement(circuit, num_devices: int, chip=None,
 # ---------------------------------------------------------------------------
 
 def schedule(circuit, num_devices: int, *, chip=None, precision: int = 1,
-             placement: bool = True, reorder: bool = True, **unknown):
+             placement: bool = True, reorder: bool = True,
+             overlap: bool = False, pipeline_chunks: int | None = None,
+             **unknown):
     """Comm-aware scheduled copy of ``circuit`` for an ``num_devices``-way
     amplitude mesh.  Pure host rewrite of the GateOp IR; the returned
     Circuit implements the SAME unitary (every pass is an exact algebraic
     refactoring) and is what ``compile_circuit(..., num_devices=...)``
     feeds the routed executor.
+
+    ``overlap=True`` (implied by a ``pipeline_chunks`` value) additionally
+    attaches a static chunking plan (parallel/executor.py plan_overlap):
+    ``compile_circuit(..., overlap=True)`` then lowers each comm event as
+    ``pipeline_chunks`` independent chunked collectives pipelined against
+    gate compute.  ``pipeline_chunks=None`` takes the planner's
+    recommendation (:func:`planner.recommend_pipeline_chunks`); a
+    non-power-of-two or non-integer count raises
+    ``E_INVALID_SCHEDULE_OPTION``.  The plan never changes the op list —
+    chunking is layout-only, provable via
+    ``analysis.equivalence.check_overlap_plan``.
 
     Invalid deployments are rejected with validation-layer codes before
     any rewriting: a non-integer, < 1 or non-power-of-two ``num_devices``
@@ -593,6 +606,16 @@ def schedule(circuit, num_devices: int, *, chip=None, precision: int = 1,
                          MESSAGES[ErrorCode.INVALID_NUM_RANKS], "schedule")
     validate_num_ranks(num_devices, "schedule")
     chip = chip or _planner.V5E
+    overlap = overlap or pipeline_chunks is not None
+    if overlap:
+        # validate (and resolve) the chunk count BEFORE any rewriting, so a
+        # bad option never half-schedules
+        from . import executor as _exec
+        if pipeline_chunks is None:
+            pipeline_chunks = _planner.recommend_pipeline_chunks(
+                circuit.num_qubits, num_devices, chip, precision)
+        pipeline_chunks = _exec.validate_pipeline_chunks(pipeline_chunks,
+                                                         "schedule")
     n = circuit.num_qubits
     ops = list(circuit.ops)
     if reorder and num_devices > 1:
@@ -607,6 +630,9 @@ def schedule(circuit, num_devices: int, *, chip=None, precision: int = 1,
     ops = _lower_epochs(ops, n, num_devices)
     out = Circuit(n)
     out.ops = ops
+    if overlap:
+        out._overlap_plan = _exec.plan_overlap(out, num_devices,
+                                               pipeline_chunks)
     if os.environ.get("QUEST_TPU_VALIDATE_SCHEDULE") == "1":
         from ..analysis.diagnostics import Severity
         from ..analysis.equivalence import check_equivalence
@@ -624,20 +650,43 @@ def schedule(circuit, num_devices: int, *, chip=None, precision: int = 1,
 
 
 def schedule_savings(circuit, num_devices: int, *, bytes_per_amp: int = 8,
-                     chip=None, precision: int = 1, scheduled=None) -> dict:
+                     chip=None, precision: int = 1, scheduled=None,
+                     pipeline_chunks: int | None = None) -> dict:
     """Before/after report of what scheduling buys: planner-predicted
     collective counts, bytes over ICI, and modeled seconds.  The payload
     behind ``python -m quest_tpu.analysis --schedule`` and the predicted
-    columns of bench.py's scheduled-vs-unscheduled rows."""
+    columns of bench.py's scheduled-vs-unscheduled rows.
+
+    With ``pipeline_chunks`` (or a ``scheduled`` circuit carrying an
+    overlap plan) the report grows the overlapped executor's predicted
+    columns: ``model_seconds_overlapped`` and ``predicted_hidden_frac``
+    from :func:`executor.predict_overlap` — the CI gate asserts the
+    overlap-aware model never predicts a slowdown vs the serial schedule."""
     chip = chip or _planner.V5E
     if scheduled is None:
         scheduled = schedule(circuit, num_devices, chip=chip,
-                             precision=precision)
+                             precision=precision,
+                             pipeline_chunks=pipeline_chunks)
     before = _planner.comm_summary(circuit, num_devices, bytes_per_amp)
     after = _planner.comm_summary(scheduled, num_devices, bytes_per_amp)
     sec_before = _model_seconds(circuit, num_devices, chip, precision)
     sec_after = _model_seconds(scheduled, num_devices, chip, precision)
+    overlap_cols = {}
+    plan = getattr(scheduled, "_overlap_plan", None)
+    if pipeline_chunks is not None or plan is not None:
+        from . import executor as _exec
+        o = _exec.predict_overlap(scheduled, num_devices,
+                                  pipeline_chunks, chip=chip,
+                                  precision=precision)
+        overlap_cols = {
+            "pipeline_chunks": o["pipeline_chunks"],
+            "model_seconds_overlapped": o["model_seconds_overlapped"],
+            "predicted_hidden_frac": o["predicted_hidden_frac"],
+            "chunked_events": o["chunked_events"],
+            "hideable_events": o["hideable_events"],
+        }
     return {
+        **overlap_cols,
         "num_devices": num_devices,
         "ops_before": before["ops"], "ops_after": after["ops"],
         "comm_events_before": before["comm_events"],

@@ -172,7 +172,7 @@ MESSAGES = {
     ErrorCode.DIAGONAL_OP_NOT_INITIALISED: "The diagonal operator has not been initialised through createDiagonalOperator().",
     ErrorCode.PLANE_ONLY_1Q: "This register uses plane-pair storage (the single-chip memory ceiling); only single-qubit uncontrolled gates are supported at this size. Apply multi-qubit/controlled gates on a register below the plane-storage threshold.",
     ErrorCode.QUREG_NOT_INITIALISED: "The register's amplitude storage has not been initialised, or was already destroyed (destroyQureg).",
-    ErrorCode.INVALID_SCHEDULE_OPTION: "Unknown scheduler option. Circuit.schedule accepts only chip, precision, placement and reorder.",
+    ErrorCode.INVALID_SCHEDULE_OPTION: "Unknown scheduler option. Circuit.schedule accepts only chip, precision, placement, reorder, overlap and pipeline_chunks.",
     ErrorCode.PLANE_ONLY: "This register uses plane-pair storage (the single-chip memory ceiling); the requested operation needs the stacked amplitude array, which cannot be materialised at this size. Supported in plane mode: init*, single-qubit gates, applyFullQFT, measure/collapse, probabilities, amplitude reads.",
 }
 

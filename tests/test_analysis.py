@@ -164,7 +164,12 @@ def test_ir_hint_fusable_1q_run():
 def test_ir_clean_circuits_have_no_findings():
     assert analyze(qt.qft_circuit(5)) == []
     assert analyze(qt.random_circuit(4, 3)) == []
-    assert analyze(qt.qft_circuit(6), num_devices=8, precision=2) == []
+    # a mesh deployment whose shards hold whole lane rows stays clean...
+    assert analyze(qt.qft_circuit(12), num_devices=8, precision=2) == []
+    # ...while a sub-lane-row shard (6q x 8 = 8 amps/shard) now warns: the
+    # wire-position comm model is incomplete there (planner.sub_tile_shard)
+    found = analyze(qt.qft_circuit(6), num_devices=8, precision=2)
+    assert [d.code for d in found] == [AnalysisCode.SUBTILE_SHARD]
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,383 @@
+"""The pipelined cross-shard executor (parallel/executor.py) and its
+ride-alongs: chunked-vs-monolithic bit-identity (the ISSUE property test),
+pairwise shard_map engine correctness, chunk-count validation through the
+E_* codes, overlap planning/prediction, the layout-only chunking proof,
+the overlap-aware planner time model, sub-tile shard comm accounting, and
+the compiled-HLO async audit."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from quest_tpu.circuit import (Circuit, compile_circuit, qft_circuit,
+                               random_circuit)
+from quest_tpu.ops import apply as ap
+from quest_tpu.parallel import executor as ex
+from quest_tpu.parallel import planner
+from oracle import random_unitary
+
+
+def _rand_state(n: int, seed: int = 0) -> jax.Array:
+    rs = np.random.RandomState(seed)
+    st = rs.randn(2, 1 << n)
+    st /= np.sqrt((st ** 2).sum())
+    return jnp.asarray(st, jnp.float64)
+
+
+def _mixed_circuit(n: int = 14, seed: int = 3) -> Circuit:
+    """Every executor-relevant structure: cross-shard 1q dense gates
+    (pairwise engine), repeated wide sharded gates (epoch sandwich),
+    diagonals, and a trailing swap network (fused bitperm window)."""
+    np.random.seed(seed)
+    rs = np.random.RandomState(seed)
+    c = Circuit(n)
+    c.h(n - 1)
+    c.rz(2, 0.31)
+    for _ in range(3):
+        c.multi_qubit_unitary((n - 2, n - 1), random_unitary(2))
+    c.unitary(n - 3, random_unitary(1))
+    c.phase_shift(1, 0.7, controls=(0,))
+    for q in range(3):
+        c.swap(q, n - 1 - q)
+    c.unitary(int(rs.randint(0, n - 4)), random_unitary(1))
+    return c
+
+
+# ---------------------------------------------------------------------------
+# chunked == monolithic (ISSUE satellite): bit-identical across C, and both
+# equal the unscheduled reference
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("devices", [2, 8])
+def test_overlapped_bit_identical_across_chunk_counts(devices):
+    """pipeline_chunks in {1, 2, 4} (C=1 is the degenerate single-chunk
+    path through the same engines) must give BIT-IDENTICAL states —
+    chunking is layout-only — and agree with the unscheduled circuit."""
+    for seed in (0, 1):
+        c = _mixed_circuit(14, seed)
+        st = _rand_state(14, 10 + seed)
+        want = np.asarray(compile_circuit(c)(st))
+        outs = {}
+        for chunks in (1, 2, 4):
+            run = compile_circuit(c, num_devices=devices, overlap=True,
+                                  pipeline_chunks=chunks)
+            outs[chunks] = np.asarray(run(st))
+            np.testing.assert_allclose(outs[chunks], want, atol=1e-12)
+        assert np.array_equal(outs[1], outs[2]), "C=1 vs C=2 not bit-identical"
+        assert np.array_equal(outs[2], outs[4]), "C=2 vs C=4 not bit-identical"
+
+
+def test_overlapped_random_circuits_equivalent():
+    for seed in range(2):
+        c = random_circuit(12, depth=2, seed=seed)
+        st = _rand_state(12, seed)
+        want = np.asarray(compile_circuit(c)(st))
+        run = compile_circuit(c, num_devices=8, overlap=True,
+                              pipeline_chunks=4)
+        np.testing.assert_allclose(np.asarray(run(st)), want, atol=1e-12)
+
+
+def test_overlapped_qft_equivalent():
+    c = qft_circuit(14)
+    st = _rand_state(14, 7)
+    want = np.asarray(compile_circuit(c)(st))
+    run = compile_circuit(c, num_devices=8, pipeline_chunks=4)  # implies overlap
+    np.testing.assert_allclose(np.asarray(run(st)), want, atol=1e-12)
+
+
+def test_pairwise_engine_matches_gate_oracle():
+    """The explicit shard_map ppermute engine must reproduce the ordinary
+    gate engine on a sharded-wire 1q dense gate, at every chunk count."""
+    n = 12
+    np.random.seed(4)
+    u = random_unitary(1)
+    c = Circuit(n).unitary(n - 1, u)
+    st = _rand_state(n, 4)
+    want = np.asarray(
+        ap.apply_matrix(st, jnp.asarray(np.stack([u.real, u.imag])),
+                        (n - 1,)))
+    outs = {}
+    for chunks in (1, 2, 4):
+        s = c.schedule(8, overlap=True, pipeline_chunks=chunks)
+        assert any(e.kind == "pairwise" for e in s._overlap_plan.events)
+        outs[chunks] = np.asarray(ex.overlapped_program(s, 8)(st))
+        np.testing.assert_allclose(outs[chunks], want, atol=1e-12)
+    assert np.array_equal(outs[1], outs[2])
+    assert np.array_equal(outs[2], outs[4])
+
+
+# ---------------------------------------------------------------------------
+# chunk-count validation (ISSUE satellite): E_INVALID_SCHEDULE_OPTION
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("bad", [0, -1, 3, 6, 2.0, "4", True])
+def test_non_power_of_two_chunks_rejected(bad):
+    from quest_tpu.validation import ErrorCode, QuESTError
+    c = qft_circuit(8)
+    with pytest.raises(QuESTError) as err:
+        c.schedule(4, overlap=True, pipeline_chunks=bad)
+    assert err.value.code == ErrorCode.INVALID_SCHEDULE_OPTION
+    with pytest.raises(QuESTError) as err:
+        compile_circuit(c, num_devices=4, pipeline_chunks=bad)
+    assert err.value.code == ErrorCode.INVALID_SCHEDULE_OPTION
+
+
+def test_overlap_without_num_devices_rejected():
+    from quest_tpu.validation import ErrorCode, QuESTError
+    with pytest.raises(QuESTError) as err:
+        compile_circuit(qft_circuit(8), overlap=True)
+    assert err.value.code == ErrorCode.INVALID_SCHEDULE_OPTION
+
+
+def test_schedule_still_rejects_unknown_kwargs_with_overlap():
+    from quest_tpu.validation import ErrorCode, QuESTError
+    with pytest.raises(QuESTError) as err:
+        qft_circuit(8).schedule(4, overlap=True, pipeline_chunk=4)  # typo
+    assert err.value.code == ErrorCode.INVALID_SCHEDULE_OPTION
+    assert "pipeline_chunk" in str(err.value)
+
+
+# ---------------------------------------------------------------------------
+# overlap planning
+# ---------------------------------------------------------------------------
+
+def test_plan_epoch_sandwich_window():
+    """A scheduler epoch (bitperm . gates . bitperm) plans as ONE hideable
+    window event whose chunk bits avoid every wire the window touches."""
+    np.random.seed(0)
+    n, devices = 14, 4
+    c = Circuit(n)
+    for _ in range(3):
+        c.multi_qubit_unitary((n - 2, n - 1), random_unitary(2))
+    s = c.schedule(devices, overlap=True, pipeline_chunks=4)
+    plan = s._overlap_plan
+    assert len(plan.events) == 1
+    e = plan.events[0]
+    assert e.kind == "window" and e.hideable and e.chunks == 4
+    assert s.ops[e.start].kind == "bitperm"
+    assert s.ops[e.stop - 1] == s.ops[e.start]
+    used = set()
+    for op in s.ops[e.start:e.stop]:
+        used |= set(op.targets) | set(op.controls)
+        if op.kind == "bitperm":
+            used |= {int(d) for d in op.matrix}
+    assert not (set(e.chunk_bits) & used)
+    assert all(b < planner.local_qubit_count(n, devices)
+               for b in e.chunk_bits)
+
+
+def test_plan_lone_reshard_not_hideable():
+    """A fused swap-network bitperm with no adjacent compute is chunked
+    (comm pipelining) but NOT marked hideable — nothing to hide behind."""
+    n = 14
+    c = Circuit(n)
+    for q in range(3):
+        c.swap(q, n - 1 - q)
+    s = c.schedule(8, overlap=True, pipeline_chunks=2)
+    events = s._overlap_plan.events
+    assert events and all(not e.hideable for e in events)
+
+
+def test_plan_degenerate_single_device():
+    s = qft_circuit(10).schedule(1, overlap=True, pipeline_chunks=4)
+    assert s._overlap_plan.events == ()
+
+
+# ---------------------------------------------------------------------------
+# layout-only chunking proof (analysis/equivalence.py)
+# ---------------------------------------------------------------------------
+
+def test_verify_schedule_proves_chunked_lowering():
+    from quest_tpu.analysis.equivalence import verify_schedule
+    for circuit in (qft_circuit(14), _mixed_circuit(14, 1)):
+        diags = verify_schedule(circuit, num_devices=8, overlap=True,
+                                pipeline_chunks=4)
+        assert diags == [], [d.format() for d in diags]
+
+
+def test_check_overlap_plan_catches_clobbered_bits():
+    """A chunk bit inside the window's wire set is a soundness violation:
+    the checker must refuse the plan with V_SEMANTICS_CHANGED."""
+    import dataclasses
+    from quest_tpu.analysis.diagnostics import AnalysisCode, Severity
+    from quest_tpu.analysis.equivalence import check_overlap_plan
+    np.random.seed(2)
+    n = 14
+    c = Circuit(n)
+    for _ in range(3):
+        c.multi_qubit_unitary((n - 2, n - 1), random_unitary(2))
+    s = c.schedule(4, overlap=True, pipeline_chunks=2)
+    plan = s._overlap_plan
+    e = plan.events[0]
+    clobbered = dataclasses.replace(e, chunk_bits=(s.ops[e.start].targets[0],),
+                                    chunks=2)
+    bad_plan = dataclasses.replace(plan, events=(clobbered,))
+    found = check_overlap_plan(s, bad_plan)
+    assert found and all(d.code == AnalysisCode.SEMANTICS_CHANGED
+                         and d.severity == Severity.ERROR for d in found)
+    # the honest plan passes
+    assert check_overlap_plan(s, plan) == []
+
+
+def test_check_overlap_plan_rejects_bad_pairwise():
+    import dataclasses
+    from quest_tpu.analysis.diagnostics import AnalysisCode
+    from quest_tpu.analysis.equivalence import check_overlap_plan
+    n = 12
+    c = Circuit(n).x(n - 1, controls=(0,))  # controlled: NOT pairwise-safe
+    s = c.schedule(8, overlap=True, pipeline_chunks=2)
+    fake = ex.ChunkedEvent(0, 1, "pairwise", (), 2, "permute", True)
+    bad_plan = dataclasses.replace(s._overlap_plan, events=(fake,))
+    found = check_overlap_plan(s, bad_plan)
+    assert any(d.code == AnalysisCode.SEMANTICS_CHANGED for d in found)
+
+
+# ---------------------------------------------------------------------------
+# overlap-aware planner cost model
+# ---------------------------------------------------------------------------
+
+def test_time_model_serial_is_sum_not_midpoint():
+    c = Circuit(16).h(15)
+    t = planner.time_model(c, 8, planner.V5E, 1)[0]
+    assert t.comm_s > 0
+    assert t.total_s == pytest.approx(t.compute_s + t.comm_s)
+
+
+def test_time_model_pipelined_pairwise_cost():
+    c = Circuit(16).h(15)
+    t = planner.time_model(c, 8, planner.V5E, 1, pipeline_chunks=4)[0]
+    assert t.hideable and t.pipeline_chunks == 4
+    assert t.total_s == pytest.approx(
+        max(t.compute_s, t.comm_s) + min(t.compute_s, t.comm_s) / 4)
+    assert t.total_s < t.compute_s + t.comm_s
+
+
+def test_predict_overlap_never_slower_and_frac_bounded():
+    for circuit in (qft_circuit(16), _mixed_circuit(14, 5)):
+        p = ex.predict_overlap(circuit.schedule(8), 8, 4)
+        assert p["model_seconds_overlapped"] <= p["model_seconds_serial"]
+        assert 0.0 <= p["predicted_hidden_frac"] <= 1.0
+        one = ex.predict_overlap(circuit.schedule(8), 8, 1)
+        assert one["model_seconds_overlapped"] == pytest.approx(
+            one["model_seconds_serial"])
+
+
+def test_recommend_pipeline_chunks_shapes():
+    assert planner.recommend_pipeline_chunks(20, 1) == 1
+    for n, d in ((22, 8), (30, 8), (34, 64)):
+        c = planner.recommend_pipeline_chunks(n, d)
+        assert c >= 1 and (c & (c - 1)) == 0
+    # a 30q f32 shard (1 GiB over 8 chips) cannot fit VMEM monolithically:
+    # the recommendation must actually chunk
+    assert planner.recommend_pipeline_chunks(30, 8) > 1
+    # a tiny shard is latency-bound: do not chunk
+    assert planner.recommend_pipeline_chunks(14, 8) == 1
+
+
+# ---------------------------------------------------------------------------
+# sub-tile shard comm accounting (ISSUE satellite; found-by-audit in PR 3)
+# ---------------------------------------------------------------------------
+
+def test_memory_footprint_flags_sub_tile_shards():
+    assert planner.memory_footprint(9, 8)["sub_tile_shard"] is True
+    assert planner.memory_footprint(20, 8)["sub_tile_shard"] is False
+    assert planner.memory_footprint(9, 1)["sub_tile_shard"] is False
+
+
+def test_comm_plan_charges_subtile_class():
+    """The 9q x 8-device config: 64 amps/shard is below one 8x128 tile, so
+    dense gates the wire-position model rates local are charged the
+    'subtile' comm class; diagonals stay comm-free."""
+    c = Circuit(9).h(0).z(0).cnot(0, 1)
+    plans = planner.comm_plan(c, 8)
+    assert plans[0].comm == "subtile" and plans[0].bytes_moved > 0
+    assert plans[1].comm == "none"          # diagonal: elementwise broadcast
+    assert plans[2].comm == "subtile"
+    s = planner.comm_summary(c, 8)
+    assert s["subtile_events"] == 2
+    assert s["comm_events"] == 2
+    # same circuit on a tile-sized shard stays local
+    big = Circuit(16).h(0).z(0).cnot(0, 1)
+    assert all(p.comm == "none" for p in planner.comm_plan(big, 8))
+    assert planner.comm_summary(big, 8)["subtile_events"] == 0
+
+
+def test_analyzer_warns_on_sub_tile_deployment():
+    from quest_tpu.analysis import analyze_circuit
+    from quest_tpu.analysis.diagnostics import (AnalysisCode, Severity)
+    c = Circuit(9).h(0)
+    found = analyze_circuit(c, num_devices=8, hints=False)
+    hits = [d for d in found if d.code == AnalysisCode.SUBTILE_SHARD]
+    assert hits and hits[0].severity == Severity.WARNING
+    assert not [d for d in analyze_circuit(Circuit(16).h(0), num_devices=8,
+                                           hints=False)
+                if d.code == AnalysisCode.SUBTILE_SHARD]
+
+
+# ---------------------------------------------------------------------------
+# compiled-HLO overlap audit (analysis/jaxpr_audit.py)
+# ---------------------------------------------------------------------------
+
+def test_count_hlo_async_collectives_parses_separation():
+    from quest_tpu.analysis.jaxpr_audit import count_hlo_async_collectives
+    hidden = "\n".join([
+        "  %s = f32[2,512] collective-permute-start(%x), channel_id=1",
+        "  %mul = f32[2,512] multiply(%a, %b)",
+        "  %d = f32[2,512] collective-permute-done(%s)",
+    ])
+    back2back = "\n".join([
+        "  %s = f32[2,512] all-to-all-start(%x)",
+        "  %d = f32[2,512] all-to-all-done(%s)",
+    ])
+    # interleaved but fully serialized: start.1; start.2; done.1; done.2 —
+    # no compute sits between any start and ITS done, so nothing is hidden
+    interleaved = "\n".join([
+        "  %s1 = f32[2,512] collective-permute-start(%x), channel_id=1",
+        "  %s2 = f32[2,512] collective-permute-start(%y), channel_id=2",
+        "  %d1 = f32[2,512] collective-permute-done(%s1)",
+        "  %d2 = f32[2,512] collective-permute-done(%s2)",
+    ])
+    assert count_hlo_async_collectives(hidden) == {"starts": 1,
+                                                   "separated": 1}
+    assert count_hlo_async_collectives(back2back) == {"starts": 1,
+                                                      "separated": 0}
+    assert count_hlo_async_collectives(interleaved) == {"starts": 2,
+                                                        "separated": 0}
+    assert count_hlo_async_collectives("%y = f32[4] add(%a, %b)") == {
+        "starts": 0, "separated": 0}
+
+
+def test_audit_overlap_reports_and_never_errors():
+    """On the 8-virtual-device CPU mesh the audit must produce a full
+    report; CPU collectives are synchronous, so any finding is the WARNING
+    A_COLLECTIVE_NOT_OVERLAPPED (or a count WARNING), never an ERROR."""
+    from quest_tpu.analysis.diagnostics import Severity
+    from quest_tpu.analysis.jaxpr_audit import audit_overlap
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    c = qft_circuit(14)
+    s = c.schedule(8, overlap=True, pipeline_chunks=4)
+    report, found = audit_overlap(s, 8, 4)
+    assert report["planned_events"] >= 1
+    assert report["hlo_collectives"] is not None
+    assert report["hlo_async"] is not None
+    assert all(d.severity < Severity.ERROR for d in found), \
+        [d.format() for d in found]
+
+
+def test_audit_dispatch_widened_bound_accepts_chunked_lowering():
+    """audit_dispatch(pipeline_chunks=C) must not flag a program whose
+    measured collective count fits C chunk-sized collectives per event."""
+    from quest_tpu.analysis.jaxpr_audit import audit_dispatch
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    c = qft_circuit(14).schedule(8)
+    _, strict = audit_dispatch(c, 8, donate=False, label="strict")
+    _, widened = audit_dispatch(c, 8, donate=False, pipeline_chunks=4,
+                                label="widened")
+    assert len(widened) <= len(strict)
+    assert not [d for d in widened if d.code == "A_COLLECTIVE_COUNT_MISMATCH"]
