@@ -634,6 +634,80 @@ def _hlo_collective_count(compiled_text: str) -> int:
     return len(pat.findall(compiled_text))
 
 
+def bench_serve_vqe16_batch64(requests=64, n=16, layers=1):
+    """64 structurally-identical, differently-parameterized 16q VQE ansatz
+    circuits through QuESTService vs the per-circuit compile-and-run loop
+    — the serving subsystem's headline row (docs/SERVING.md).
+
+    The per-circuit loop pays one XLA compile PER TENANT (a program keyed
+    on angle values is a fresh program for every angle assignment); the
+    service canonicalizes all 64 to one structural class, compiles ONE
+    parameter-lifted (state, params) program, and runs one 64-wide
+    microbatch.  Value = serve-path amp updates/s; the config records the
+    compile counts (must be 1 vs 64), both wall times and the speedup, and
+    the mean batch size from the service metrics."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from quest_tpu.circuit import _run_ops_routed
+    from quest_tpu.serve import CompileCache, QuESTService
+    from quest_tpu.serve.selftest import vqe_ansatz
+
+    platform = jax.devices()[0].platform
+    dtype = jnp.float64 if platform == "cpu" else jnp.float32
+    circuits = [vqe_ansatz(n, layers, seed=s) for s in range(requests)]
+    gates = len(circuits[0].ops)
+
+    def fresh():
+        return jnp.zeros((2, 1 << n), dtype).at[0, 0].set(1.0)
+
+    # per-circuit loop: a fresh jit closure per tenant = one compile each —
+    # exactly what a pre-serve caller pays for an angle sweep
+    t0 = time.perf_counter()
+    eager_out = None
+    for c in circuits:
+        run = jax.jit(lambda s, _ops=c.key(): _run_ops_routed(s, _ops))
+        eager_out = run(fresh())
+    jax.block_until_ready(eager_out)
+    eager_seconds = time.perf_counter() - t0
+
+    cache = CompileCache()
+    svc = QuESTService(max_batch=requests, max_delay_ms=50.0,
+                      max_queue=requests, dtype=dtype, cache=cache,
+                      start=False)
+    t0 = time.perf_counter()
+    futs = [svc.submit(c) for c in circuits]
+    svc.start()
+    if not svc.drain(timeout=1200):
+        raise RuntimeError("serve drain timed out")
+    results = [f.result(timeout=120) for f in futs]
+    serve_seconds = time.perf_counter() - t0
+    svc.shutdown()
+
+    # correctness guard: last request vs its per-circuit program
+    worst = float(np.abs(results[-1].state - np.asarray(eager_out)).max())
+    tol = 1e-12 if dtype == jnp.float64 else 1e-5
+    assert worst < tol, f"serve result drifted {worst} from per-circuit run"
+    snap = cache.snapshot()
+    assert snap["compiles"] == 1, f"expected ONE compile, got {snap}"
+    hist = svc.metrics_dict()["histograms"]["batch_size"]
+    value = (1 << n) * gates * requests / max(serve_seconds, 1e-9)
+    cfg = {"qubits": n, "requests": requests, "gates_per_circuit": gates,
+           "precision": 2 if dtype == jnp.float64 else 1,
+           "platform": platform,
+           "serve_seconds": serve_seconds,
+           "eager_loop_seconds": eager_seconds,
+           "speedup_vs_per_circuit_loop": eager_seconds
+           / max(serve_seconds, 1e-9),
+           "serve_compiles": int(snap["compiles"]),
+           "eager_compiles": requests,
+           "cache_hit_rate": snap["hit_rate"],
+           "mean_batch_size": hist["mean"],
+           "max_abs_diff_vs_per_circuit": worst,
+           "seconds": serve_seconds}
+    return value, cfg
+
+
 _SCHED_PAIR_CHUNKS = 4  # pipeline depth of the overlapped bench variant
 
 
@@ -944,6 +1018,8 @@ def main() -> None:
         # HBM; depth 3 amortises the 42 per-op dispatches (~5 s/layer on the
         # chip) so the number is not a single-layer sample
         add("densmatr_14q_damping_depol_f64", bench_density, 14, 3, 2)
+        # serving subsystem (quest_tpu/serve): 64 tenants, one compile
+        add("serve_vqe_16q_batch64", bench_serve_vqe16_batch64)
         add("qft_28q_f32", bench_qft, 28, 1)
         if platform != "cpu":
             add("qft_28q_f32_inplace_ordered", bench_qft_inplace, 28, True)

@@ -70,6 +70,8 @@ GROUPS = [
                                    "adjoint_gradient_fn"]),
     ("Trajectory simulation", ["trajectory_state_fn",
                                "trajectory_expectation_fn"]),
+    ("Serving (quest_tpu.serve)", ["QuESTService", "ServeResult",
+                                   "CompileCache", "CacheOptions"]),
 ]
 
 

@@ -24,6 +24,8 @@ from .autodiff import (Param, ParamCircuit, build as build_param_circuit,  # noq
                        adjoint_gradient_fn, expectation_fn, state_fn)
 from .trajectories import (trajectory_expectation_fn,  # noqa: F401
                            trajectory_state_fn)
+from .serve import (CacheOptions, CompileCache, QuESTService,  # noqa: F401
+                    ServeResult)
 
 __version__ = "0.1.0"
 __all__ = list(_api_all) + [
@@ -33,4 +35,5 @@ __all__ = list(_api_all) + [
     "Param", "ParamCircuit", "build_param_circuit", "expectation_fn",
     "state_fn", "adjoint_gradient_fn",
     "trajectory_state_fn", "trajectory_expectation_fn",
+    "QuESTService", "ServeResult", "CompileCache", "CacheOptions",
 ]

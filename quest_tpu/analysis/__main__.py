@@ -19,13 +19,21 @@ Modes (combinable; exit status is 1 iff any ERROR-severity diagnostic):
   counts, unexpected gathers, donation aliasing) — the CI scheduler-
   correctness smoke.  Implies ``--schedule``'s scheduling step.
 
+- ``--serve-audit``: machine-prove the serve layer's parameter-lifted
+  compilation cache (analysis/serve_audit.py): per structural class, the
+  skeleton + operand-vector reconstruction is translation-validated
+  against the request circuit, the lifted ``(state, params)`` program is
+  probed against the eager path, and an angle-perturbed twin must share
+  the cache entry — any violation is ``A_PARAM_LIFT_DIVERGENCE``.  Audits
+  the listed circuits, or the serve selftest workload when none are given.
+
 Circuit modes run the IR pass and the eager/compiled abstract-eval pass
 against the deployment described by ``--devices/--precision/--chip``.
 
 ``--json`` switches stdout to ONE machine-readable JSON document —
 ``{"diagnostics": [...], "circuits": [...], "schedule": [...],
-"verify": [...], "summary": {...}}`` — so CI gates parse severities
-instead of grepping text.  Exit status is unchanged.
+"verify": [...], "serve_audit": [...], "summary": {...}}`` — so CI gates
+parse severities instead of grepping text.  Exit status is unchanged.
 """
 
 from __future__ import annotations
@@ -155,6 +163,16 @@ def main(argv=None) -> int:
                         dest="verify_schedule",
                         help="translation-validate each circuit's scheduled "
                              "rewrite and audit the lowered dispatch path")
+    parser.add_argument("--serve-audit", action="store_true",
+                        dest="serve_audit",
+                        help="machine-prove the serve cache's parameter "
+                             "lift per structural class (round-trip "
+                             "equivalence + lifted-vs-eager probe + key "
+                             "stability; analysis/serve_audit.py).  Audits "
+                             "the --qft/--random/--circuit circuits, or "
+                             "the serve selftest workload when none are "
+                             "given; --devices > 1 audits the scheduler-"
+                             "composed cache path")
     parser.add_argument("--overlap-chunks", type=int, default=None,
                         dest="overlap_chunks", metavar="C",
                         help="schedule with the pipelined executor's "
@@ -178,7 +196,7 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     doc: dict = {"circuits": [], "schedule": [], "verify": [],
-                 "diagnostics": [], "summary": {}}
+                 "serve_audit": [], "diagnostics": [], "summary": {}}
 
     def echo(line: str) -> None:
         if not args.as_json:
@@ -227,6 +245,19 @@ def main(argv=None) -> int:
         doc["circuits"].append({"label": label, "ops": len(circuit.ops),
                                 "findings": len(found)})
         echo(f"{label}: {len(circuit.ops)} ops, {len(found)} finding(s)")
+
+    if args.serve_audit:
+        ran = True
+        from .serve_audit import audit_param_lift, default_workload
+        targets = ([(label, c) for label, c in circuits]
+                   if circuits else default_workload())
+        reports, found = audit_param_lift(
+            targets, num_devices=args.devices,
+            dtype=_dtype(args.precision))
+        doc["serve_audit"] = reports
+        diagnostics += found
+        for r in reports:
+            echo(f"{r['label']}: serve-audit " + json.dumps(r, default=float))
 
     if not ran:
         parser.print_usage()

@@ -51,6 +51,8 @@ class AnalysisCode:
     COLLECTIVE_NOT_OVERLAPPED = "A_COLLECTIVE_NOT_OVERLAPPED"
     # deployment-shape projections (parallel/planner.py)
     SUBTILE_SHARD = "A_SUBTILE_SHARD"
+    # serving-layer parameter-lift audit (analysis/serve_audit.py)
+    PARAM_LIFT_DIVERGENCE = "A_PARAM_LIFT_DIVERGENCE"
     # optimization hints
     ADJACENT_INVERSE_PAIR = "H_ADJACENT_INVERSE_PAIR"
     FUSABLE_1Q_RUN = "H_FUSABLE_1Q_RUN"
@@ -130,6 +132,15 @@ ANALYSIS_MESSAGES = {
         "wire-position comm model rates shard-local, so every dense gate "
         "is charged the 'subtile' comm class. Use fewer devices (or more "
         "qubits) so a shard holds at least one lane row.",
+    AnalysisCode.PARAM_LIFT_DIVERGENCE:
+        "The serve cache's parameter-lifted program for this structural "
+        "class diverges from the eager per-circuit path: the skeleton + "
+        "operand-vector reconstruction is not provably the same circuit "
+        "(translation-validator witness), the lifted (state, params) "
+        "executable disagrees with the eager oracle on a probe state, or "
+        "an angle-perturbed twin failed to share the class's cache entry. "
+        "Serving would return wrong amplitudes for EVERY request of the "
+        "class.",
     AnalysisCode.ADJACENT_INVERSE_PAIR:
         "Adjacent gates on identical wires compose to the identity and can "
         "be cancelled.",

@@ -1,0 +1,139 @@
+"""Pass 6: machine-proving the serve cache's parameter lift.
+
+The serve subsystem (quest_tpu/serve) compiles ONE ``(state, params)``
+program per structural class and claims it computes what per-circuit
+compilation would have — for EVERY angle assignment, including classes the
+scheduler rewrote (serve/cache.py).  That claim is a compiler-shaped one,
+so it gets the PR 3 treatment: validate the artifact, don't trust the
+rewriter.
+
+Per structural class this audit proves three things:
+
+1. **Round-trip** — the class skeleton + the circuit's operand vector
+   reconstruct a circuit (``serve.cache.circuit_from_params``) that
+   :func:`analysis.equivalence.check_equivalence` PROVES equivalent to the
+   request circuit.  For a mesh class the skeleton is the SCHEDULED op
+   order with provenance-gathered operand slots, so this certifies the
+   scheduler-composed cache entry end to end (reordering, bitperm fusion,
+   placement relabeling, slot provenance) with the Pauli-tableau /
+   phase-polynomial / dense-window domains — never a 2^n state.
+2. **Lifted execution** — the class's compiled lifted program run on a
+   probe state agrees with the eager per-circuit program.  Tolerance is a
+   few f64 ulps, NOT zero: embedding payloads as constants lets XLA
+   contract FMAs differently than the runtime-operand program (measured
+   1-2 ulp on CPU; docs/SERVING.md "numerics"), which is a codegen
+   identity, not a lift defect.
+3. **Key stability** — an angle-perturbed twin of the circuit lands on the
+   SAME cache entry (a structural-key instability would silently bring
+   back one-compile-per-tenant).
+
+Any violation is ``A_PARAM_LIFT_DIVERGENCE`` (ERROR).  Wired into
+``python -m quest_tpu.analysis --serve-audit`` and the CI ``serve-selftest``
+job; with no explicit circuits the serve selftest's workload classes are
+audited (serve/selftest.py ``audit_circuits``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .diagnostics import AnalysisCode, Diagnostic, Severity, diag
+
+__all__ = ["audit_param_lift", "default_workload"]
+
+def _probe_eps(dtype) -> float:
+    """FMA-contraction slack scaled to the PROBE dtype: a few ulps over a
+    deep circuit — 1e-13 for f64, 1e-4 for f32 (one f32 ulp is ~1e-7, and
+    accelerator codegen may legally differ per gate)."""
+    import numpy as np
+    return 1e-13 if np.dtype(dtype).itemsize >= 8 else 1e-4
+
+
+def default_workload() -> list:
+    """(label, circuit, perturbed-twin) per serve-selftest class."""
+    from ..serve.selftest import audit_circuits
+    return audit_circuits()
+
+
+def _probe_state(num_qubits: int, dtype, seed: int = 0):
+    import jax.numpy as jnp
+    rng = np.random.default_rng(seed)
+    v = rng.normal(size=(2, 1 << num_qubits))
+    v /= np.sqrt((v ** 2).sum())
+    return jnp.asarray(v, dtype)
+
+
+def audit_param_lift(circuits, *, num_devices: int = 1, dtype=None,
+                     label_prefix: str = "") -> tuple:
+    """Audit each ``(label, circuit[, twin])`` entry's structural class.
+
+    Returns ``(reports, diagnostics)``: one report dict per class and the
+    ``A_PARAM_LIFT_DIVERGENCE`` findings (plus any pass-through equivalence
+    diagnostics).  ``num_devices > 1`` audits the scheduler-composed cache
+    path."""
+    import jax.numpy as jnp
+
+    from .. import circuit as _circ
+    from ..serve.cache import CacheOptions, CompileCache, circuit_from_params
+    from .equivalence import check_equivalence
+
+    if dtype is None:
+        dtype = jnp.float64
+    options = (CacheOptions(num_devices=num_devices)
+               if num_devices and num_devices > 1 else CacheOptions())
+    cache = CompileCache()  # isolated: the audit must not warm serving caches
+    reports: list[dict] = []
+    out: list[Diagnostic] = []
+    for item in circuits:
+        label, circuit = item[0], item[1]
+        twin = item[2] if len(item) > 2 else None
+        label = f"{label_prefix}{label}"
+        n = circuit.num_qubits
+        ops = circuit.key()
+        entry = cache.entry_for(ops, n, options)
+        report = {"label": label, "num_qubits": n, "ops": len(ops),
+                  "num_devices": num_devices,
+                  "skeleton_ops": len(entry.skeleton or ()),
+                  "lifted_params": entry.num_params}
+
+        # 1. round-trip reconstruction, proven by the PR 3 validator
+        recon = circuit_from_params(n, entry.skeleton, entry.offsets,
+                                    _circ.param_vector(ops))
+        eq = check_equivalence(circuit, recon)
+        errors = [d for d in eq if d.severity >= Severity.ERROR]
+        report["roundtrip_proven"] = not eq
+        report["roundtrip_diagnostics"] = len(eq)
+        if errors:
+            out.append(diag(AnalysisCode.PARAM_LIFT_DIVERGENCE,
+                            Severity.ERROR,
+                            detail=(f"{label}: skeleton+params reconstruction "
+                                    f"is NOT the request circuit "
+                                    f"({errors[0].message})")))
+        out.extend(eq)  # unverified-region warnings surface as themselves
+
+        # 2. lifted program vs eager program on a probe state
+        probe = _probe_state(n, dtype)
+        lifted = np.asarray(cache.execute(ops, probe, num_qubits=n,
+                                          options=options))
+        eager = np.asarray(_circ._run_ops(probe, ops))
+        worst = float(np.abs(lifted - eager).max())
+        report["probe_max_abs_diff"] = worst
+        if not np.isfinite(worst) or worst > _probe_eps(dtype):
+            out.append(diag(AnalysisCode.PARAM_LIFT_DIVERGENCE,
+                            Severity.ERROR,
+                            detail=(f"{label}: lifted program diverges from "
+                                    f"the eager path on a probe state "
+                                    f"(max |diff| {worst:.3g})")))
+
+        # 3. structural-key stability across an angle-perturbed twin
+        if twin is not None:
+            entry2 = cache.entry_for(twin.key(), twin.num_qubits, options)
+            report["twin_shares_entry"] = entry2 is entry
+            if entry2 is not entry:
+                out.append(diag(AnalysisCode.PARAM_LIFT_DIVERGENCE,
+                                Severity.ERROR,
+                                detail=(f"{label}: an angle-perturbed twin "
+                                        "missed the class's cache entry — "
+                                        "the structural key is unstable")))
+        reports.append(report)
+    return reports, out

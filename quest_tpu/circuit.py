@@ -30,7 +30,8 @@ import numpy as np
 from .ops import apply as _ap
 
 __all__ = ["Circuit", "GateOp", "compile_circuit", "apply_circuit",
-           "op_operands", "random_circuit", "qft_circuit"]
+           "op_operands", "op_param_count", "structural_op", "param_vector",
+           "lifted_operands", "random_circuit", "qft_circuit"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -172,7 +173,21 @@ class Circuit:
     def __len__(self) -> int:
         return len(self.ops)
 
-    def key(self) -> tuple:
+    def key(self, structural: bool = False) -> tuple:
+        """Hashable identity of the recorded gate list.
+
+        ``structural=True`` returns the STRUCTURAL key: op kinds, wires,
+        control states and payload arities with every continuous payload
+        (gate matrices, diagonal entries, the mrz angle) lifted out.  Two
+        circuits differing only in rotation angles — the shape of a
+        million-user parameterized workload — share one structural key and
+        therefore ONE compiled program in the serve-layer compilation cache
+        (quest_tpu/serve/cache.py), where the default key would force one
+        XLA compile per angle assignment.  Discrete payloads (``bitperm``
+        destination wires) stay in the key: they select the program's data
+        movement, not its operands."""
+        if structural:
+            return tuple(structural_op(op) for op in self.ops)
         return tuple(self.ops)
 
     def optimize(self, max_pack: int = 7) -> "Circuit":
@@ -236,8 +251,73 @@ def op_operands(op: GateOp, state_dtype) -> dict:
     return {}
 
 
-def _apply_one(state: jax.Array, op: GateOp) -> jax.Array:
-    operands = op_operands(op, state.dtype)
+def op_param_count(op: GateOp) -> int:
+    """Number of continuous (liftable) payload scalars of ``op``: the flat
+    real-pair matrix/diagonal payload, or the single mrz angle.  Discrete
+    payloads (``bitperm`` wire destinations) and payload-free kinds count
+    zero — they are structure, not operands."""
+    if op.kind in ("matrix", "diagonal"):
+        if op.matrix is not None:
+            return len(op.matrix)
+        return int(np.prod(op.shape))
+    if op.kind == "mrz":
+        return 1
+    return 0
+
+
+def structural_op(op: GateOp) -> GateOp:
+    """The payload-free twin of ``op`` used by structural keys: continuous
+    payloads dropped, arity (``shape``) kept so the lifted operand layout is
+    still derivable from the key alone."""
+    if op.kind in ("matrix", "diagonal"):
+        return GateOp(op.kind, op.targets, op.controls, op.control_states,
+                      None, op.shape)
+    if op.kind == "mrz":
+        return GateOp(op.kind, op.targets, op.controls, op.control_states,
+                      None, None)
+    return op
+
+
+def param_vector(ops) -> np.ndarray:
+    """The flat float64 operand vector of a circuit (or op list): every
+    continuous payload concatenated in op order.  This is the runtime
+    ``params`` argument of a parameter-lifted program — the circuit's
+    structural key plus this vector reconstruct it exactly
+    (serve/cache.py circuit_from_params)."""
+    if isinstance(ops, Circuit):
+        ops = ops.ops
+    chunks = []
+    for op in ops:
+        if op_param_count(op):
+            if op.matrix is None:
+                raise ValueError(
+                    "param_vector needs concrete payloads; got a structural "
+                    f"op ({op.kind} on {op.targets})")
+            chunks.append(np.asarray(op.matrix, dtype=np.float64).ravel())
+    if not chunks:
+        return np.zeros((0,), np.float64)
+    return np.concatenate(chunks)
+
+
+def lifted_operands(op: GateOp, params: jax.Array, offset, state_dtype) -> dict:
+    """:func:`op_operands` twin for parameter-lifted programs (the serve
+    compilation cache): operands are STATIC slices of a runtime float64
+    vector instead of compile-time constants, so one compiled program
+    serves every payload assignment of its structural class.  The dtype
+    contract matches ``op_operands`` exactly — payloads cast to the state
+    dtype, the mrz angle kept float64 (params are float64 end-to-end)."""
+    if op.kind in ("matrix", "diagonal"):
+        size = int(np.prod(op.shape))
+        return {"payload": params[offset:offset + size]
+                .reshape(op.shape).astype(state_dtype)}
+    if op.kind == "mrz":
+        return {"angle": params[offset]}
+    return {}
+
+
+def _apply_one(state: jax.Array, op: GateOp, operands: dict | None = None) -> jax.Array:
+    if operands is None:
+        operands = op_operands(op, state.dtype)
     if op.kind == "matrix":
         return _ap.apply_matrix(state, operands["payload"], op.targets,
                                 op.controls, op.control_states)
@@ -282,13 +362,17 @@ def _shadow_op(op: GateOp, n: int) -> GateOp:
                   conj_matrix, op.shape)
 
 
-def _apply_one_routed(state: jax.Array, op: GateOp, perm: tuple):
+def _apply_one_routed(state: jax.Array, op: GateOp, perm: tuple,
+                      operands: dict | None = None):
     """Apply one op under a deferred logical->physical bit permutation:
     dense gates may extend the permutation instead of swapping back
     (ops/apply.py apply_matrix_routed); every other kind is position-
-    agnostic and just translates its wires.  Returns (state, perm)."""
+    agnostic and just translates its wires.  Returns (state, perm).
+    ``operands`` overrides the compile-time-constant payload with traced
+    arrays (the parameter-lifted path, :func:`lifted_operands`)."""
     if op.kind == "matrix":
-        u = jnp.asarray(op.payload(), dtype=state.dtype)
+        u = (operands["payload"] if operands is not None
+             else jnp.asarray(op.payload(), dtype=state.dtype))
         return _ap.apply_matrix_routed(state, u, op.targets, op.controls,
                                        op.control_states, perm)
     if op.kind == "bitperm":
@@ -301,18 +385,27 @@ def _apply_one_routed(state: jax.Array, op: GateOp, perm: tuple):
     c = tuple(perm[q] for q in op.controls)
     if t != op.targets or c != op.controls:
         op = GateOp(op.kind, t, c, op.control_states, op.matrix, op.shape)
-    return _apply_one(state, op), perm
+    return _apply_one(state, op, operands), perm
 
 
-def _run_ops_routed(state: jax.Array, ops: tuple) -> jax.Array:
+def _run_ops_routed(state: jax.Array, ops: tuple, params=None,
+                    offsets: tuple | None = None) -> jax.Array:
     """Whole-program op chain with deferred routing: wide minor-block gates
     swap INTO prefix positions once and the swap-back is paid once at the
     end (reconcile) instead of per gate — on a sharded state each avoided
     pair is two avoided all-to-alls (the reference's own unfixed TODO,
-    QuEST_cpu_distributed.c:1376-1379)."""
+    QuEST_cpu_distributed.c:1376-1379).
+
+    With ``params`` (a traced float64 vector) and ``offsets`` (a static
+    per-op offset tuple) the chain runs PARAMETER-LIFTED: each op's
+    continuous payload is sliced from ``params`` instead of embedded as a
+    constant, so the traced program is shared by every circuit of the
+    structural class (serve/cache.py)."""
     perm = tuple(range(_ap.num_qubits_of(state)))
-    for op in ops:
-        state, perm = _apply_one_routed(state, op, perm)
+    for i, op in enumerate(ops):
+        operands = (None if params is None
+                    else lifted_operands(op, params, offsets[i], state.dtype))
+        state, perm = _apply_one_routed(state, op, perm, operands)
     return _ap.reconcile_perm(state, perm)
 
 
@@ -321,20 +414,21 @@ def _run_ops(state: jax.Array, ops: tuple) -> jax.Array:
     return _run_ops_routed(state, ops)
 
 
-@lru_cache(maxsize=32)
+@lru_cache(maxsize=256)
 def _donated_program(ops: tuple):
-    """One jitted donating program per op tuple.  Without this cache every
-    ``compile_circuit(donate=True)`` call built a FRESH ``run`` closure, and
-    ``jax.jit`` caches per function object — so each call carried an empty
-    jit cache and retraced/recompiled the whole circuit (measured: one full
-    XLA compile per call in an iteration loop).  Keyed on ``circuit.key()``:
-    equal op lists share one program and trace once per state signature.
-    Bounded because compiled donating executables pin device memory; an
-    evicted entry just retraces on next use."""
-    @partial(jax.jit, donate_argnums=(0,))
-    def run(state: jax.Array) -> jax.Array:
-        return _run_ops_routed(state, ops)
-    return run
+    """One donating program per op tuple — since PR 5 an adapter over the
+    serve subsystem's parameter-lifted compilation cache
+    (quest_tpu/serve/cache.py), so there is ONE program cache with ONE
+    byte-budgeted eviction policy.  The compiled ``(state, params)``
+    executable is cached there on the STRUCTURAL key
+    (:meth:`Circuit.key` ``structural=True``): equal-structure circuits
+    differing only in rotation angles share one XLA program, where the old
+    per-op-tuple cache compiled once per angle assignment.  This wrapper
+    just closes over the op tuple's concrete operand vector
+    (:func:`param_vector`); an entry evicted from the serve cache
+    recompiles transparently on next use."""
+    from .serve.cache import global_cache
+    return global_cache().donating_runner(ops)
 
 
 def compile_circuit(circuit: Circuit, donate: bool = False,
@@ -343,7 +437,10 @@ def compile_circuit(circuit: Circuit, donate: bool = False,
     """Return a jitted ``state -> state`` applying the whole circuit as one
     XLA program.  ``donate=True`` reuses the input buffer (allocation-free
     iteration) — callers must not hold other references to the state; the
-    donated program is cached on ``circuit.key()`` (see _donated_program).
+    donated program lives in the serve layer's parameter-lifted compilation
+    cache keyed on ``circuit.key(structural=True)`` (see _donated_program:
+    equal-structure circuits differing only in gate payloads share one
+    compiled executable).
     ``num_devices`` runs the comm-aware scheduler first
     (:meth:`Circuit.schedule`): the compiled program is the scheduled,
     collective-minimised equivalent for an ``num_devices``-way amplitude

@@ -1,0 +1,21 @@
+"""quest_tpu.serve — batched multi-tenant circuit-execution service.
+
+The production front door the ROADMAP's north star asks for: a bounded-queue
+service (:class:`QuESTService`) that canonicalizes each submitted circuit to
+its structural class, compiles ONE parameter-lifted XLA program per class
+(cache.py), aggregates same-class requests into vmapped microbatches
+(batch.py), enforces deadlines and backpressure (service.py), and exports
+metrics as a dict and Prometheus text (metrics.py).
+
+``python -m quest_tpu.serve --selftest`` runs a synthetic multi-tenant
+workload and prints the metrics (the CI gate); see docs/SERVING.md.
+"""
+
+from .cache import (CacheOptions, CompileCache, circuit_from_params,  # noqa: F401
+                    global_cache)
+from .metrics import Metrics, parse_prometheus  # noqa: F401
+from .service import QuESTService, ServeResult  # noqa: F401
+
+__all__ = ["QuESTService", "ServeResult", "CompileCache", "CacheOptions",
+           "global_cache", "circuit_from_params", "Metrics",
+           "parse_prometheus"]

@@ -1,0 +1,40 @@
+"""``python -m quest_tpu.serve`` — the serving-layer CLI.
+
+``--selftest`` runs the synthetic multi-tenant workload (selftest.py):
+three single-device structural classes plus, on an 8+-device backend, a
+scheduled mesh class — asserting bit-identical results against the eager
+oracle, a >= 0.9 cache hit rate and a well-formed Prometheus export, then
+printing the metrics.  ``--json`` switches stdout to ONE machine-readable
+document (``{"ok":, "checks":, "metrics":, "prometheus":}``) for the CI
+gate.  Exit status 0 iff every check passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m quest_tpu.serve",
+        description="Batched multi-tenant circuit-execution service "
+                    "(docs/SERVING.md).")
+    parser.add_argument("--selftest", action="store_true",
+                        help="run the synthetic multi-tenant workload and "
+                             "print its metrics")
+    parser.add_argument("--scale", type=int, default=1,
+                        help="workload multiplier for the selftest "
+                             "(default 1: 64 single-device requests)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit ONE machine-readable JSON document")
+    args = parser.parse_args(argv)
+    if not args.selftest:
+        parser.print_usage()
+        return 2
+    from .selftest import run_selftest
+    return run_selftest(as_json=args.as_json, scale=max(1, args.scale))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

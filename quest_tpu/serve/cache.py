@@ -1,0 +1,449 @@
+"""Parameter-lifted compilation cache: ONE XLA program per structural class.
+
+The defect this fixes, at its root: ``compile_circuit`` keyed programs on
+``circuit.key()``, which embeds every gate payload — so a million users
+running the SAME VQE ansatz with different rotation angles meant a million
+identical-shape XLA compiles (the reference's own hot path has the same
+character: ``compactUnitary``/rotation decompositions in QuEST_common.c are
+angle-parameterized gates whose angles are runtime data, not program
+structure).  Here a circuit is canonicalized to its STRUCTURAL key
+(``Circuit.key(structural=True)``: op kinds, wires, arities, mesh/schedule
+options — continuous payloads lifted out into a flat float64 operand vector,
+``circuit.param_vector``), and ONE donating jitted ``(state, params)``
+program is compiled per structural class.  Each request then supplies its
+angles as a runtime operand — a cache hit costs an operand-vector build, not
+an XLA compile.
+
+Scheduled classes (``num_devices > 1``) compose with the PR 2 scheduler: the
+class REPRESENTATIVE is scheduled once and the scheduled op order is recorded
+as a skeleton whose per-op operand slots point back into the ORIGINAL op
+order (payload provenance survives the scheduler because reordering and
+placement relabeling preserve payload tuples, scheduler.py ``_relabel_op``) —
+so later requests of the class pay neither the schedule search nor the
+compile.  Overlapped classes (PR 4) are cached but NOT lifted: the pipelined
+executor embeds payloads host-side, so their programs key on the full op
+tuple within the class entry (documented in docs/SERVING.md).
+
+Compiled programs are ahead-of-time lowered (``jit(...).lower().compile()``)
+so the cache — not jax's per-function trace cache — owns every executable:
+hit/miss/eviction/compile counters are exact, and entries are LRU-evicted
+against a total compiled-bytes budget (compiled executables pin device
+memory for constants and temp buffers; an evicted class just recompiles on
+next use).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import circuit as _circ
+
+__all__ = ["CacheOptions", "CacheEntry", "CompileCache", "global_cache",
+           "circuit_from_params", "DEFAULT_MAX_BYTES"]
+
+DEFAULT_MAX_BYTES = int(os.environ.get("QUEST_TPU_SERVE_CACHE_BYTES",
+                                       str(1 << 30)))
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheOptions:
+    """Execution options that select a DIFFERENT compiled program and are
+    therefore part of the structural key (mesh width, scheduler overlap) —
+    precision is not listed because the state dtype is part of every
+    program signature already."""
+    num_devices: int | None = None
+    overlap: bool = False
+    pipeline_chunks: int | None = None
+
+
+@dataclasses.dataclass
+class _Program:
+    call: object          # the AOT-compiled executable (or opaque callable)
+    nbytes: int
+
+
+@dataclasses.dataclass
+class CacheEntry:
+    """One structural class: the (scheduled) skeleton, the operand-slot map
+    back into the original op order, and every compiled signature of the
+    class (singleton / batched / donating variants)."""
+    skey: tuple
+    options: CacheOptions
+    num_qubits: int | None
+    skeleton: tuple | None          # structural op tuple; None => opaque (overlap)
+    offsets: tuple | None           # per-skeleton-op offset into the param vector
+    num_params: int
+    programs: dict = dataclasses.field(default_factory=dict)
+    nbytes: int = 0
+    alive: bool = True
+
+
+def _provenance_offsets(orig_ops, sched_ops) -> tuple:
+    """Map each scheduled op's operand slot back to its offset in the
+    ORIGINAL op order's param vector.  The scheduler preserves payload
+    tuples through reordering and wire relabeling (scheduler.py
+    ``_relabel_op`` passes ``op.matrix`` through untouched for non-bitperm
+    kinds), so tuple identity is the provenance; value equality is the
+    defensive fallback for interned payloads."""
+    by_id: dict[int, int] = {}
+    by_val: dict[tuple, list] = {}
+    off = 0
+    for op in orig_ops:
+        c = _circ.op_param_count(op)
+        if c:
+            by_id[id(op.matrix)] = off
+            by_val.setdefault((op.kind, op.shape, op.matrix), []).append(off)
+        off += c
+    total = off
+    offsets: list[int | None] = []
+    used: set[int] = set()
+    for op in sched_ops:
+        if _circ.op_param_count(op) == 0:
+            offsets.append(None)
+            continue
+        o = by_id.get(id(op.matrix))
+        if o is None or o in used:
+            o = next((cand for cand in
+                      by_val.get((op.kind, op.shape, op.matrix), ())
+                      if cand not in used), None)
+        if o is None:
+            raise AssertionError(
+                f"scheduler broke payload provenance: {op.kind} on "
+                f"{op.targets} has no unmatched source op")
+        used.add(o)
+        offsets.append(o)
+    if len(used) != len(by_id):
+        raise AssertionError(
+            f"scheduled circuit dropped {len(by_id) - len(used)} "
+            "parameterized op(s)")
+    return tuple(offsets), total
+
+
+def circuit_from_params(num_qubits: int, skeleton, offsets, params) -> "_circ.Circuit":
+    """Rebuild a concrete Circuit from a structural skeleton + operand
+    vector — the inverse of the lift.  Used by the serve audit
+    (analysis/serve_audit.py): for a SCHEDULED skeleton this reconstructs
+    exactly the circuit the cached program executes for ``params``, which
+    the PR 3 translation validator can then prove equivalent to the
+    original request circuit."""
+    params = np.asarray(params, np.float64).ravel()
+    c = _circ.Circuit(num_qubits)
+    for op, off in zip(skeleton, offsets):
+        n_par = _circ.op_param_count(op)
+        if n_par == 0:
+            c.ops.append(op)
+            continue
+        payload = tuple(float(x) for x in params[off:off + n_par])
+        shape = op.shape if op.kind != "mrz" else None
+        c.ops.append(_circ.GateOp(op.kind, op.targets, op.controls,
+                                  op.control_states, payload, shape))
+    return c
+
+
+def _state_sig(state) -> tuple:
+    sharding = getattr(state, "sharding", None)
+    return (tuple(state.shape), str(state.dtype), repr(sharding))
+
+
+def _compiled_bytes(compiled) -> int:
+    """Device footprint of one AOT executable for the eviction budget:
+    generated code + temp allocations when the backend reports them, HLO
+    text length as the backend-agnostic fallback (proportional to program
+    size, which is what the budget needs to rank)."""
+    try:
+        ma = compiled.memory_analysis()
+        size = (int(getattr(ma, "generated_code_size_in_bytes", 0) or 0)
+                + int(getattr(ma, "temp_size_in_bytes", 0) or 0))
+        if size > 0:
+            return size
+    except Exception:
+        pass
+    try:
+        return len(compiled.as_text())
+    except Exception:
+        return 1 << 20
+
+
+class CompileCache:
+    """LRU of :class:`CacheEntry` bounded by total compiled bytes.
+
+    ``stats``: hits / misses / evictions (structural-class lookups),
+    compiles / compile_seconds (per-executable), entry_bytes / entries.
+    One process-global instance (:func:`global_cache`) backs BOTH
+    ``compile_circuit(donate=True)`` and every :class:`QuESTService` unless
+    a service is constructed with its own cache — one cache, one eviction
+    policy."""
+
+    def __init__(self, max_bytes: int | None = None):
+        self.max_bytes = DEFAULT_MAX_BYTES if max_bytes is None else int(max_bytes)
+        self._lock = threading.RLock()
+        self._entries: "OrderedDict[tuple, CacheEntry]" = OrderedDict()
+        self.stats = {"hits": 0, "misses": 0, "evictions": 0,
+                      "compiles": 0, "compile_seconds": 0.0,
+                      "entry_bytes": 0}
+        self.compile_times: list[float] = []
+
+    # -- structural lookup --------------------------------------------------
+    def entry_for(self, ops, num_qubits: int | None = None,
+                  options: CacheOptions = CacheOptions()) -> CacheEntry:
+        """The cache's one lookup: structural-key hit returns the existing
+        class entry (programs and schedule included); a miss canonicalizes
+        ``ops`` — scheduling the representative when the options carry a
+        mesh — and registers a fresh entry."""
+        skey = (num_qubits, tuple(_circ.structural_op(op) for op in ops),
+                options)
+        with self._lock:
+            e = self._entries.get(skey)
+            if e is not None:
+                self._entries.move_to_end(skey)
+                self.stats["hits"] += 1
+                return e
+            self.stats["misses"] += 1
+        e = self._build_entry(skey, tuple(ops), num_qubits, options)
+        with self._lock:
+            have = self._entries.get(skey)
+            if have is not None:      # raced with another thread's build
+                self._entries.move_to_end(skey)
+                return have
+            self._entries[skey] = e
+            self.stats["entry_bytes"] += e.nbytes
+            self._evict_locked()
+        return e
+
+    def _build_entry(self, skey, ops, num_qubits, options) -> CacheEntry:
+        if options.overlap:
+            # the pipelined executor (PR 4) embeds payloads host-side:
+            # cached, byte-budgeted, but not parameter-lifted
+            return CacheEntry(skey, options, num_qubits, None, None,
+                              int(sum(_circ.op_param_count(op) for op in ops)))
+        if options.num_devices is not None and options.num_devices > 1:
+            c = _circ.Circuit(num_qubits)
+            c.ops = list(ops)
+            sched = c.schedule(options.num_devices)
+            offsets, total = _provenance_offsets(ops, sched.ops)
+            skeleton = tuple(_circ.structural_op(op) for op in sched.ops)
+            return CacheEntry(skey, options, num_qubits, skeleton, offsets,
+                              total)
+        skeleton = tuple(_circ.structural_op(op) for op in ops)
+        offsets, off = [], 0
+        for op in ops:
+            c = _circ.op_param_count(op)
+            offsets.append(off if c else None)
+            off += c
+        return CacheEntry(skey, options, num_qubits, skeleton,
+                          tuple(offsets), off)
+
+    # -- program compilation ------------------------------------------------
+    def _get_program(self, entry: CacheEntry, tag: tuple, build) -> _Program:
+        with self._lock:
+            p = entry.programs.get(tag)
+            if p is not None:
+                return p
+        t0 = time.perf_counter()
+        call = build()
+        dt = time.perf_counter() - t0
+        nbytes = _compiled_bytes(call)
+        with self._lock:
+            p = entry.programs.get(tag)
+            if p is not None:       # raced: keep the first, drop ours
+                return p
+            p = entry.programs[tag] = _Program(call, nbytes)
+            entry.nbytes += nbytes
+            self.stats["compiles"] += 1
+            self.stats["compile_seconds"] += dt
+            self.compile_times.append(dt)
+            if len(self.compile_times) > 4096:
+                del self.compile_times[:2048]
+            if entry.alive:
+                self.stats["entry_bytes"] += nbytes
+                self._evict_locked()
+        return p
+
+    def single_program(self, entry: CacheEntry, state, *,
+                       donate: bool = False) -> _Program:
+        """The class's ``(state, params) -> state`` executable for this
+        state signature."""
+        assert entry.skeleton is not None, "opaque (overlap) entries have no lifted program"
+        tag = ("single", bool(donate), _state_sig(state))
+        skeleton, offsets, n_par = entry.skeleton, entry.offsets, entry.num_params
+
+        def build():
+            def run(st, params):
+                return _circ._run_ops_routed(st, skeleton, params, offsets)
+            jfn = jax.jit(run, donate_argnums=(0,) if donate else ())
+            pav = jax.ShapeDtypeStruct((n_par,), jnp.float64)
+            return jfn.lower(state, pav).compile()
+
+        return self._get_program(entry, tag, build)
+
+    def batch_program(self, entry: CacheEntry, state, batch: int, *,
+                      stacked: bool = False, mode: str = "map") -> _Program:
+        """The microbatch executable: params stacked on axis 0, initial
+        state broadcast (``stacked=False``, the shared-|0..0> fast path) or
+        per-request (``stacked=True``).  ``state`` is the UNBATCHED
+        prototype; its signature keys the program.
+
+        ``mode='map'`` (default) lowers the batch as ``lax.map`` — the
+        per-element computation is the IDENTICAL jaxpr to the singleton
+        program, so batched results are bit-identical to serial execution
+        (the serving contract).  ``mode='vmap'`` lowers one vectorized
+        program — on dense-gate circuits XLA's batched FMA fusion can
+        differ from the unbatched codegen in the LAST ULP (measured ~4e-17
+        on f64 CPU), so it trades the bit-identity guarantee for
+        throughput; see docs/SERVING.md."""
+        assert entry.skeleton is not None
+        if mode not in ("map", "vmap"):
+            raise ValueError(f"batch mode must be 'map' or 'vmap', got {mode!r}")
+        tag = ("batch", int(batch), bool(stacked), mode, _state_sig(state))
+        skeleton, offsets, n_par = entry.skeleton, entry.offsets, entry.num_params
+
+        def build():
+            def one(st, params):
+                return _circ._run_ops_routed(st, skeleton, params, offsets)
+
+            if mode == "vmap":
+                def run(st, pb):
+                    return jax.vmap(one, in_axes=(0 if stacked else None, 0))(st, pb)
+            elif stacked:
+                def run(sb, pb):
+                    return jax.lax.map(lambda xs: one(xs[0], xs[1]), (sb, pb))
+            else:
+                def run(st, pb):
+                    return jax.lax.map(lambda p: one(st, p), pb)
+
+            pav = jax.ShapeDtypeStruct((batch, n_par), jnp.float64)
+            sav = (jax.ShapeDtypeStruct((batch,) + tuple(state.shape),
+                                        state.dtype) if stacked else state)
+            return jax.jit(run).lower(sav, pav).compile()
+
+        return self._get_program(entry, tag, build)
+
+    def overlap_program(self, entry: CacheEntry, ops: tuple, *,
+                        donate: bool = False) -> _Program:
+        """Opaque per-payload program for an overlapped class (PR 4
+        executor; payloads compile-time).  Keyed on the FULL op tuple
+        inside the class entry so the byte budget still governs it."""
+        tag = ("overlap", bool(donate), ops)
+
+        def build():
+            from ..parallel import executor as _exec
+            c = _circ.Circuit(entry.num_qubits)
+            c.ops = list(ops)
+            sched = c.schedule(entry.options.num_devices, overlap=True,
+                               pipeline_chunks=entry.options.pipeline_chunks)
+            # a plain callable: _compiled_bytes falls through to its
+            # flat-rate charge, which is all the budget needs here
+            return _exec.overlapped_program(sched, entry.options.num_devices,
+                                            donate=donate)
+
+        return self._get_program(entry, tag, build)
+
+    # -- execution front-ends -----------------------------------------------
+    def execute(self, ops, state, params=None, *, num_qubits=None,
+                options: CacheOptions = CacheOptions(),
+                donate: bool = False):
+        """One-call lookup + compile-if-needed + run for a single request."""
+        entry = self.entry_for(ops, num_qubits, options)
+        if entry.skeleton is None:
+            return self.overlap_program(entry, tuple(ops),
+                                        donate=donate).call(state)
+        if params is None:
+            params = _circ.param_vector(ops)
+        params = self._check_params(entry, params)
+        prog = self.single_program(entry, state, donate=donate)
+        return prog.call(state, params)
+
+    def donating_runner(self, ops):
+        """The ``compile_circuit(donate=True)`` adapter: a ``state ->
+        state`` callable over this op tuple's operand vector and the
+        class's shared donating program.  The resolved (entry, program) is
+        memoized per state signature in the closure — donate exists for
+        tight iteration loops, which must not take the process-global cache
+        lock (or inflate the per-request hit counters) once per step; only
+        an evicted entry re-enters the cache."""
+        ops = tuple(ops)
+        params = jnp.asarray(_circ.param_vector(ops))
+        resolved: dict = {}
+
+        def run(state):
+            sig = _state_sig(state)
+            hit = resolved.get(sig)
+            if hit is None or not hit[0].alive:
+                entry = self.entry_for(ops)
+                prog = self.single_program(entry, state, donate=True)
+                resolved.clear()     # one live signature per loop in practice
+                resolved[sig] = hit = (entry, prog)
+            return hit[1].call(state, params)
+
+        return run
+
+    def _check_params(self, entry: CacheEntry, params):
+        params = jnp.asarray(params, jnp.float64).ravel()
+        if params.shape != (entry.num_params,):
+            raise ValueError(
+                f"operand vector has {params.shape[0]} scalars; this "
+                f"structural class takes {entry.num_params}")
+        return params
+
+    # -- bookkeeping --------------------------------------------------------
+    def _evict_locked(self) -> None:
+        """Drop least-recently-used classes until the byte budget holds.
+        The most recent entry always survives (a budget smaller than one
+        program must still serve that program)."""
+        while (self.stats["entry_bytes"] > self.max_bytes
+               and len(self._entries) > 1):
+            _, e = self._entries.popitem(last=False)
+            e.alive = False
+            self.stats["entry_bytes"] -= e.nbytes
+            self.stats["evictions"] += 1
+
+    def hit_rate(self) -> float:
+        with self._lock:
+            total = self.stats["hits"] + self.stats["misses"]
+            return self.stats["hits"] / total if total else 0.0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            d = dict(self.stats)
+            d["entries"] = len(self._entries)
+            d["max_bytes"] = self.max_bytes
+            d["hit_rate"] = (d["hits"] / (d["hits"] + d["misses"])
+                             if d["hits"] + d["misses"] else 0.0)
+            times = sorted(self.compile_times)
+            if times:
+                d["compile_seconds_p50"] = times[len(times) // 2]
+                d["compile_seconds_p99"] = times[min(len(times) - 1,
+                                                     round(0.99 * (len(times) - 1)))]
+            return d
+
+    def clear(self) -> None:
+        with self._lock:
+            for e in self._entries.values():
+                e.alive = False
+            self._entries.clear()
+            self.stats = {"hits": 0, "misses": 0, "evictions": 0,
+                          "compiles": 0, "compile_seconds": 0.0,
+                          "entry_bytes": 0}
+            self.compile_times = []
+
+
+_GLOBAL: CompileCache | None = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def global_cache() -> CompileCache:
+    """The process-wide cache shared by ``compile_circuit(donate=True)``
+    and default-constructed services — the single eviction policy."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = CompileCache()
+        return _GLOBAL

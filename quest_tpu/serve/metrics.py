@@ -1,0 +1,196 @@
+"""Serving metrics: thread-safe counters, gauges and histograms with dict
+and Prometheus text exports.
+
+The reference has no observability surface at all (a QuEST run reports
+through ``reportQuregParams`` printfs); a serving layer lives or dies by its
+metrics — queue depth tells you when to shed load, the cache hit rate is THE
+number that says parameter lifting is working, and latency percentiles are
+the SLO.  Kept dependency-free on purpose: the container must not grow a
+prometheus_client requirement, and the text exposition format is a stable,
+trivially-writable contract (one ``name{labels} value`` line per sample).
+
+Histograms keep both fixed buckets (the Prometheus export) and a bounded
+reservoir of raw observations (exact p50/p99 for the dict export — at serve
+request rates a few thousand retained floats are noise)."""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["Metrics", "parse_prometheus",
+           "LATENCY_BUCKETS", "BATCH_BUCKETS"]
+
+# seconds; spans sub-ms CPU microbatches to stuck-queue outliers
+LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+BATCH_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
+
+_RESERVOIR_CAP = 8192  # raw observations kept per histogram (FIFO halved)
+
+
+class _Histogram:
+    __slots__ = ("buckets", "counts", "total", "count", "raw")
+
+    def __init__(self, buckets):
+        self.buckets = tuple(float(b) for b in buckets)
+        self.counts = [0] * (len(self.buckets) + 1)  # last = +Inf
+        self.total = 0.0
+        self.count = 0
+        self.raw: list[float] = []
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        self.total += value
+        self.count += 1
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                self.counts[i] += 1
+                break
+        else:
+            self.counts[-1] += 1
+        self.raw.append(value)
+        if len(self.raw) > _RESERVOIR_CAP:
+            # drop the oldest half: percentiles stay recent-biased, O(1) amortised
+            del self.raw[:_RESERVOIR_CAP // 2]
+
+    def percentile(self, q: float) -> float:
+        if not self.raw:
+            return 0.0
+        xs = sorted(self.raw)
+        idx = min(len(xs) - 1, max(0, round(q / 100.0 * (len(xs) - 1))))
+        return xs[int(idx)]
+
+    def summary(self) -> dict:
+        mean = self.total / self.count if self.count else 0.0
+        return {"count": self.count, "sum": self.total, "mean": mean,
+                "p50": self.percentile(50.0), "p99": self.percentile(99.0)}
+
+
+class Metrics:
+    """A tiny metric registry: ``inc``/``set_gauge``/``observe`` and two
+    exports — ``as_dict()`` for programmatic callers (the selftest gate)
+    and ``to_prometheus()`` for scrapers.  All methods are thread-safe."""
+
+    def __init__(self, prefix: str = "quest_serve"):
+        self.prefix = prefix
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = {}
+        self._gauges: dict[str, float] = {}
+        self._hists: dict[str, _Histogram] = {}
+
+    # -- recording ----------------------------------------------------------
+    def inc(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + value
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def observe(self, name: str, value: float, buckets=LATENCY_BUCKETS) -> None:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = _Histogram(buckets)
+            h.observe(value)
+
+    def counter(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0.0)
+
+    # -- export -------------------------------------------------------------
+    def as_dict(self) -> dict:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": {k: h.summary() for k, h in self._hists.items()},
+            }
+
+    def to_prometheus(self, extra_gauges: dict | None = None) -> str:
+        """The Prometheus text exposition format.  ``extra_gauges`` lets the
+        service splice point-in-time values (cache stats snapshot) into the
+        same scrape without them living in the registry."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = {k: (h.buckets, list(h.counts), h.total, h.count)
+                     for k, h in self._hists.items()}
+        if extra_gauges:
+            gauges.update({k: float(v) for k, v in extra_gauges.items()})
+        p = self.prefix
+        lines: list[str] = []
+        for name in sorted(counters):
+            full = f"{p}_{name}"
+            lines.append(f"# TYPE {full} counter")
+            lines.append(f"{full} {_fmt(counters[name])}")
+        for name in sorted(gauges):
+            full = f"{p}_{name}"
+            lines.append(f"# TYPE {full} gauge")
+            lines.append(f"{full} {_fmt(gauges[name])}")
+        for name in sorted(hists):
+            buckets, counts, total, count = hists[name]
+            full = f"{p}_{name}"
+            lines.append(f"# TYPE {full} histogram")
+            cum = 0
+            for b, c in zip(buckets, counts[:-1]):
+                cum += c
+                lines.append(f'{full}_bucket{{le="{_fmt(b)}"}} {cum}')
+            cum += counts[-1]
+            lines.append(f'{full}_bucket{{le="+Inf"}} {cum}')
+            lines.append(f"{full}_sum {_fmt(total)}")
+            lines.append(f"{full}_count {count}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def parse_prometheus(text: str) -> dict:
+    """Strict-enough parser for the exposition format this module emits
+    (used by the CI gate and tests to prove the export is well-formed).
+    Returns ``{metric_sample_name: {label_string_or_'': value}}``; raises
+    ``ValueError`` on any malformed line or on a histogram whose cumulative
+    bucket counts decrease."""
+    samples: dict[str, dict[str, float]] = {}
+    last_hist_cum: dict[str, float] = {}
+    for ln, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) < 4 or parts[1] not in ("TYPE", "HELP"):
+                raise ValueError(f"line {ln}: malformed comment {line!r}")
+            if parts[1] == "TYPE" and parts[3] not in (
+                    "counter", "gauge", "histogram", "summary", "untyped"):
+                raise ValueError(f"line {ln}: unknown metric type {parts[3]!r}")
+            continue
+        name_part, _, value_part = line.rpartition(" ")
+        if not name_part:
+            raise ValueError(f"line {ln}: no value in {line!r}")
+        try:
+            value = float(value_part)
+        except ValueError:
+            raise ValueError(f"line {ln}: bad value {value_part!r}") from None
+        labels = ""
+        name = name_part
+        if "{" in name_part:
+            if not name_part.endswith("}"):
+                raise ValueError(f"line {ln}: malformed labels in {line!r}")
+            name, _, labels = name_part.partition("{")
+            labels = labels[:-1]
+        if not name.replace("_", "").replace(":", "").isalnum():
+            raise ValueError(f"line {ln}: bad metric name {name!r}")
+        samples.setdefault(name, {})[labels] = value
+        if name.endswith("_bucket"):
+            prev = last_hist_cum.get(name)
+            if prev is not None and value < prev:
+                raise ValueError(
+                    f"line {ln}: histogram {name} buckets not cumulative")
+            last_hist_cum[name] = value
+    if not samples:
+        raise ValueError("no metric samples found")
+    return samples

@@ -1,0 +1,214 @@
+"""Synthetic multi-tenant serving workload: the serve subsystem's selftest.
+
+Drives :class:`QuESTService` with the traffic shape the subsystem exists
+for — many tenants, few structural classes: a 10q VQE ansatz under 40
+different angle assignments (the parameter-lifted cache's headline case),
+a repeated 8q QFT (identical payloads, pure cache hits), a sampled 6q
+random-circuit class exercising per-request RNG streams, and — when the
+backend exposes an 8-device mesh — a 12q QFT class served through the PR 2
+comm-aware scheduler.  Checks results bit-identically against the eager
+per-circuit oracle, pins the cache hit rate, and proves the Prometheus
+export well-formed.
+
+This is the CI gate (``python -m quest_tpu.serve --selftest``; ci.yml
+``serve-selftest`` job) and the default workload of the serve audit
+(``python -m quest_tpu.analysis --serve-audit``, analysis/serve_audit.py).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+import numpy as np
+
+__all__ = ["vqe_ansatz", "workload_classes", "audit_circuits",
+           "run_selftest"]
+
+_SEED = 7
+
+
+def vqe_ansatz(num_qubits: int, layers: int, seed: int):
+    """Rotation + entangler ansatz: per-layer ry wall, CNOT ladder, rz wall
+    — the compactUnitary/rotation shape of the reference's hot path
+    (QuEST_common.c) with every angle a liftable operand."""
+    from ..circuit import Circuit
+    rng = np.random.default_rng(seed)
+    c = Circuit(num_qubits)
+    for layer in range(layers):
+        for q in range(num_qubits):
+            c.ry(q, float(rng.uniform(-math.pi, math.pi)))
+        for q in range(layer % 2, num_qubits - 1, 2):
+            c.cnot(q, q + 1)
+        for q in range(num_qubits):
+            c.rz(q, float(rng.uniform(-math.pi, math.pi)))
+    return c
+
+
+def workload_classes(scale: int = 1) -> list:
+    """The synthetic tenant mix: ``(label, [circuits], shots)`` per
+    structural class.  ``scale`` multiplies request counts."""
+    from ..circuit import qft_circuit, random_circuit
+    return [
+        ("vqe10", [vqe_ansatz(10, 2, seed=s) for s in range(40 * scale)], 0),
+        ("qft8", [qft_circuit(8) for _ in range(10 * scale)], 0),
+        ("random6_sampled",
+         [random_circuit(6, depth=2, seed=s) for s in range(14 * scale)], 64),
+    ]
+
+
+def audit_circuits() -> list:
+    """One representative + one angle-perturbed twin per structural class
+    (the serve audit's default workload)."""
+    from ..circuit import qft_circuit, random_circuit
+    return [
+        ("vqe10", vqe_ansatz(10, 2, seed=0), vqe_ansatz(10, 2, seed=1)),
+        ("qft8", qft_circuit(8), qft_circuit(8)),
+        ("random6", random_circuit(6, depth=2, seed=0),
+         random_circuit(6, depth=2, seed=1)),
+    ]
+
+
+def _check(checks: dict, name: str, ok: bool, detail: str = "") -> bool:
+    checks[name] = {"ok": bool(ok), "detail": detail}
+    return bool(ok)
+
+
+def run_selftest(as_json: bool = False, scale: int = 1) -> int:
+    """Run the workload through fresh services sharing one fresh cache;
+    print metrics (human text, or ONE JSON document with ``--json``).
+    Returns the process exit status: 0 iff every check passed."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..circuit import _run_ops
+    from ..ops import measure as _meas
+    from ..rng import MT19937
+    from .cache import CompileCache
+    from .metrics import parse_prometheus
+    from .service import QuESTService
+
+    def echo(line: str) -> None:
+        if not as_json:
+            print(line)
+
+    cache = CompileCache()
+    checks: dict = {}
+    ok = True
+
+    svc = QuESTService(max_batch=16, max_delay_ms=10, seed=_SEED,
+                       cache=cache, start=False)
+    submitted = []  # (label, circuit, shots, future)
+    classes = workload_classes(scale)
+    # interleave classes round-robin: the aggregator must re-group them
+    longest = max(len(cs) for _, cs, _ in classes)
+    for i in range(longest):
+        for label, circuits, shots in classes:
+            if i < len(circuits):
+                submitted.append((label, circuits[i], shots,
+                                  svc.submit(circuits[i], shots=shots)))
+    svc.start()
+    drained = svc.drain(timeout=600)
+    ok &= _check(checks, "drain", drained, "queue drained within timeout")
+
+    # mesh class through the PR 2 scheduler (composition proof)
+    mesh_pair = None
+    if len(jax.devices()) >= 8:
+        from ..circuit import qft_circuit
+        svc_mesh = QuESTService(num_devices=8, max_batch=8, max_delay_ms=10,
+                                seed=_SEED, cache=cache, start=False)
+        mesh_circ = qft_circuit(12)
+        mesh_futs = [svc_mesh.submit(qft_circuit(12)) for _ in range(8)]
+        svc_mesh.start()
+        ok &= _check(checks, "mesh_drain", svc_mesh.drain(timeout=600))
+        mesh_pair = (mesh_circ, mesh_futs)
+        svc_mesh.shutdown()
+
+    # correctness, two contracts per class (docs/SERVING.md "numerics"):
+    # (1) the batched result is BIT-IDENTICAL to serial per-circuit
+    #     execution — batching must never change a tenant's answer;
+    # (2) it agrees with the constant-embedded eager program to a couple of
+    #     f64 ulps (the two compilations may legally differ in FMA
+    #     contraction; exact equivalence is machine-proven by
+    #     `python -m quest_tpu.analysis --serve-audit`)
+    seen: set = set()
+    exact = True
+    worst_ulp = 0.0
+    n_checked = 0
+    for label, circuit, shots, fut in submitted:
+        if label in seen:
+            continue
+        seen.add(label)
+        res = fut.result(timeout=60)
+        st = jnp.zeros((2, 1 << circuit.num_qubits),
+                       jnp.float64).at[0, 0].set(1.0)
+        serial = np.asarray(cache.execute(circuit.key(), st,
+                                          num_qubits=circuit.num_qubits))
+        if not np.array_equal(res.state, serial):
+            exact = False
+            echo(f"FAIL {label}: batched state != serial execution "
+                 f"(max |diff| {np.abs(res.state - serial).max():.3g})")
+        eager = np.asarray(_run_ops(st, circuit.key()))
+        worst_ulp = max(worst_ulp, float(np.abs(res.state - eager).max()))
+        n_checked += 1
+        if shots:
+            probs = np.asarray(_meas.prob_all_outcomes(
+                jnp.asarray(serial), tuple(range(circuit.num_qubits))))
+            cdf = np.cumsum(probs)
+            gen = MT19937()
+            gen.init_by_array([_SEED, res.request_id])
+            draws = gen.genrand_real1_batch(shots)
+            expect = np.searchsorted(cdf, draws * cdf[-1], side="right")
+            expect = np.minimum(expect, np.nonzero(probs > 0)[0][-1])
+            if not np.array_equal(res.samples, expect.astype(np.int64)):
+                exact = False
+                echo(f"FAIL {label}: sample stream diverged from the "
+                     "per-request MT19937 oracle")
+    ok &= _check(checks, "results_bit_identical_to_serial", exact,
+                 f"{n_checked} classes checked")
+    ok &= _check(checks, "results_near_eager_oracle", worst_ulp < 1e-14,
+                 f"max |served - eager| = {worst_ulp:.3g}")
+
+    if mesh_pair is not None:
+        circ, futs = mesh_pair
+        st = jnp.zeros((2, 1 << circ.num_qubits),
+                       jnp.float64).at[0, 0].set(1.0)
+        want = np.asarray(_run_ops(st, circ.key()))
+        worst = max(float(np.abs(f.result(timeout=60).state - want).max())
+                    for f in futs)
+        ok &= _check(checks, "mesh_results", worst < 1e-10,
+                     f"scheduled x8 class max |diff| {worst:.3g}")
+
+    # every future resolved successfully
+    failed = sum(1 for _, _, _, f in submitted if f.exception() is not None)
+    ok &= _check(checks, "no_failures", failed == 0,
+                 f"{failed} failed futures of {len(submitted)}")
+
+    snap = cache.snapshot()
+    hit_rate = snap["hit_rate"]
+    ok &= _check(checks, "cache_hit_rate", hit_rate >= 0.9,
+                 f"hit rate {hit_rate:.3f} over {snap['hits'] + snap['misses']}"
+                 f" lookups ({snap['compiles']} compiles)")
+
+    prom = svc.prometheus()
+    try:
+        parsed = parse_prometheus(prom)
+        ok &= _check(checks, "prometheus_parses", True,
+                     f"{len(parsed)} metric families")
+    except ValueError as exc:
+        ok &= _check(checks, "prometheus_parses", False, str(exc))
+
+    metrics = svc.metrics_dict()
+    svc.shutdown()
+    if as_json:
+        print(json.dumps({"ok": bool(ok), "checks": checks,
+                          "metrics": metrics, "prometheus": prom},
+                         default=float))
+    else:
+        for name, r in checks.items():
+            echo(f"[{'ok' if r['ok'] else 'FAIL'}] {name}: {r['detail']}")
+        echo("--- metrics ---")
+        echo(json.dumps(metrics, indent=1, default=float))
+        echo("--- prometheus ---")
+        echo(prom)
+    return 0 if ok else 1

@@ -1,0 +1,383 @@
+"""QuESTService: the multi-tenant front door over the compile cache.
+
+``submit(circuit, params=None, shots=0, deadline_ms=None)`` returns a
+``concurrent.futures.Future``; a background worker groups queued requests by
+structural class and runs each group as one vmapped microbatch (batch.py)
+through the parameter-lifted cache (cache.py).  The queue is BOUNDED —
+overflow raises ``E_QUEUE_FULL`` at submit time (backpressure belongs at the
+front door, not in an unbounded deque that OOMs the host) — and deadlines
+are enforced when a request would enter a batch: an expired request
+completes exceptionally with ``E_DEADLINE_EXCEEDED`` instead of occupying a
+batch slot and making every co-batched request later.
+
+Measurement sampling is per-request and batching-invariant: request ``i``
+draws from its OWN MT19937 stream seeded ``(service_seed, request_id)`` —
+the reference's one global stream (QuEST_common.c:155-170) would make
+outcomes depend on scheduling order, which a batching server must never do.
+Results are bit-identical to serial per-circuit execution in the default
+``batch_mode='map'``: the lifted program runs the same routed op chain with
+the same operand values, and the ``lax.map`` batch lowering keeps the
+per-element jaxpr identical to the singleton program.  ``batch_mode='vmap'``
+vectorizes across the batch instead — measurably faster on wide batches,
+bit-exact only to the last f64 ulp (XLA's batched FMA fusion differs; see
+docs/SERVING.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future, InvalidStateError
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import circuit as _circ
+from ..rng import MT19937
+from ..validation import ErrorCode, MESSAGES, QuESTError
+from . import batch as _batch
+from .cache import CacheOptions, CompileCache, global_cache
+from .metrics import BATCH_BUCKETS, Metrics
+
+__all__ = ["QuESTService", "ServeResult"]
+
+_U32 = 0xFFFFFFFF
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """One completed request: the final (2, 2^n) SoA state, the per-request
+    sample draws (``shots`` joint outcomes over all qubits, or None), and
+    the batch context it executed in."""
+    state: np.ndarray
+    samples: np.ndarray | None
+    batch_size: int
+    request_id: int
+
+
+@dataclasses.dataclass
+class _Request:
+    rid: int
+    ops: tuple
+    num_qubits: int
+    params: np.ndarray
+    shots: int
+    deadline: float | None          # absolute time.monotonic(), or None
+    initial_state: np.ndarray | None
+    future: Future
+    enqueue_t: float
+    group_key: tuple
+
+
+class QuESTService:
+    """Batched circuit-execution service over one device (default) or a
+    ``num_devices``-way amplitude mesh (requests are scheduled through the
+    PR 2 comm-aware scheduler once per structural class).
+
+    Knobs: ``max_batch``/``max_delay_ms`` bound the microbatch aggregator
+    (a group executes when it fills OR when its oldest request has waited
+    the delay); ``max_queue`` bounds admission; ``seed`` roots the
+    per-request sample streams; ``start=False`` defers the worker so a
+    caller can stage a burst and then :meth:`start` it as one batch wave
+    (benchmarks, tests)."""
+
+    def __init__(self, *, num_devices: int | None = None,
+                 overlap: bool = False, pipeline_chunks: int | None = None,
+                 max_batch: int = 16, max_delay_ms: float = 2.0,
+                 max_queue: int = 1024, seed: int = 0, dtype=None,
+                 batch_mode: str = "map",
+                 cache: CompileCache | None = None,
+                 metrics: Metrics | None = None, start: bool = True):
+        if batch_mode not in ("map", "vmap"):
+            raise ValueError(
+                f"batch_mode must be 'map' or 'vmap', got {batch_mode!r}")
+        self.batch_mode = batch_mode
+        if overlap and (num_devices is None or num_devices < 2):
+            raise QuESTError(ErrorCode.INVALID_SCHEDULE_OPTION,
+                             MESSAGES[ErrorCode.INVALID_SCHEDULE_OPTION]
+                             + " overlap=True requires num_devices=.",
+                             "QuESTService")
+        self._options = CacheOptions(num_devices=num_devices, overlap=overlap,
+                                     pipeline_chunks=pipeline_chunks)
+        self.max_batch = max(1, int(max_batch))
+        self.max_delay_s = max(0.0, float(max_delay_ms) / 1000.0)
+        self.max_queue = max(1, int(max_queue))
+        self.seed = int(seed)
+        self.dtype = jnp.float64 if dtype is None else dtype
+        self._cache = cache if cache is not None else global_cache()
+        self.metrics = metrics if metrics is not None else Metrics()
+        self._sharding = None
+        if num_devices is not None and num_devices > 1:
+            from ..parallel.mesh import amp_sharding, make_amps_mesh
+            devices = jax.devices()
+            if len(devices) < num_devices:
+                raise QuESTError(ErrorCode.INVALID_NUM_RANKS,
+                                 MESSAGES[ErrorCode.INVALID_NUM_RANKS]
+                                 + f" ({len(devices)} devices visible, "
+                                 f"{num_devices} requested.)", "QuESTService")
+            self._sharding = amp_sharding(make_amps_mesh(devices[:num_devices]))
+        self._cond = threading.Condition()
+        self._queue: list[_Request] = []
+        self._inflight = 0
+        self._next_rid = 0
+        self._accepting = True
+        self._stop = False
+        self._draining = False
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="quest-serve-worker")
+        self._started = False
+        if start:
+            self.start()
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "QuESTService":
+        if not self._started:
+            self._started = True
+            self._worker.start()
+        return self
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """Block until every queued and in-flight request has completed.
+        Returns False on timeout."""
+        end = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+            try:
+                while self._queue or self._inflight:
+                    left = None if end is None else end - time.monotonic()
+                    if left is not None and left <= 0:
+                        return False
+                    self._cond.wait(timeout=0.05 if left is None
+                                    else min(0.05, left))
+            finally:
+                self._draining = False
+        return True
+
+    def shutdown(self, drain: bool = True, timeout: float | None = None) -> None:
+        """Stop accepting requests; with ``drain`` (default) finish
+        everything queued first, otherwise fail pending requests."""
+        with self._cond:
+            self._accepting = False
+        if drain and self._started:
+            self.drain(timeout=timeout)
+        with self._cond:
+            dropped, self._queue = self._queue, []
+            self._stop = True
+            self._cond.notify_all()
+        for req in dropped:
+            self._fail(req, RuntimeError(
+                "QuESTService shut down before execution"))
+        if self._started:
+            self._worker.join(timeout=timeout)
+
+    def __enter__(self) -> "QuESTService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown(drain=exc == (None, None, None))
+
+    # -- submission ---------------------------------------------------------
+    def submit(self, circuit, params=None, shots: int = 0,
+               deadline_ms: float | None = None,
+               initial_state=None) -> Future:
+        """Enqueue one request; the Future resolves to a
+        :class:`ServeResult` (or raises ``QuESTError`` for deadline expiry,
+        or whatever the execution raised).
+
+        ``params`` overrides the circuit's own operand vector (the
+        multi-tenant idiom: ONE recorded ansatz object, per-user angles) —
+        it must match the structural class's operand count.  ``shots``
+        joint outcomes over all qubits are drawn from the request's private
+        RNG stream.  ``deadline_ms`` is relative to submission."""
+        if not isinstance(circuit, _circ.Circuit):
+            raise TypeError(f"submit takes a Circuit, got {type(circuit)!r}")
+        ops = circuit.key()
+        expected = int(sum(_circ.op_param_count(op) for op in ops))
+        if params is None:
+            pvec = _circ.param_vector(ops)
+        else:
+            if self._options.overlap:
+                raise ValueError(
+                    "overlap services take parameters embedded in the "
+                    "circuit: the pipelined executor compiles payloads in")
+            pvec = np.asarray(params, np.float64).ravel()
+            if pvec.shape != (expected,):
+                raise ValueError(
+                    f"params has {pvec.shape[0]} scalars; this circuit's "
+                    f"structural class takes {expected}")
+        state0 = None
+        if initial_state is not None:
+            state0 = np.asarray(initial_state)
+            if state0.shape != (2, 1 << circuit.num_qubits):
+                raise ValueError(
+                    f"initial_state must be (2, 2^n) SoA, got {state0.shape}")
+        shots = int(shots)
+        if shots < 0:
+            raise ValueError("shots must be >= 0")
+        now = time.monotonic()
+        deadline = None if deadline_ms is None else now + float(deadline_ms) / 1000.0
+        group_key = (circuit.num_qubits, circuit.key(structural=True),
+                     state0 is None)
+        fut: Future = Future()
+        with self._cond:
+            if not self._accepting or self._stop:
+                raise RuntimeError("QuESTService is shut down")
+            if len(self._queue) >= self.max_queue:
+                self.metrics.inc("queue_rejected_total")
+                raise QuESTError(ErrorCode.QUEUE_FULL,
+                                 MESSAGES[ErrorCode.QUEUE_FULL], "submit")
+            rid = self._next_rid
+            self._next_rid += 1
+            self._queue.append(_Request(rid, ops, circuit.num_qubits, pvec,
+                                        shots, deadline, state0, fut, now,
+                                        group_key))
+            self.metrics.inc("requests_submitted_total")
+            self.metrics.set_gauge("queue_depth", len(self._queue))
+            self._cond.notify_all()
+        return fut
+
+    # -- worker -------------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._stop:
+                    self._cond.wait()
+                if self._stop and not self._queue:
+                    return
+                head = self._queue[0]
+                group = _batch.group_ready(self._queue, head.group_key,
+                                           self.max_batch)
+                # fill the batch: LOOP the wait (any submit's notify wakes
+                # us), flushing only when the group is full or the oldest
+                # request has genuinely waited out max_delay_ms
+                fill_deadline = head.enqueue_t + self.max_delay_s
+                while (len(group) < self.max_batch and not self._stop
+                       and not self._draining):
+                    left = fill_deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._cond.wait(timeout=left)
+                    group = _batch.group_ready(self._queue, head.group_key,
+                                               self.max_batch)
+                for req in group:
+                    self._queue.remove(req)
+                self._inflight += len(group)
+                self.metrics.set_gauge("queue_depth", len(self._queue))
+            try:
+                self._execute(group)
+            finally:
+                with self._cond:
+                    self._inflight -= len(group)
+                    self._cond.notify_all()
+
+    @staticmethod
+    def _fail(req: _Request, exc: BaseException) -> None:
+        """Deliver an exception, tolerating a future the caller cancelled
+        or that already completed — a tenant's cancel() must never be able
+        to kill the worker thread."""
+        try:
+            req.future.set_exception(exc)
+        except InvalidStateError:
+            pass
+
+    def _state(self, req: _Request):
+        if req.initial_state is None:
+            st = jnp.zeros((2, 1 << req.num_qubits),
+                           self.dtype).at[0, 0].set(1.0)
+        else:
+            st = jnp.asarray(req.initial_state, self.dtype)
+        if self._sharding is not None:
+            st = jax.device_put(st, self._sharding)
+        return st
+
+    def _execute(self, group: list) -> None:
+        now = time.monotonic()
+        live = []
+        for req in group:
+            if req.deadline is not None and now > req.deadline:
+                self.metrics.inc("deadline_expired_total")
+                self._fail(req, QuESTError(
+                    ErrorCode.DEADLINE_EXCEEDED,
+                    MESSAGES[ErrorCode.DEADLINE_EXCEEDED], "submit"))
+            elif not req.future.set_running_or_notify_cancel():
+                continue        # caller cancelled before execution: drop
+            else:
+                live.append(req)
+        if not live:
+            return
+        try:
+            # one lookup PER REQUEST (not per group): the hit/miss counters
+            # are the per-request serving economics — 64 same-class requests
+            # are 1 miss + 63 hits however they happen to batch
+            for req in live:
+                entry = self._cache.entry_for(req.ops, req.num_qubits,
+                                              self._options)
+            t0 = time.perf_counter()
+            if entry.skeleton is None:
+                # opaque overlapped class (PR 4): per-request programs
+                states = [self._cache.overlap_program(entry, req.ops)
+                          .call(self._state(req)) for req in live]
+                padded = len(live)
+            else:
+                states, padded = _batch.execute_group(
+                    self._cache, entry, live, self._state, self.max_batch,
+                    mode=self.batch_mode)
+            jax.block_until_ready(states[-1])
+            dt = time.perf_counter() - t0
+            self.metrics.inc("batches_total")
+            self.metrics.observe("batch_size", len(live),
+                                 buckets=BATCH_BUCKETS)
+            self.metrics.observe("execute_seconds", dt)
+            if padded > len(live):
+                self.metrics.inc("padded_requests_total", padded - len(live))
+            done_t = time.monotonic()
+            for req, st in zip(live, states):
+                samples = self._sample(st, req) if req.shots else None
+                try:
+                    req.future.set_result(ServeResult(np.asarray(st), samples,
+                                                      len(live), req.rid))
+                except InvalidStateError:
+                    continue        # raced a cancel mid-execution
+                self.metrics.inc("requests_completed_total")
+                self.metrics.observe("request_latency_seconds",
+                                     done_t - req.enqueue_t)
+        except Exception as exc:  # noqa: BLE001 — forwarded to the futures
+            for req in live:
+                self._fail(req, exc)
+            self.metrics.inc("requests_failed_total", len(live))
+
+    def _sample(self, state, req: _Request) -> np.ndarray:
+        """``req.shots`` joint outcomes over all qubits from the request's
+        PRIVATE MT19937 stream seeded (service_seed, request_id): the same
+        inverse-CDF draw as the API's sampleOutcomes, but isolated so
+        batching order can never change any request's outcomes."""
+        from ..ops import measure as _meas
+        probs = np.asarray(_meas.prob_all_outcomes(
+            state, tuple(range(req.num_qubits))))
+        cdf = np.cumsum(probs)
+        total = cdf[-1]
+        if not np.isfinite(total) or total <= 0:
+            raise ValueError(f"unnormalisable result state (sum {total})")
+        gen = MT19937()
+        gen.init_by_array([self.seed & _U32, req.rid & _U32])
+        draws = gen.genrand_real1_batch(req.shots)
+        outcomes = np.searchsorted(cdf, draws * total, side="right")
+        last_pos = np.nonzero(probs > 0)[0][-1]
+        self.metrics.inc("samples_drawn_total", req.shots)
+        return np.minimum(outcomes, last_pos).astype(np.int64)
+
+    # -- observability ------------------------------------------------------
+    def metrics_dict(self) -> dict:
+        d = self.metrics.as_dict()
+        d["cache"] = self._cache.snapshot()
+        d["cache_hit_rate"] = d["cache"]["hit_rate"]
+        return d
+
+    def prometheus(self) -> str:
+        cache = self._cache.snapshot()
+        extra = {f"cache_{k}": v for k, v in cache.items()
+                 if isinstance(v, (int, float))}
+        return self.metrics.to_prometheus(extra_gauges=extra)

@@ -99,6 +99,11 @@ class ErrorCode:
     PLANE_ONLY = "E_PLANE_ONLY"
     QUREG_NOT_INITIALISED = "E_QUREG_NOT_INITIALISED"
     INVALID_SCHEDULE_OPTION = "E_INVALID_SCHEDULE_OPTION"
+    # serving layer (quest_tpu/serve) — no reference analogue: the C API has
+    # no request queue; these are the backpressure/deadline contract of
+    # QuESTService (docs/SERVING.md)
+    QUEUE_FULL = "E_QUEUE_FULL"
+    DEADLINE_EXCEEDED = "E_DEADLINE_EXCEEDED"
 
 
 # Human-readable messages; tests substring-match these, mirroring the
@@ -173,6 +178,8 @@ MESSAGES = {
     ErrorCode.PLANE_ONLY_1Q: "This register uses plane-pair storage (the single-chip memory ceiling); only single-qubit uncontrolled gates are supported at this size. Apply multi-qubit/controlled gates on a register below the plane-storage threshold.",
     ErrorCode.QUREG_NOT_INITIALISED: "The register's amplitude storage has not been initialised, or was already destroyed (destroyQureg).",
     ErrorCode.INVALID_SCHEDULE_OPTION: "Unknown scheduler option. Circuit.schedule accepts only chip, precision, placement, reorder, overlap and pipeline_chunks.",
+    ErrorCode.QUEUE_FULL: "The serving queue holds max_queue pending requests; this request was rejected for backpressure. Retry after the queue drains, raise max_queue, or add capacity.",
+    ErrorCode.DEADLINE_EXCEEDED: "The request's deadline expired before a batch slot was available; it was completed exceptionally without executing.",
     ErrorCode.PLANE_ONLY: "This register uses plane-pair storage (the single-chip memory ceiling); the requested operation needs the stacked amplitude array, which cannot be materialised at this size. Supported in plane mode: init*, single-qubit gates, applyFullQFT, measure/collapse, probabilities, amplitude reads.",
 }
 

@@ -399,18 +399,24 @@ def test_schedule_accepts_valid_num_devices():
 
 def test_compile_donate_caches_program(monkeypatch):
     """compile_circuit(donate=True) must not rebuild its jitted program per
-    call: two compiles of EQUAL circuits applied twice each trace once."""
+    call: two compiles of EQUAL circuits applied twice each trace once.
+    Since PR 5 the donated program lives in the serve layer's structural
+    compilation cache (quest_tpu/serve/cache.py), so the cache is cleared
+    first — an equal-STRUCTURE circuit from another test would otherwise
+    legitimately satisfy the trace with zero new traces."""
     import quest_tpu.circuit as circuit_mod
+    from quest_tpu.serve.cache import global_cache
 
+    global_cache().clear()
+    circuit_mod._donated_program.cache_clear()
     traces = {"n": 0}
     real = circuit_mod._run_ops_routed
 
-    def counting(state, ops):
+    def counting(state, ops, params=None, offsets=None):
         traces["n"] += 1
-        return real(state, ops)
+        return real(state, ops, params, offsets)
 
     monkeypatch.setattr(circuit_mod, "_run_ops_routed", counting)
-    # unique circuit so no earlier test pre-populated the donated cache
     c1 = random_circuit(6, depth=2, seed=987_123)
     c2 = random_circuit(6, depth=2, seed=987_123)
     assert c1.key() == c2.key() and c1 is not c2

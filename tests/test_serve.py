@@ -1,0 +1,430 @@
+"""The serving subsystem (quest_tpu/serve): structural keys, the
+parameter-lifted compile cache, microbatching, concurrency, RNG isolation,
+backpressure/deadlines, and eviction.
+
+Numerics contract under test (docs/SERVING.md): batched execution is
+BIT-IDENTICAL to serial per-request execution of the same class program
+(the ``lax.map`` lowering keeps the per-element jaxpr identical), and the
+lifted program agrees with the constant-embedded eager program to a couple
+of f64 ulps (the two compilations may legally differ in FMA contraction —
+exact equivalence is machine-proven by the serve audit, also run here)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from conftest import ON_ACCELERATOR  # noqa: F401 (platform dtype choice)
+
+import jax
+import jax.numpy as jnp
+
+import quest_tpu as qt  # noqa: F401 (x64 + precision config)
+from quest_tpu.circuit import (Circuit, GateOp, _run_ops, compile_circuit,
+                               op_param_count, param_vector, qft_circuit,
+                               random_circuit, structural_op)
+from quest_tpu.serve import (CacheOptions, CompileCache, QuESTService,
+                             circuit_from_params, parse_prometheus)
+from quest_tpu.serve.batch import bucket_size
+from quest_tpu.serve.selftest import vqe_ansatz
+from quest_tpu.validation import QuESTError
+
+DTYPE = jnp.float32 if ON_ACCELERATOR else jnp.float64
+EAGER_ULP = 1e-5 if ON_ACCELERATOR else 1e-14
+
+
+def zero_state(n):
+    return jnp.zeros((2, 1 << n), DTYPE).at[0, 0].set(1.0)
+
+
+def eager(circuit):
+    return np.asarray(_run_ops(zero_state(circuit.num_qubits), circuit.key()))
+
+
+# ---------------------------------------------------------------------------
+# structural keys + parameter lift (satellite 1)
+# ---------------------------------------------------------------------------
+
+def test_structural_key_ignores_angles_keeps_structure():
+    a = vqe_ansatz(6, 2, seed=0)
+    b = vqe_ansatz(6, 2, seed=1)
+    assert a.key() != b.key()
+    assert a.key(structural=True) == b.key(structural=True)
+    # a wire change IS structure
+    c = vqe_ansatz(6, 2, seed=0)
+    op0 = c.ops[0]
+    c.ops[0] = GateOp(op0.kind, (op0.targets[0] + 1,), op0.controls,
+                      op0.control_states, op0.matrix, op0.shape)
+    assert c.key(structural=True) != a.key(structural=True)
+
+
+def test_structural_op_keeps_discrete_payloads():
+    bp = GateOp("bitperm", (3, 4, 5), (), (), (4.0, 5.0, 3.0), None)
+    assert structural_op(bp) is bp          # destination wires are structure
+    assert op_param_count(bp) == 0
+    rz = Circuit(2).rz(0, 0.3).ops[0]
+    s = structural_op(rz)
+    assert s.matrix is None and s.shape == rz.shape
+    assert op_param_count(s) == op_param_count(rz) == len(rz.matrix)
+
+
+def test_param_vector_roundtrip():
+    c = vqe_ansatz(5, 2, seed=3)
+    cache = CompileCache()
+    entry = cache.entry_for(c.key(), 5)
+    recon = circuit_from_params(5, entry.skeleton, entry.offsets,
+                                param_vector(c))
+    assert recon.key() == c.key()
+
+
+def test_donated_program_shared_across_angles(monkeypatch):
+    """The angle-recompile defect, fixed at the root: two circuits
+    differing ONLY in rotation angles share one compiled donating program
+    — trace-count pinned (mirrors PR 2's trace-count test), results still
+    per-circuit correct."""
+    import quest_tpu.circuit as circuit_mod
+    from quest_tpu.serve.cache import global_cache
+
+    global_cache().clear()
+    circuit_mod._donated_program.cache_clear()
+    c1 = vqe_ansatz(6, 2, seed=11)
+    c2 = vqe_ansatz(6, 2, seed=22)
+    assert c1.key() != c2.key()
+    want1, want2 = eager(c1), eager(c2)   # before the counter: _run_ops
+    traces = {"n": 0}                     # traces through the same chain
+    real = circuit_mod._run_ops_routed
+
+    def counting(state, ops, params=None, offsets=None):
+        traces["n"] += 1
+        return real(state, ops, params, offsets)
+
+    monkeypatch.setattr(circuit_mod, "_run_ops_routed", counting)
+    run1 = compile_circuit(c1, donate=True)
+    run2 = compile_circuit(c2, donate=True)
+    got1 = np.asarray(run1(zero_state(6)))
+    got2 = np.asarray(run2(zero_state(6)))
+    assert traces["n"] == 1, f"structural class traced {traces['n']} times"
+    assert np.abs(got1 - want1).max() <= EAGER_ULP
+    assert np.abs(got2 - want2).max() <= EAGER_ULP
+    assert not np.allclose(got1, got2)      # different angles, different states
+    snap = global_cache().snapshot()
+    assert snap["compiles"] == 1 and snap["hits"] == 1
+
+
+# ---------------------------------------------------------------------------
+# service: concurrency storm, bit-identity, RNG isolation (satellite 3)
+# ---------------------------------------------------------------------------
+
+def _storm_classes():
+    return [lambda s: vqe_ansatz(6, 2, seed=s),
+            lambda s: random_circuit(7, depth=2, seed=s),
+            lambda s: qft_circuit(5)]
+
+
+def test_threaded_storm_bit_identical_to_serial():
+    """>= 64 requests, mixed structural classes, submitted from 8 threads
+    into a RUNNING service: every batched result must be bit-identical to
+    serial (singleton) execution of the same request, and within ulps of
+    the eager oracle."""
+    cache = CompileCache()
+    svc = QuESTService(max_batch=8, max_delay_ms=5, max_queue=4096,
+                       dtype=DTYPE, cache=cache)
+    makers = _storm_classes()
+    reqs = [(i, makers[i % 3](i // 3)) for i in range(66)]
+    futs: dict = {}
+    lock = threading.Lock()
+
+    def submitter(chunk):
+        for i, c in chunk:
+            f = svc.submit(c, shots=8)
+            with lock:
+                futs[i] = (c, f)
+
+    threads = [threading.Thread(target=submitter, args=(reqs[k::8],))
+               for k in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert svc.drain(timeout=300)
+    for i, (c, f) in sorted(futs.items()):
+        res = f.result(timeout=60)
+        serial = np.asarray(cache.execute(c.key(), zero_state(c.num_qubits),
+                                          num_qubits=c.num_qubits))
+        assert np.array_equal(res.state, serial), \
+            f"request {i}: batched != serial"
+        assert np.abs(res.state - eager(c)).max() <= EAGER_ULP
+    svc.shutdown()
+    d = svc.metrics_dict()
+    assert d["counters"]["requests_completed_total"] == 66
+    assert d["cache_hit_rate"] > 0.9
+
+
+def test_sample_streams_deterministic_and_isolated():
+    """Per-request MT19937 streams: identical (seed, request_id) draws the
+    identical samples whatever the batching; different requests draw
+    different streams."""
+    results = []
+    for max_batch in (8, 1):
+        cache = CompileCache()
+        svc = QuESTService(max_batch=max_batch, max_delay_ms=5, seed=99,
+                           dtype=DTYPE, cache=cache, start=False)
+        futs = [svc.submit(random_circuit(6, depth=2, seed=s % 4), shots=64)
+                for s in range(12)]
+        svc.start()
+        assert svc.drain(timeout=300)
+        results.append([f.result(timeout=60) for f in futs])
+        svc.shutdown()
+    batched, serial = results
+    for a, b in zip(batched, serial):
+        assert a.request_id == b.request_id
+        assert np.array_equal(a.state, b.state)
+        assert np.array_equal(a.samples, b.samples)
+    # same circuit (seed 0 twice: requests 0 and 4), different streams
+    assert np.array_equal(batched[0].state, batched[4].state)
+    assert not np.array_equal(batched[0].samples, batched[4].samples)
+
+
+# ---------------------------------------------------------------------------
+# backpressure, deadlines, shutdown
+# ---------------------------------------------------------------------------
+
+def test_queue_full_raises():
+    svc = QuESTService(max_queue=3, dtype=DTYPE, cache=CompileCache(),
+                       start=False)
+    c = qft_circuit(4)
+    for _ in range(3):
+        svc.submit(c)
+    with pytest.raises(QuESTError) as exc:
+        svc.submit(c)
+    assert exc.value.code == "E_QUEUE_FULL"
+    assert svc.metrics.counter("queue_rejected_total") == 1
+    svc.start()
+    svc.shutdown()
+
+
+def test_deadline_exceeded_skips_batch_slot():
+    svc = QuESTService(dtype=DTYPE, cache=CompileCache(), start=False)
+    expired = svc.submit(qft_circuit(4), deadline_ms=1)
+    alive = svc.submit(qft_circuit(4), deadline_ms=60_000)
+    time.sleep(0.05)
+    svc.start()
+    assert svc.drain(timeout=120)
+    with pytest.raises(QuESTError) as exc:
+        expired.result(timeout=30)
+    assert exc.value.code == "E_DEADLINE_EXCEEDED"
+    assert alive.result(timeout=30).state is not None
+    assert svc.metrics.counter("deadline_expired_total") == 1
+    svc.shutdown()
+
+
+def test_cancelled_future_does_not_kill_worker():
+    """A tenant's Future.cancel() must never kill the worker or fail its
+    co-batched neighbours (found by review: set_exception/set_result on a
+    cancelled future raises InvalidStateError)."""
+    svc = QuESTService(dtype=DTYPE, cache=CompileCache(), start=False)
+    c = qft_circuit(4)
+    cancelled_expired = svc.submit(c, deadline_ms=1)
+    cancelled = svc.submit(c)
+    alive = svc.submit(c)
+    assert cancelled_expired.cancel() and cancelled.cancel()
+    time.sleep(0.05)
+    svc.start()
+    assert svc.drain(timeout=120)
+    assert alive.result(timeout=30).state is not None   # worker survived
+    assert cancelled.cancelled() and cancelled_expired.cancelled()
+    late = svc.submit(c)                                # still serving
+    assert svc.drain(timeout=120)
+    assert late.result(timeout=30).state is not None
+    svc.shutdown()
+
+
+def test_shutdown_without_drain_fails_pending():
+    svc = QuESTService(dtype=DTYPE, cache=CompileCache(), start=False)
+    f = svc.submit(qft_circuit(4))
+    svc.shutdown(drain=False)
+    with pytest.raises(RuntimeError):
+        f.result(timeout=10)
+    with pytest.raises(RuntimeError):
+        svc.submit(qft_circuit(4))
+
+
+# ---------------------------------------------------------------------------
+# cache eviction + accounting (satellite 3's "tiny byte budget")
+# ---------------------------------------------------------------------------
+
+def test_cache_eviction_under_tiny_byte_budget():
+    cache = CompileCache(max_bytes=1)     # nothing fits; newest survives
+    a, b = vqe_ansatz(5, 1, seed=0), qft_circuit(5)
+    st = zero_state(5)
+    ra1 = np.asarray(cache.execute(a.key(), st, num_qubits=5))
+    assert cache.stats["evictions"] == 0
+    np.asarray(cache.execute(b.key(), st, num_qubits=5))
+    assert cache.stats["evictions"] == 1          # class A pushed out
+    assert cache.snapshot()["entries"] == 1
+    ra2 = np.asarray(cache.execute(a.key(), st, num_qubits=5))
+    assert cache.stats["misses"] == 3             # A recompiled after eviction
+    assert cache.stats["evictions"] == 2
+    assert np.array_equal(ra1, ra2)               # eviction never changes results
+    assert cache.stats["entry_bytes"] >= 0
+
+
+def test_batch_padding_and_metrics():
+    assert [bucket_size(m, 8) for m in (1, 2, 3, 5, 8, 9)] == [1, 2, 4, 8, 8, 8]
+    cache = CompileCache()
+    svc = QuESTService(max_batch=8, max_delay_ms=5, dtype=DTYPE, cache=cache,
+                       start=False)
+    futs = [svc.submit(vqe_ansatz(5, 1, seed=s)) for s in range(5)]
+    svc.start()
+    assert svc.drain(timeout=120)
+    for s, f in enumerate(futs):
+        res = f.result(timeout=60)
+        assert res.batch_size == 5
+        assert np.abs(res.state - eager(vqe_ansatz(5, 1, seed=s))).max() \
+            <= EAGER_ULP
+    d = svc.metrics_dict()
+    assert d["counters"]["padded_requests_total"] == 3     # 5 padded to 8
+    assert d["histograms"]["batch_size"]["mean"] == 5
+    svc.shutdown()
+
+
+def test_initial_state_stacked_path():
+    cache = CompileCache()
+    svc = QuESTService(max_batch=4, max_delay_ms=5, dtype=DTYPE, cache=cache,
+                       start=False)
+    c = vqe_ansatz(5, 1, seed=0)
+    states = []
+    rng = np.random.default_rng(5)
+    for _ in range(3):
+        v = rng.normal(size=(2, 32))
+        v /= np.sqrt((v ** 2).sum())
+        states.append(v)
+    futs = [svc.submit(c, initial_state=s) for s in states]
+    svc.start()
+    assert svc.drain(timeout=120)
+    for s, f in zip(states, futs):
+        want = np.asarray(_run_ops(jnp.asarray(s, DTYPE), c.key()))
+        assert np.abs(f.result(timeout=60).state - want).max() <= EAGER_ULP
+    svc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# scheduler-composed classes (PR 2) + metrics export
+# ---------------------------------------------------------------------------
+
+def test_mesh_service_composes_with_scheduler():
+    if ON_ACCELERATOR or len(jax.devices()) < 8:
+        pytest.skip("needs the 8-virtual-device CPU mesh")
+    # 16q: the smallest QFT whose reversal swaps reach the PREFIX wires on
+    # an 8-way mesh, so the scheduler fuses them into a bitperm collective
+    cache = CompileCache()
+    svc = QuESTService(num_devices=8, max_batch=2, max_delay_ms=5,
+                       dtype=DTYPE, cache=cache, start=False)
+    futs = [svc.submit(qft_circuit(16)) for _ in range(2)]
+    svc.start()
+    assert svc.drain(timeout=300)
+    want = eager(qft_circuit(16))
+    for f in futs:
+        assert np.abs(f.result(timeout=60).state - want).max() < 1e-10
+    # one schedule + one compile for the whole class (2 requests are
+    # 1 miss + 1 hit: the schedule search ran ONCE)
+    assert cache.stats["misses"] == 1 and cache.stats["compiles"] == 1
+    entry = cache.entry_for(qft_circuit(16).key(), 16,
+                            CacheOptions(num_devices=8))
+    assert any(op.kind == "bitperm" for op in entry.skeleton), \
+        "scheduled skeleton should carry the fused swap network"
+    # the fused bitperm carries NO lifted operands: its payload is routing
+    assert all(off is None for op, off in zip(entry.skeleton, entry.offsets)
+               if op.kind == "bitperm")
+    svc.shutdown()
+
+
+def test_prometheus_export_parses_and_counts():
+    cache = CompileCache()
+    svc = QuESTService(max_batch=4, max_delay_ms=5, dtype=DTYPE, cache=cache,
+                       start=False)
+    futs = [svc.submit(qft_circuit(4)) for _ in range(4)]
+    svc.start()
+    assert svc.drain(timeout=120)
+    for f in futs:
+        f.result(timeout=60)
+    text = svc.prometheus()
+    parsed = parse_prometheus(text)
+    assert parsed["quest_serve_requests_completed_total"][""] == 4
+    assert "quest_serve_cache_hit_rate" in parsed
+    assert "quest_serve_request_latency_seconds_bucket" in parsed
+    d = svc.metrics_dict()
+    assert {"count", "sum", "mean", "p50", "p99"} <= \
+        set(d["histograms"]["request_latency_seconds"])
+    svc.shutdown()
+
+
+def test_serve_audit_clean():
+    """Satellite 2: the parameter lift is machine-proven, not assumed."""
+    from quest_tpu.analysis.serve_audit import audit_param_lift
+    reports, found = audit_param_lift(
+        [("vqe6", vqe_ansatz(6, 2, seed=0), vqe_ansatz(6, 2, seed=1)),
+         ("qft6", qft_circuit(6), qft_circuit(6))],
+        dtype=DTYPE)
+    assert not found, [d.format() for d in found]
+    assert all(r["roundtrip_proven"] and r["twin_shares_entry"]
+               for r in reports)
+
+
+def test_serve_audit_catches_divergence(monkeypatch):
+    """Adversarial: corrupt the scheduler-provenance slot map (swap two
+    operand offsets) — the audit's round-trip proof AND probe must catch
+    it (the audit is a real check, not a rubber stamp)."""
+    from quest_tpu.analysis.serve_audit import audit_param_lift
+    from quest_tpu.serve import cache as cache_mod
+
+    real = cache_mod._provenance_offsets
+
+    def corrupted(orig_ops, sched_ops):
+        offsets, total = real(orig_ops, sched_ops)
+        slots = [i for i, o in enumerate(offsets) if o is not None]
+        out = list(offsets)
+        out[slots[0]], out[slots[1]] = out[slots[1]], out[slots[0]]
+        return tuple(out), total
+
+    monkeypatch.setattr(cache_mod, "_provenance_offsets", corrupted)
+    bad = Circuit(6).ry(0, 0.3).ry(1, 0.9).ry(2, 1.7).ry(3, -0.4)
+    _, found = audit_param_lift([("corrupted", bad)], num_devices=8,
+                                dtype=DTYPE)
+    assert any(d.code == "A_PARAM_LIFT_DIVERGENCE" for d in found), \
+        [d.format() for d in found]
+
+
+# ---------------------------------------------------------------------------
+# the acceptance row: 64 x 16q, one compile, serial-identical, PR 5 headline
+# ---------------------------------------------------------------------------
+
+def test_vqe16_batch64_single_compile_bit_identical():
+    """64 structurally-identical, differently-parameterized 16q circuits
+    through QuESTService: exactly ONE XLA compilation (cache counters
+    asserted), results bit-identical to serial per-circuit execution and
+    ulp-close to the constant-embedded eager oracle (whose exact
+    equivalence the serve audit proves)."""
+    cache = CompileCache()
+    svc = QuESTService(max_batch=64, max_delay_ms=50, max_queue=256,
+                       dtype=DTYPE, cache=cache, start=False)
+    circuits = [vqe_ansatz(16, 1, seed=s) for s in range(64)]
+    assert len({c.key(structural=True) for c in circuits}) == 1
+    futs = [svc.submit(c) for c in circuits]
+    svc.start()
+    assert svc.drain(timeout=600)
+    results = [f.result(timeout=60) for f in futs]
+    assert cache.stats["compiles"] == 1, cache.snapshot()
+    assert cache.stats["misses"] == 1 and cache.stats["hits"] == 63
+    assert all(r.batch_size == 64 for r in results)
+    # serial oracle AFTER the compile assertion (it adds the singleton
+    # program for the same class)
+    for c, r in zip(circuits[:8], results[:8]):
+        serial = np.asarray(cache.execute(c.key(), zero_state(16),
+                                          num_qubits=16))
+        assert np.array_equal(r.state, serial)
+        assert np.abs(r.state - eager(c)).max() <= EAGER_ULP
+    svc.shutdown()
