@@ -854,6 +854,67 @@ def bench_random24_sched_pair(devices, depth=2):
                             devices, depth=1)
 
 
+def bench_auto_engine(circuit, n, iters=2, label="auto_engine"):
+    """``compile_circuit(engine="auto")`` — the default dispatch — vs the
+    forced-XLA variant on the SAME circuit: which backend the planner picks
+    (both for the live platform and for TPU-class specs), the epoch
+    executor's fused HBM-pass count vs the per-gate count, and measured
+    amps/s for both programs.  On CPU auto resolves to the XLA engine
+    (Pallas would run in interpret mode) so the two measurements coincide
+    and the row documents the spec-level decision; on a chip the auto
+    program runs the fused Pallas passes and the ratio is the realized
+    engine win (ROADMAP item 2: >= 2x on random/VQE rows)."""
+    from quest_tpu.circuit import compile_circuit
+    from quest_tpu.parallel import planner
+
+    import jax
+    import jax.numpy as jnp
+
+    spec = planner.select_engine(circuit, 1, backend="tpu")
+    run_auto = compile_circuit(circuit)               # engine="auto" default
+    run_xla = compile_circuit(circuit, engine="xla")
+
+    state = jnp.zeros((2, 1 << n), jnp.float32).at[0, 0].set(1.0)
+    compute_a, total, dt, overhead = _run_layered(run_auto, state, iters)
+    assert abs(total - 1.0) < 1e-2, f"state not normalised: {total}"
+    compute_x, total_x, _, _ = _run_layered(run_xla, state, iters)
+    assert abs(total_x - 1.0) < 1e-2, f"state not normalised: {total_x}"
+
+    gates = len(circuit.ops)
+    value = (1 << n) * gates * iters / compute_a
+    model = spec["model"] or {}
+    cfg = {"qubits": n, "gates": gates, "iters": iters, "precision": 1,
+           "engine_live": run_auto.engine,
+           "engine_live_reason": run_auto.engine_reason,
+           "engine_tpu_spec": spec["engine"],
+           "engine_tpu_spec_reason": spec["reason"],
+           "hbm_passes_pallas": model.get("pallas_hbm_passes"),
+           "hbm_passes_xla": model.get("xla_hbm_passes"),
+           "model_engine_speedup": (
+               model["xla_seconds"] / model["pallas_seconds"]
+               if model.get("pallas_seconds") else None),
+           "amps_per_sec_xla_engine": (1 << n) * gates * iters / compute_x,
+           "vs_xla_engine": compute_x / compute_a,
+           "seconds": dt, "overhead_seconds": overhead}
+    passes = (model.get("pallas_hbm_passes") or gates) \
+        if run_auto.engine == "pallas" else gates
+    cfg.update(_roofline(1 << n, 1, passes * iters, compute_a))
+    return value, cfg
+
+
+def bench_random24_auto_engine(n=24, depth=4, iters=2):
+    from quest_tpu.circuit import random_circuit
+    return bench_auto_engine(random_circuit(n, depth, seed=11), n, iters)
+
+
+def bench_vqe16_auto_engine(n=16, layers=2, iters=4):
+    # n=16 sits BELOW the epoch engine's n>=17 block floor: the row
+    # documents the envelope (engine_tpu_spec == "xla", reasoned) next to
+    # the random24 row's pallas pick — both truthfully auto-dispatched
+    from quest_tpu.serve.selftest import vqe_ansatz
+    return bench_auto_engine(vqe_ansatz(n, layers, seed=0), n, iters)
+
+
 def bench_qft(n, precision=1, devices=None):
     """Full QFT pass: H + controlled-phase ladder + reversal swaps — the
     diagonal-gate + swap routing path (BASELINE config 5).  With ``devices``
@@ -1020,6 +1081,10 @@ def main() -> None:
         add("densmatr_14q_damping_depol_f64", bench_density, 14, 3, 2)
         # serving subsystem (quest_tpu/serve): 64 tenants, one compile
         add("serve_vqe_16q_batch64", bench_serve_vqe16_batch64)
+        # engine dispatch (ops/epoch_pallas.py): default auto engine vs
+        # forced XLA, with the planner's spec-level decision recorded
+        add("random24_f32_auto_engine", bench_random24_auto_engine)
+        add("vqe_16q_auto_engine", bench_vqe16_auto_engine)
         add("qft_28q_f32", bench_qft, 28, 1)
         if platform != "cpu":
             add("qft_28q_f32_inplace_ordered", bench_qft_inplace, 28, True)

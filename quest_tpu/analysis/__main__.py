@@ -79,9 +79,13 @@ def _schedule_report(label: str, circuit, args, scheduled, echo) -> tuple:
     from .diagnostics import AnalysisCode, Severity, diag
     report = schedule_savings(circuit, args.devices, chip=_chip(args.chip),
                               precision=args.precision, scheduled=scheduled,
-                              pipeline_chunks=args.overlap_chunks)
+                              pipeline_chunks=args.overlap_chunks,
+                              engine=args.engine)
     report["label"] = label
     echo(f"{label}: schedule savings " + json.dumps(report, default=float))
+    echo(f"{label}: engine {report['engine_chosen']} "
+         f"({report['engine_reason']}); epochs "
+         + json.dumps(report["engine_epochs"], default=float))
     out = []
     if (report["comm_events_after"] > report["comm_events_before"]
             or report["comm_bytes_after"] > report["comm_bytes_before"]):
@@ -137,8 +141,35 @@ def _verify_report(label: str, circuit, args, scheduled, echo) -> tuple:
     report["dispatch_audit"] = audit
     report["hlo_pair"] = {k: pair[k]
                           for k in ("unscheduled_hlo", "scheduled_hlo")}
+    d5: list = []
+    if args.engine == "pallas" and args.devices <= 1:
+        # the epoch-executor rollout gate (docs/ANALYSIS.md): the Pallas
+        # lowering of the scheduled circuit is proven IR-equivalent
+        # (check_epoch_plan: same V_* domains) and the actual kernels are
+        # probed in interpret mode where the register fits
+        from ..ops import epoch_pallas as _ep
+        if _ep.epoch_supported(scheduled.num_qubits, args.precision):
+            from .equivalence import check_epoch_plan, probe_epoch_execution
+            plan_e = _ep.plan_circuit(scheduled.key(), scheduled.num_qubits)
+            proof = check_epoch_plan(scheduled, plan_e)
+            probe = probe_epoch_execution(scheduled)
+            d5 = proof + probe
+            report["epoch_plan"] = plan_e.summary()
+            # the IR proof stands alone; the probe's skip warning beyond
+            # its register cap must not read as a failed proof
+            report["epoch_proven"] = not proof and not any(
+                d.severity >= Severity.ERROR for d in probe)
+            report["epoch_probe_executed"] = not any(
+                d.code == "V_UNVERIFIED_REGION" for d in probe)
+        else:
+            report["epoch_plan"] = None
+            report["epoch_proven"] = False
+            report["epoch_probe_executed"] = False
+            report["epoch_skip_reason"] = (
+                f"outside the epoch engine envelope (f32, "
+                f"{_ep.MIN_QUBITS} <= n <= {_ep.MAX_QUBITS})")
     echo(f"{label}: verify-schedule " + json.dumps(report, default=float))
-    return report, found + d2 + d3 + d4
+    return report, found + d2 + d3 + d4 + d5
 
 
 def main(argv=None) -> int:
@@ -181,6 +212,14 @@ def main(argv=None) -> int:
                              "grows overlapped model columns and "
                              "--verify-schedule proves the chunking "
                              "layout-only and audits the compiled program")
+    parser.add_argument("--engine", default="auto",
+                        choices=("auto", "xla", "pallas"),
+                        help="compiled-circuit backend for the engine "
+                             "columns of --schedule and (with 'pallas') "
+                             "the epoch-executor verification of "
+                             "--verify-schedule: the lowering is proven "
+                             "IR-equivalent and the kernels probed in "
+                             "interpret mode (default auto)")
     parser.add_argument("--devices", type=int, default=1,
                         help="mesh size for the deployment model (default 1)")
     parser.add_argument("--precision", type=int, default=1, choices=(1, 2),

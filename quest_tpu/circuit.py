@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import _compat
 from .ops import apply as _ap
 
 __all__ = ["Circuit", "GateOp", "compile_circuit", "apply_circuit",
@@ -173,7 +174,7 @@ class Circuit:
     def __len__(self) -> int:
         return len(self.ops)
 
-    def key(self, structural: bool = False) -> tuple:
+    def key(self, structural: bool = False, engine: str | None = None) -> tuple:
         """Hashable identity of the recorded gate list.
 
         ``structural=True`` returns the STRUCTURAL key: op kinds, wires,
@@ -185,10 +186,21 @@ class Circuit:
         (quest_tpu/serve/cache.py), where the default key would force one
         XLA compile per angle assignment.  Discrete payloads (``bitperm``
         destination wires) stay in the key: they select the program's data
-        movement, not its operands."""
-        if structural:
-            return tuple(structural_op(op) for op in self.ops)
-        return tuple(self.ops)
+        movement, not its operands.
+
+        ``engine`` tags the key with the RESOLVED compiled-circuit backend
+        ("xla" | "pallas"; ``compile_circuit`` resolves "auto" before any
+        keying).  The tag is part of program identity: the same op list
+        lowered through the XLA gate engine and through the Pallas epoch
+        executor (ops/epoch_pallas.py) are different executables, and a
+        cache entry compiled under one must never be served to a request
+        planned for the other.  ``engine=None`` and the default
+        ``engine="xla"`` key identically (backward compatible)."""
+        ops = (tuple(structural_op(op) for op in self.ops) if structural
+               else tuple(self.ops))
+        if engine is not None and engine != "xla":
+            return (("engine", engine),) + ops
+        return ops
 
     def optimize(self, max_pack: int = 7) -> "Circuit":
         """Run the native gate-fusion engine (native/fusion.cpp): merges
@@ -414,10 +426,33 @@ def _run_ops(state: jax.Array, ops: tuple) -> jax.Array:
     return _run_ops_routed(state, ops)
 
 
+def _split_engine_key(kops: tuple) -> tuple:
+    """Inverse of :meth:`Circuit.key` ``engine=``: (engine, op tuple)."""
+    if kops and kops[0] == ("engine", "pallas"):
+        return "pallas", kops[1:]
+    return "xla", kops
+
+
+@partial(jax.jit, static_argnames=("kops",))
+def _run_ops_engine(state: jax.Array, kops: tuple) -> jax.Array:
+    """Whole-circuit program keyed on the ENGINE-TAGGED circuit key
+    (:meth:`Circuit.key` ``engine=``), so the jit cache can never hand an
+    XLA-lowered executable to a pallas-planned call or vice versa.  The
+    pallas lowering (ops/epoch_pallas.py) runs fused aliased block/fiber
+    passes with the deferred qubit map reconciled at the end, falling back
+    per-window — never per-program — to the XLA gate engine for ops the
+    epoch planner cannot lower."""
+    engine, ops = _split_engine_key(kops)
+    if engine == "pallas":
+        from .ops import epoch_pallas as _ep
+        return _ep.run_ops_planes(state, ops)
+    return _run_ops_routed(state, ops)
+
+
 @lru_cache(maxsize=256)
-def _donated_program(ops: tuple):
-    """One donating program per op tuple — since PR 5 an adapter over the
-    serve subsystem's parameter-lifted compilation cache
+def _donated_program(ops: tuple, engine: str = "xla"):
+    """One donating program per (op tuple, engine) — since PR 5 an adapter
+    over the serve subsystem's parameter-lifted compilation cache
     (quest_tpu/serve/cache.py), so there is ONE program cache with ONE
     byte-budgeted eviction policy.  The compiled ``(state, params)``
     executable is cached there on the STRUCTURAL key
@@ -426,14 +461,20 @@ def _donated_program(ops: tuple):
     per-op-tuple cache compiled once per angle assignment.  This wrapper
     just closes over the op tuple's concrete operand vector
     (:func:`param_vector`); an entry evicted from the serve cache
-    recompiles transparently on next use."""
+    recompiles transparently on next use.
+
+    ``engine`` must be RESOLVED ("xla" | "pallas", never "auto") — it is
+    part of the cache class key (serve/cache.py CacheOptions.engine), so an
+    executable lowered through one backend is never served to a request
+    planned for the other."""
     from .serve.cache import global_cache
-    return global_cache().donating_runner(ops)
+    return global_cache().donating_runner(ops, engine=engine)
 
 
 def compile_circuit(circuit: Circuit, donate: bool = False,
                     num_devices: int | None = None, overlap: bool = False,
-                    pipeline_chunks: int | None = None):
+                    pipeline_chunks: int | None = None,
+                    engine: str = "auto", chip=None):
     """Return a jitted ``state -> state`` applying the whole circuit as one
     XLA program.  ``donate=True`` reuses the input buffer (allocation-free
     iteration) — callers must not hold other references to the state; the
@@ -446,6 +487,21 @@ def compile_circuit(circuit: Circuit, donate: bool = False,
     collective-minimised equivalent for an ``num_devices``-way amplitude
     mesh.
 
+    ``engine`` selects the compiled-circuit backend: ``"xla"`` is the
+    per-gate/fused gate engine, ``"pallas"`` forces the in-place Pallas
+    epoch executor (ops/epoch_pallas.py: fused aliased block/fiber passes
+    plus a deferred qubit map — the generalized qft_inplace machinery) and
+    the default ``"auto"`` resolves through the planner's engine cost model
+    (parallel/planner.py ``select_engine``, scored on ``chip`` — default
+    v5e) BEFORE anything is keyed, so the resolved engine is part of every
+    program/cache identity (:meth:`Circuit.key` ``engine=``).  The epoch
+    engine is single-device (its deferred permutation must materialize
+    before sharded collectives — docs/DESIGN.md); forcing it on a mesh
+    raises ``E_INVALID_SCHEDULE_OPTION``.  The returned function carries
+    the decision as ``run.engine`` / ``run.engine_plan`` (the auditable
+    per-epoch lowering).  A non-f32 state falls back to the XLA program at
+    call time — the epoch engine is f32-only.
+
     ``overlap=True`` (implied by ``pipeline_chunks``) additionally lowers
     the scheduled circuit through the pipelined executor
     (parallel/executor.py): every cross-shard collective is split into
@@ -456,6 +512,7 @@ def compile_circuit(circuit: Circuit, donate: bool = False,
     ``E_INVALID_SCHEDULE_OPTION``.  Overlapped programs carry a device
     mesh and are NOT cached on ``circuit.key()`` — hold on to the returned
     function."""
+    from .parallel import planner as _planner
     if overlap or pipeline_chunks is not None:
         from .validation import MESSAGES, ErrorCode, QuESTError
         if num_devices is None:
@@ -463,19 +520,56 @@ def compile_circuit(circuit: Circuit, donate: bool = False,
                 ErrorCode.INVALID_SCHEDULE_OPTION,
                 MESSAGES[ErrorCode.INVALID_SCHEDULE_OPTION]
                 + " overlap=True requires num_devices=.", "compile_circuit")
+        if engine == "pallas":
+            # the pipelined executor is an XLA-engine lowering: its chunked
+            # collectives are exactly what the epoch engine's deferred
+            # qubit map cannot coexist with (docs/DESIGN.md)
+            raise QuESTError(
+                ErrorCode.INVALID_SCHEDULE_OPTION,
+                MESSAGES[ErrorCode.INVALID_SCHEDULE_OPTION]
+                + " engine='pallas' unavailable with overlap=True.",
+                "compile_circuit")
         from .parallel import executor as _exec
         circuit = circuit.schedule(num_devices, overlap=True,
                                    pipeline_chunks=pipeline_chunks)
         return _exec.overlapped_program(circuit, num_devices, donate=donate)
     if num_devices is not None and num_devices > 1:
+        choice = _planner.select_engine(circuit, num_devices,
+                                        chip or _planner.V5E,
+                                        requested=engine)
         circuit = circuit.schedule(num_devices)
+    else:
+        choice = _planner.select_engine(circuit, 1, chip or _planner.V5E,
+                                        requested=engine)
+    resolved = choice["engine"]
     ops = circuit.key()
     if donate:
-        return _donated_program(ops)
+        shared = _donated_program(ops, resolved)
 
-    def run(state: jax.Array) -> jax.Array:
-        return _run_ops(state, ops)
+        # fresh wrapper per call: the underlying program is lru-shared
+        # across equal (ops, engine) keys, but the engine metadata set
+        # below belongs to THIS call's selection — mutating the shared
+        # closure would rewrite attributes held by earlier callers
+        def run(state: jax.Array) -> jax.Array:
+            return shared(state)
+    elif resolved == "pallas":
+        kops = circuit.key(engine="pallas")
 
+        def run(state: jax.Array) -> jax.Array:
+            if state.dtype != jnp.float32:   # f32-only engine: fall back
+                return _run_ops(state, ops)
+            # x64 off while tracing: the Mosaic lowering constraint shared
+            # by every in-place engine (safe: mrz phases precompute in f64
+            # host-side, so no traced f64 operand exists — epoch_pallas)
+            with _compat.enable_x64(False):
+                return _run_ops_engine(state, kops)
+    else:
+        def run(state: jax.Array) -> jax.Array:
+            return _run_ops(state, ops)
+
+    run.engine = resolved
+    run.engine_reason = choice["reason"]
+    run.engine_plan = choice["plan"]
     return run
 
 

@@ -1,0 +1,593 @@
+"""General Pallas epoch executor: the in-place engines as a circuit backend.
+
+``ops/qft_inplace.py`` proved that the fastest way to run a circuit on one
+chip is NOT one XLA pass per gate but a handful of aliased Pallas passes:
+BENCH_r05 has the in-place engine at 2.1-2.7e11 amps/s against the XLA
+engine's 7.1e10 on the same 28q QFT.  That module, however, is a hand-written
+closed form only QFT circuits can reach.  This module generalizes its three
+tricks into a backend ``compile_circuit`` can target for ARBITRARY scheduled
+windows of 1q/2q/diagonal ops (PAPER.md's thesis: interchangeable kernel
+implementations behind one dispatch layer; ROADMAP item 2):
+
+1. **Fused block passes.**  Every op whose dense action is confined to one
+   minor axis group of the tile view — lane (qubits 0-6), sublane (7-9) or
+   fiber (10-16) — and every diagonal/parity op on ANY wires (their factor
+   is a function of the global amplitude index, which each (F=128, S=8,
+   L=128) block can reconstruct from ``program_id``) is block-local.  A
+   maximal run of such ops becomes ONE aliased Pallas pass applying all of
+   them MXU/VPU-resident in VMEM: k gates for one HBM read+write of the
+   state, the generalization of ``_qft_tail_kernel``'s 33-passes-in-one.
+
+2. **Fiber passes for high qubits.**  Dense uncontrolled ops on qubits
+   >= 17 run through the aliased fiber engine (``pallas_layer
+   _apply_fiber_p``); consecutive ops in the same 7-qubit fiber group are
+   kron-embedded and composed host-side into one pack — one pass per group
+   per run, the generalization of the per-stage H passes.
+
+3. **Deferred qubit map.**  ``swap``/``bitperm`` ops never move data: they
+   update a logical->physical wire permutation that later ops absorb into
+   their wiring (the residual permutation is carried across epoch
+   boundaries and materialized once, by ``reconcile_perm``, at the end of
+   the program — or returned to plane-pair callers, the unordered-QFT
+   convention).  The QFT's trailing swap network therefore costs ZERO
+   passes, and the whole transform lowers to exactly the hand-written
+   engine's ``2(n-17)+1`` HBM passes (regression-tested).
+
+Ops outside the supported set (cross-group multi-target dense gates,
+controlled dense on high qubits, >5-target general diagonals) split the
+epoch: they execute through the XLA gate engine between Pallas segments,
+with wires translated through the live permutation, so ANY circuit compiles
+— the planner's engine cost model (parallel/planner.py ``select_engine``)
+just rates mostly-unsupported circuits as XLA wins.
+
+Envelope: f32 plane storage, 17 <= n <= 30 (the in-place layer floor; int32
+block indices).  Correctness gate: ``analysis/equivalence.py
+check_epoch_plan`` proves every lowering IR-equivalent to its window and
+``probe_epoch_execution`` runs the actual kernels (``pl.pallas_call``
+interpret mode on CPU) against the XLA engine — both wired into
+``--verify-schedule --engine pallas`` and the tier-1 suite.  The residual
+permutation MUST be materialized before any sharded collective (the map
+renames amplitude-index bits, which a mesh reshards on — docs/DESIGN.md);
+the engine is therefore single-device, and ``select_engine`` pins
+multi-device deployments to XLA.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+
+from .. import _compat
+
+from .pallas_layer import (LANE, SUB, _fiber_group, _interpret, _shape3,
+                           _state_spec)
+from .qft_inplace import _block_k
+
+__all__ = ["EnginePlan", "Segment", "plan_circuit", "epoch_supported",
+           "run_ops_planes", "run_planes", "jit_program", "MIN_QUBITS",
+           "MAX_QUBITS"]
+
+MIN_QUBITS = 17   # the (fiber, sublane, lane) block view floor
+MAX_QUBITS = 30   # int32 global amplitude indices in the block kernels
+
+# widest general diagonal lowered as in-kernel selects (2^5 = 32 entries);
+# wider diagonals fall back to the XLA gather engine
+_DIAG_CAP = 5
+
+# axis groups of the minor 17 qubits in the (F, S, L) tile view
+_LANE_Q = (0, 7)
+_SUB_Q = (7, 10)
+_FIBER_Q = (10, 17)
+
+_X_PAIR = np.stack([np.array([[0.0, 1.0], [1.0, 0.0]]), np.zeros((2, 2))])
+_Y_PAIR = np.stack([np.zeros((2, 2)), np.array([[0.0, -1.0], [1.0, 0.0]])])
+_YC_PAIR = np.stack([np.zeros((2, 2)), np.array([[0.0, 1.0], [-1.0, 0.0]])])
+
+
+# ---------------------------------------------------------------------------
+# host-side lowering: ops -> passes
+# ---------------------------------------------------------------------------
+
+def _embed_axis(up: np.ndarray, rel: tuple, width: int) -> np.ndarray:
+    """Embed a (2, 2^k, 2^k) real-pair unitary acting on axis-index bits
+    ``rel`` (matrix index bit j <-> axis bit rel[j], the engine-wide
+    targets[j] convention) into the full (2, 2^width, 2^width) axis matrix,
+    identity on the remaining bits."""
+    dim = 1 << width
+    m = up[0] + 1j * up[1]
+    a = np.arange(dim)
+    sub = np.zeros(dim, np.int64)
+    mask = 0
+    for j, p in enumerate(rel):
+        sub |= ((a >> p) & 1) << j
+        mask |= 1 << p
+    rest = a & ~mask
+    out = m[sub[:, None], sub[None, :]] * (rest[:, None] == rest[None, :])
+    return np.stack([out.real, out.imag])  # f64; cast to f32 at pass build
+
+
+def _pair_compose(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Complex compose on real pairs: ``a`` AFTER ``b`` (a @ b)."""
+    return np.stack([a[0] @ b[0] - a[1] @ b[1],
+                     a[0] @ b[1] + a[1] @ b[0]])
+
+
+def _dense_pair(op) -> np.ndarray:
+    """The (2, 2^k, 2^k) real-pair matrix of a dense-kind op."""
+    if op.kind == "x":
+        return _X_PAIR
+    if op.kind == "y":
+        return _Y_PAIR
+    if op.kind == "y*":
+        return _YC_PAIR
+    return op.payload()
+
+
+def _cstates(op) -> tuple:
+    return tuple(op.control_states) or (1,) * len(op.controls)
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class BlockPass:
+    """One fused block-local Pallas pass: ``specs`` is the static kernel
+    program (see ``_epoch_block_kernel``), ``mats`` the deduplicated
+    embedded axis matrices it matmuls with."""
+    specs: tuple
+    mats: tuple          # of np (2, D, D) float32, D in {128, 8}
+
+    @property
+    def kind(self) -> str:
+        return "block"
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class FiberPass:
+    """One aliased fiber pass: the composed kron pack of a run of dense
+    ops on one high-qubit fiber group [base, base+log2(width))."""
+    base: int
+    width: int
+    pack: np.ndarray     # (2, width, width) float32
+
+    @property
+    def kind(self) -> str:
+        return "fiber"
+
+
+@dataclasses.dataclass
+class Segment:
+    """A maximal single-engine run: ``ops`` are the window's ops with wires
+    already translated to PHYSICAL positions (the audit/reporting view and,
+    for xla segments, the execution list); ``passes`` is the Pallas
+    lowering (pallas segments only)."""
+    engine: str                  # 'pallas' | 'xla'
+    ops: list
+    passes: list
+
+
+@dataclasses.dataclass
+class EnginePlan:
+    """The epoch executor's static lowering of one circuit."""
+    num_qubits: int
+    segments: list
+    residual_perm: tuple         # perm[logical] = physical position
+    deferred_ops: int            # swap/bitperm ops absorbed with zero passes
+
+    @property
+    def pallas_passes(self) -> int:
+        return sum(len(s.passes) for s in self.segments
+                   if s.engine == "pallas")
+
+    @property
+    def pallas_ops(self) -> int:
+        return sum(len(s.ops) for s in self.segments if s.engine == "pallas")
+
+    @property
+    def xla_ops(self) -> int:
+        return sum(len(s.ops) for s in self.segments if s.engine == "xla")
+
+    @property
+    def hbm_passes(self) -> int:
+        """Modeled HBM passes of the lowered program: one per Pallas pass,
+        one per XLA-segment gate.  The deferred residual permutation is
+        excluded — it is carried, not executed (the unordered-transform
+        convention of qft_inplace), and single-chip materialization is two
+        plane gathers charged to whoever forces it."""
+        return self.pallas_passes + self.xla_ops
+
+    def summary(self) -> dict:
+        return {
+            "num_qubits": self.num_qubits,
+            "segments": [{"engine": s.engine, "ops": len(s.ops),
+                          "passes": len(s.passes) if s.engine == "pallas"
+                          else len(s.ops)}
+                         for s in self.segments],
+            "pallas_passes": self.pallas_passes,
+            "pallas_ops": self.pallas_ops,
+            "xla_ops": self.xla_ops,
+            "deferred_ops": self.deferred_ops,
+            "hbm_passes": self.hbm_passes,
+            "residual_nontrivial": self.residual_perm
+            != tuple(range(self.num_qubits)),
+        }
+
+
+def _phys_op(op, perm: list):
+    """``op`` with targets/controls translated through the live
+    logical->physical map (bitperm destination payloads are wires too)."""
+    from ..circuit import GateOp
+    t = tuple(perm[q] for q in op.targets)
+    c = tuple(perm[q] for q in op.controls)
+    mat = op.matrix
+    if op.kind == "bitperm":
+        mat = tuple(float(perm[int(d)]) for d in op.matrix)
+    if t == op.targets and c == op.controls and mat == op.matrix:
+        return op
+    return GateOp(op.kind, t, c, op.control_states, mat, op.shape)
+
+
+def _absorb_perm(perm: list, op) -> None:
+    """Fold a logical ``swap``/``bitperm`` into the deferred map: content
+    of logical wire t now answers to logical name d, so later ops on d land
+    on t's physical home (G_d . P = P . G_t for the permutation P)."""
+    if op.kind == "swap":
+        a, b = op.targets
+        perm[a], perm[b] = perm[b], perm[a]
+    else:
+        old = list(perm)
+        for t, d in zip(op.targets, op.matrix):
+            perm[int(d)] = old[t]
+
+
+def _axis_group(targets: tuple) -> tuple | None:
+    """The minor axis group confining all (physical) ``targets``, or None."""
+    for group in (_LANE_Q, _SUB_Q, _FIBER_Q):
+        if all(group[0] <= t < group[1] for t in targets):
+            return group
+    return None
+
+
+def _classify(op, n: int) -> str:
+    """Lowering class of a PHYSICAL op: 'defer' (absorbed into the qubit
+    map), 'block' (fused block-local pass), 'fiber' (high-qubit pack pass),
+    or 'xla' (gate-engine fallback splitting the epoch)."""
+    if op.kind in ("swap", "bitperm"):
+        return "defer"
+    if op.kind == "mrz":
+        return "block"
+    if op.kind == "diagonal":
+        return "block" if len(op.targets) <= _DIAG_CAP else "xla"
+    if op.kind in ("matrix", "x", "y", "y*"):
+        if _axis_group(op.targets) is not None:
+            return "block"
+        if not op.controls and min(op.targets) >= MIN_QUBITS:
+            base, hi = _fiber_group(min(op.targets), n)
+            if max(op.targets) < hi:
+                return "fiber"
+        return "xla"
+    return "xla"
+
+
+class _BlockBuilder:
+    """Accumulates consecutive block-class ops into one BlockPass."""
+
+    def __init__(self):
+        self.specs: list = []
+        self.mats: list = []
+        self._mat_idx: dict = {}
+
+    def _intern(self, m: np.ndarray) -> int:
+        key = m.tobytes()
+        i = self._mat_idx.get(key)
+        if i is None:
+            i = self._mat_idx[key] = len(self.mats)
+            self.mats.append(m)
+        return i
+
+    def add(self, op) -> None:
+        if op.kind == "mrz":
+            half = float(op.matrix[0]) / 2.0
+            self.specs.append(("mrz", op.targets,
+                               float(np.cos(half)), float(np.sin(half))))
+            return
+        if op.kind == "diagonal":
+            d = op.payload()
+            self.specs.append(("diag", op.targets, op.controls, _cstates(op),
+                               tuple(np.float32(x) for x in d[0]),
+                               tuple(np.float32(x) for x in d[1])))
+            return
+        group = _axis_group(op.targets)
+        lo, hi = group
+        axis = {0: "lane", 7: "sub", 10: "fiber"}[lo]
+        m = _embed_axis(_dense_pair(op), tuple(t - lo for t in op.targets),
+                        hi - lo).astype(np.float32)
+        self.specs.append(("dense", axis, self._intern(m), op.controls,
+                           _cstates(op)))
+
+    def flush(self):
+        if not self.specs:
+            return None
+        out = BlockPass(tuple(self.specs), tuple(self.mats))
+        self.specs, self.mats, self._mat_idx = [], [], {}
+        return out
+
+
+def epoch_supported(num_qubits: int, precision: int = 1) -> bool:
+    """Whether the epoch engine's envelope admits this register at all
+    (individual ops may still fall back per-window)."""
+    return precision == 1 and MIN_QUBITS <= num_qubits <= MAX_QUBITS
+
+
+@lru_cache(maxsize=64)
+def plan_circuit(ops: tuple, num_qubits: int) -> EnginePlan:
+    """Lower an op tuple (logical wires) into the epoch executor's static
+    plan: engine segments, fused passes, and the deferred residual
+    permutation.  Pure host work, cached per (ops, n)."""
+    n = num_qubits
+    if not MIN_QUBITS <= n <= MAX_QUBITS:
+        raise ValueError(
+            f"epoch executor needs {MIN_QUBITS} <= n <= {MAX_QUBITS}, got {n}")
+    perm = list(range(n))
+    segments: list = []
+    builder = _BlockBuilder()
+    fiber_run: list | None = None   # [base, width, pack]
+    deferred = 0
+
+    def seg(engine: str) -> Segment:
+        if not segments or segments[-1].engine != engine:
+            segments.append(Segment(engine, [], []))
+        return segments[-1]
+
+    def flush_block():
+        bp = builder.flush()
+        if bp is not None:
+            seg("pallas").passes.append(bp)
+
+    def flush_fiber():
+        nonlocal fiber_run
+        if fiber_run is not None:
+            seg("pallas").passes.append(
+                FiberPass(fiber_run[0], fiber_run[1],
+                          fiber_run[2].astype(np.float32)))
+            fiber_run = None
+
+    for op in ops:
+        pop = _phys_op(op, perm)
+        cls = _classify(pop, n)
+        if cls == "defer":
+            _absorb_perm(perm, op)
+            deferred += 1
+            continue
+        if cls == "block":
+            flush_fiber()
+            builder.add(pop)
+            seg("pallas").ops.append(pop)
+            continue
+        if cls == "fiber":
+            flush_block()
+            base, hi = _fiber_group(min(pop.targets), n)
+            width = 1 << (hi - base)
+            pack = _embed_axis(_dense_pair(pop),
+                               tuple(t - base for t in pop.targets),
+                               hi - base)
+            if fiber_run is not None and fiber_run[0] == base:
+                fiber_run[2] = _pair_compose(pack, fiber_run[2])
+            else:
+                flush_fiber()
+                fiber_run = [base, width, pack]
+            seg("pallas").ops.append(pop)
+            continue
+        flush_block()
+        flush_fiber()
+        seg("xla").ops.append(pop)
+    flush_block()
+    flush_fiber()
+    return EnginePlan(n, segments, tuple(perm), deferred)
+
+
+# ---------------------------------------------------------------------------
+# the fused block kernel
+# ---------------------------------------------------------------------------
+
+def _epoch_block_kernel(specs: tuple, *refs):
+    """Apply a static program of block-local ops to one (F, S, L) block.
+
+    ``specs`` entries (everything host-constant; the only kernel INPUTS are
+    the deduplicated embedded axis matrices, two refs each):
+
+    - ``('dense', axis, mat_idx, controls, cstates)``: complex contraction
+      of the lane/sublane/fiber axis with embedded matrix ``mat_idx``;
+      controls select per element off the global amplitude index.
+    - ``('diag', targets, controls, cstates, dr, di)``: elementwise complex
+      multiply by the diagonal entry selected by the targets' index bits
+      (entries equal to 1 are never written — a controlled phase costs one
+      select).
+    - ``('mrz', targets, cos, sin)``: parity-keyed phase rotation,
+      exp(-i a/2 Z..Z); the trig is precomputed host-side in f64 (the mrz
+      angle-precision contract, see circuit.op_operands).
+    """
+    nmats = (len(refs) - 4) // 2
+    mats = refs[:2 * nmats]
+    re_ref, im_ref, ore_ref, oim_ref = refs[2 * nmats:]
+    hp = jax.lax.Precision.HIGHEST
+    xr = re_ref[...]
+    xi = im_ref[...]
+    f, s, l = xr.shape
+    k = _block_k(xr.shape, pl.program_id(0) * jnp.int32(LANE * SUB * LANE))
+
+    def bit(q):
+        return (k >> q) & 1
+
+    def ctrl(controls, cstates):
+        m = None
+        for c, st in zip(controls, cstates):
+            t = bit(c) == st
+            m = t if m is None else (m & t)
+        return m
+
+    def rdot(x, m):     # minor axis: out[., j] = sum_l x[., l] m[j, l]
+        return jax.lax.dot_general(x, m, (((1,), (1,)), ((), ())),
+                                   precision=hp,
+                                   preferred_element_type=x.dtype)
+
+    def ldot(m, x):     # leading axis: out[j, .] = sum_a m[j, a] x[a, .]
+        return jax.lax.dot_general(m, x, (((1,), (0,)), ((), ())),
+                                   precision=hp,
+                                   preferred_element_type=x.dtype)
+
+    for spec in specs:
+        tag = spec[0]
+        if tag == "dense":
+            _, axis, mi, controls, cstates = spec
+            mr = mats[2 * mi][...]
+            mim = mats[2 * mi + 1][...]
+            if axis == "lane":
+                ar = xr.reshape(f * s, l)
+                ai = xi.reshape(f * s, l)
+                nr = (rdot(ar, mr) - rdot(ai, mim)).reshape(f, s, l)
+                ni = (rdot(ar, mim) + rdot(ai, mr)).reshape(f, s, l)
+            elif axis == "sub":
+                # left-multiply with S leading (see pallas_layer csub)
+                ar = xr.transpose(1, 0, 2).reshape(s, f * l)
+                ai = xi.transpose(1, 0, 2).reshape(s, f * l)
+                nr = (ldot(mr, ar) - ldot(mim, ai)).reshape(s, f, l) \
+                    .transpose(1, 0, 2)
+                ni = (ldot(mim, ar) + ldot(mr, ai)).reshape(s, f, l) \
+                    .transpose(1, 0, 2)
+            else:
+                ar = xr.reshape(f, s * l)
+                ai = xi.reshape(f, s * l)
+                nr = (ldot(mr, ar) - ldot(mim, ai)).reshape(f, s, l)
+                ni = (ldot(mim, ar) + ldot(mr, ai)).reshape(f, s, l)
+            if controls:
+                m = ctrl(controls, cstates)
+                nr = jnp.where(m, nr, xr)
+                ni = jnp.where(m, ni, xi)
+            xr, xi = nr, ni
+        elif tag == "diag":
+            _, targets, controls, cstates, dr, di = spec
+            idx = None
+            for j, t in enumerate(targets):
+                b = bit(t) << j if j else bit(t)
+                idx = b if idx is None else idx | b
+            vr = jnp.full_like(xr, 1.0)
+            vi = jnp.zeros_like(xr)
+            for b in range(len(dr)):
+                if dr[b] == np.float32(1.0) and di[b] == np.float32(0.0):
+                    continue
+                eq = idx == b
+                vr = jnp.where(eq, jnp.float32(dr[b]), vr)
+                vi = jnp.where(eq, jnp.float32(di[b]), vi)
+            if controls:
+                m = ctrl(controls, cstates)
+                vr = jnp.where(m, vr, jnp.float32(1.0))
+                vi = jnp.where(m, vi, jnp.float32(0.0))
+            xr, xi = xr * vr - xi * vi, xr * vi + xi * vr
+        else:
+            _, targets, c_, s_ = spec
+            par = None
+            for t in targets:
+                par = bit(t) if par is None else par ^ bit(t)
+            cc = jnp.float32(c_)
+            sn = jnp.where(par == 1, jnp.float32(s_), jnp.float32(-s_))
+            xr, xi = xr * cc - xi * sn, xr * sn + xi * cc
+    ore_ref[...] = xr
+    oim_ref[...] = xi
+
+
+def _run_block_pass(re, im, bp: BlockPass):
+    top, shape3 = _shape3(re.shape[0])
+    ins = []
+    in_specs = []
+    for m in bp.mats:
+        d = m.shape[1]
+        ins += [jnp.asarray(m[0]), jnp.asarray(m[1])]
+        in_specs += [pl.BlockSpec((d, d), lambda i: (0, 0))] * 2
+    run = pl.pallas_call(
+        partial(_epoch_block_kernel, bp.specs),
+        interpret=_interpret(),
+        grid=(top,),
+        in_specs=in_specs + [_state_spec(), _state_spec()],
+        out_specs=[_state_spec(), _state_spec()],
+        out_shape=[
+            jax.ShapeDtypeStruct(shape3, re.dtype),
+            jax.ShapeDtypeStruct(shape3, re.dtype),
+        ],
+        # out block (i) reads only in block (i): the state planes alias
+        # their outputs and the whole fused pass runs truly in place
+        input_output_aliases={len(ins): 0, len(ins) + 1: 1},
+    )
+    out_re, out_im = run(*ins, re.reshape(shape3), im.reshape(shape3))
+    return out_re.reshape(-1), out_im.reshape(-1)
+
+
+def _run_fiber_pass(re, im, fp: FiberPass):
+    from .pallas_layer import _apply_fiber_p
+    return _apply_fiber_p(re, im, jnp.asarray(fp.pack), fp.base, fp.width)
+
+
+# ---------------------------------------------------------------------------
+# execution entry points
+# ---------------------------------------------------------------------------
+
+def run_planes(re: jax.Array, im: jax.Array, ops: tuple):
+    """Apply ``ops`` to plane-pair storage through the epoch plan.
+    CONSUMES both planes (every Pallas pass aliases).  Returns
+    ``(re, im, residual_perm)`` — the deferred qubit map is NOT
+    materialized: logical wire q's content sits at position
+    ``residual_perm[q]`` (the qft_inplace ``bit_reversal=False``
+    convention); callers chain further epochs or reconcile once."""
+    plan = plan_circuit(tuple(ops), int(re.shape[0]).bit_length() - 1)
+    for segment in plan.segments:
+        if segment.engine == "pallas":
+            for p in segment.passes:
+                if p.kind == "block":
+                    re, im = _run_block_pass(re, im, p)
+                else:
+                    re, im = _run_fiber_pass(re, im, p)
+        else:
+            from ..circuit import _apply_one
+            state = jnp.stack([re, im])
+            for op in segment.ops:
+                state = _apply_one(state, op)
+            re, im = state[0], state[1]
+    return re, im, plan.residual_perm
+
+
+def run_ops_planes(state: jax.Array, ops: tuple) -> jax.Array:
+    """(2, N) compatibility entry: plane split, epoch chain, residual
+    permutation reconciled (``reconcile_perm`` — fused prefix transposes).
+    The plane slice/stack at the boundaries costs a state copy next to the
+    truly in-place :func:`run_planes`; fine through 29 qubits."""
+    from .apply import num_qubits_of, reconcile_perm
+    n = num_qubits_of(state)
+    if state.dtype != jnp.float32:
+        raise ValueError(f"epoch executor is f32-only, got {state.dtype}")
+    if not MIN_QUBITS <= n <= MAX_QUBITS:
+        raise ValueError(
+            f"epoch executor needs {MIN_QUBITS} <= n <= {MAX_QUBITS}, got {n}")
+    re, im, perm = run_planes(state[0], state[1], tuple(ops))
+    return reconcile_perm(jnp.stack([re, im]), perm)
+
+
+def jit_program(ops, donate: bool = False):
+    """One jitted ``state -> state`` program over the epoch plan.  Traced
+    with x64 disabled (the Mosaic lowering constraint shared by every
+    in-place engine; safe here because mrz phases are precomputed host-side
+    in f64 — no traced f64 operand exists in the program)."""
+    ops = tuple(ops)
+
+    @partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def run(state):
+        return run_ops_planes(state, ops)
+
+    def call(state):
+        with _compat.enable_x64(False):
+            return run(state)
+
+    return call

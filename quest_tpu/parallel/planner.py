@@ -207,6 +207,10 @@ MEASURED_EFFICIENCY = {
     "f32_inplace": 0.29,  # qft_30q in-place engine (r04/r05: 0.27-0.31)
     "f64_gate": 0.065,    # random24_f64_unfused (r05; X64-emulated stack)
     "f64_best": 0.21,     # best measured f64 flip-kernel window (r05)
+    # the general epoch executor (ops/epoch_pallas.py) inherits the in-place
+    # engine class it generalizes: its passes are the same aliased
+    # block/fiber kernels the qft_30q rows measured at 0.27-0.31
+    "pallas_epoch": 0.29,
 }
 
 
@@ -370,3 +374,170 @@ def project_random_circuit(num_qubits: int, depth: int, num_devices: int,
         "amp_updates_per_sec_per_chip": per_chip,
         "vs_1e8_target": per_chip / 1e8,
     }
+
+
+# ---------------------------------------------------------------------------
+# engine dimension: XLA gate engine vs the Pallas epoch executor
+# (ops/epoch_pallas.py) as the compiled-circuit backend.  The scheduler and
+# compile_circuit(engine="auto") pick per circuit from the SAME pass-count x
+# MEASURED_EFFICIENCY roofline the rest of this module uses, so the choice
+# is inspectable before compiling (the module's founding contract).
+# ---------------------------------------------------------------------------
+
+#: engines ``compile_circuit`` accepts; "auto" resolves through
+#: :func:`select_engine` before anything is keyed or compiled
+ENGINES = ("auto", "xla", "pallas")
+
+
+def engine_time_model(circuit, chip: ChipSpec = V5E, precision: int = 1,
+                      plan=None) -> dict:
+    """Single-chip wall-time comparison of the two compiled-circuit
+    backends for ``circuit``: the per-gate XLA engine (one HBM pass per op,
+    ``f32_gate``/``f64_gate`` efficiency — the deliberately conservative
+    convention of :func:`time_model`) vs the Pallas epoch executor's fused
+    lowering (``plan.hbm_passes`` aliased passes; Pallas segments at the
+    measured ``pallas_epoch`` efficiency, fallback XLA segments at the gate
+    efficiency).  Returns the auditable breakdown ``select_engine`` scores;
+    ``pallas_seconds`` is None outside the epoch engine's envelope."""
+    from ..ops import epoch_pallas as _ep
+    n = circuit.num_qubits
+    bytes_per_amp = 8 if precision == 1 else 16
+    state_bytes = (1 << n) * bytes_per_amp
+    eff_xla = MEASURED_EFFICIENCY["f32_gate" if precision == 1
+                                  else "f64_gate"]
+    pass_s_xla = 2.0 * state_bytes / (chip.hbm_bytes_per_sec * eff_xla)
+    pass_s_pallas = 2.0 * state_bytes / (
+        chip.hbm_bytes_per_sec * MEASURED_EFFICIENCY["pallas_epoch"])
+    out = {
+        "num_qubits": n,
+        "ops": len(circuit.ops),
+        "xla_hbm_passes": len(circuit.ops),
+        "xla_seconds": len(circuit.ops) * pass_s_xla,
+        "pallas_supported": _ep.epoch_supported(n, precision),
+        "pallas_seconds": None,
+        "pallas_hbm_passes": None,
+    }
+    if not out["pallas_supported"]:
+        return out
+    if plan is None:
+        plan = _ep.plan_circuit(circuit.key(), n)
+    out["pallas_hbm_passes"] = plan.hbm_passes
+    out["pallas_seconds"] = (plan.pallas_passes * pass_s_pallas
+                             + plan.xla_ops * pass_s_xla)
+    out["pallas_pass_breakdown"] = {
+        "pallas_passes": plan.pallas_passes,
+        "xla_fallback_ops": plan.xla_ops,
+        "deferred_perm_ops": plan.deferred_ops,
+    }
+    return out
+
+
+def select_engine(circuit, num_devices: int | None = None,
+                  chip: ChipSpec = V5E, precision: int = 1,
+                  requested: str = "auto", backend: str | None = None) -> dict:
+    """Resolve the compiled-circuit engine for a deployment.
+
+    Returns ``{"engine", "reason", "model", "plan"}`` with ``engine`` in
+    ``("xla", "pallas")``.  ``requested="pallas"`` forces the epoch
+    executor wherever its envelope admits the register (interpret mode off
+    TPU — the CI/test path) and raises ``QuESTError``
+    ``E_INVALID_SCHEDULE_OPTION`` where it cannot hold (mesh deployments:
+    the deferred qubit map renames amplitude-index bits, which MUST be
+    materialized before any sharded collective — docs/DESIGN.md — so the
+    engine is single-device; f64; n outside [17, 30]).
+
+    ``requested="auto"`` picks by the :func:`engine_time_model` roofline on
+    ``chip`` — a TPU-class spec, so the choice is deterministic and
+    cache-key-stable — but only commits to Pallas when ``backend``
+    (default: the live jax backend) actually compiles Mosaic: off-TPU the
+    kernels run in interpret mode, a correctness tool, not an engine.
+    ``QUEST_TPU_EPOCH_ENGINE=1`` overrides the backend guard (CI);
+    ``=0`` pins auto to XLA."""
+    import os
+
+    from ..ops import epoch_pallas as _ep
+    if requested not in ENGINES:
+        raise ValueError(f"engine must be one of {ENGINES}, got {requested!r}")
+
+    def xla(reason, model=None):
+        return {"engine": "xla", "reason": reason, "model": model,
+                "plan": None}
+
+    multi = num_devices is not None and num_devices > 1
+    supported = _ep.epoch_supported(circuit.num_qubits, precision)
+    if requested == "xla":
+        return xla("requested")
+    if multi or not supported:
+        reason = ("multi-device mesh: the deferred qubit map must "
+                  "materialize before sharded collectives" if multi else
+                  f"outside the in-place envelope (f32, "
+                  f"{_ep.MIN_QUBITS} <= n <= {_ep.MAX_QUBITS})")
+        if requested == "pallas":
+            from ..validation import MESSAGES, ErrorCode, QuESTError
+            raise QuESTError(ErrorCode.INVALID_SCHEDULE_OPTION,
+                             MESSAGES[ErrorCode.INVALID_SCHEDULE_OPTION]
+                             + f" engine='pallas' unavailable: {reason}.",
+                             "select_engine")
+        return xla(reason)
+    if requested == "pallas":
+        plan = _ep.plan_circuit(circuit.key(), circuit.num_qubits)
+        return {"engine": "pallas", "reason": "requested",
+                "model": engine_time_model(circuit, chip, precision,
+                                           plan=plan),
+                "plan": plan}
+    # auto: cheap guards BEFORE the plan build — the default
+    # compile_circuit path must stay trivial wherever the answer is XLA
+    # anyway (off-TPU backends run Pallas in interpret mode)
+    env = os.environ.get("QUEST_TPU_EPOCH_ENGINE")
+    if env == "0":
+        return xla("QUEST_TPU_EPOCH_ENGINE=0")
+    if env != "1":
+        import jax
+        live = backend or jax.default_backend()
+        if live != "tpu":
+            return xla(f"backend {live!r} runs Pallas in interpret mode")
+    plan = _ep.plan_circuit(circuit.key(), circuit.num_qubits)
+    model = engine_time_model(circuit, chip, precision, plan=plan)
+    if plan.pallas_passes == 0:
+        return xla("no epoch-supported windows", model)
+    if model["pallas_seconds"] >= model["xla_seconds"]:
+        return xla("modeled slower than the XLA engine", model)
+    return {"engine": "pallas",
+            "reason": (f"modeled {model['xla_seconds'] / model['pallas_seconds']:.1f}x "
+                       f"vs XLA ({model['pallas_hbm_passes']} fused passes "
+                       f"vs {model['xla_hbm_passes']})"),
+            "model": model, "plan": plan}
+
+
+def engine_summary(circuit, num_devices: int | None = None,
+                   chip: ChipSpec = V5E, precision: int = 1,
+                   requested: str = "auto") -> dict:
+    """Per-epoch engine report for the analysis CLI's ``--schedule`` view:
+    which engine each epoch of the (scheduled) circuit runs on and what the
+    lowering costs, so ``A_SCHEDULE_COMM_REGRESSION`` comparisons are
+    engine-aware.  Epochs are the epoch executor's segments on one device;
+    on a mesh the whole circuit is one XLA row (see :func:`select_engine`).
+    Unlike ``select_engine`` this REPORTS an infeasible forced engine (as
+    the XLA row it would fall back to) instead of raising — the schedule
+    report must describe any deployment."""
+    from ..validation import QuESTError
+    try:
+        choice = select_engine(circuit, num_devices, chip, precision,
+                               requested)
+    except QuESTError as e:
+        choice = {"engine": "xla", "reason": str(e), "plan": None}
+    epochs = []
+    if choice["plan"] is not None and choice["engine"] == "pallas":
+        for i, seg in enumerate(choice["plan"].segments):
+            epochs.append({
+                "epoch": i, "engine": seg.engine, "ops": len(seg.ops),
+                "hbm_passes": (len(seg.passes) if seg.engine == "pallas"
+                               else len(seg.ops)),
+            })
+    else:
+        epochs.append({"epoch": 0, "engine": "xla", "ops": len(circuit.ops),
+                       "hbm_passes": len(circuit.ops)})
+    return {"engine": choice["engine"], "reason": choice["reason"],
+            "epochs": epochs,
+            "deferred_perm_ops": (choice["plan"].deferred_ops
+                                  if choice["plan"] is not None else 0)}

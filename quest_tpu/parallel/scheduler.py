@@ -651,7 +651,8 @@ def schedule(circuit, num_devices: int, *, chip=None, precision: int = 1,
 
 def schedule_savings(circuit, num_devices: int, *, bytes_per_amp: int = 8,
                      chip=None, precision: int = 1, scheduled=None,
-                     pipeline_chunks: int | None = None) -> dict:
+                     pipeline_chunks: int | None = None,
+                     engine: str = "auto") -> dict:
     """Before/after report of what scheduling buys: planner-predicted
     collective counts, bytes over ICI, and modeled seconds.  The payload
     behind ``python -m quest_tpu.analysis --schedule`` and the predicted
@@ -661,7 +662,14 @@ def schedule_savings(circuit, num_devices: int, *, bytes_per_amp: int = 8,
     overlap plan) the report grows the overlapped executor's predicted
     columns: ``model_seconds_overlapped`` and ``predicted_hidden_frac``
     from :func:`executor.predict_overlap` — the CI gate asserts the
-    overlap-aware model never predicts a slowdown vs the serial schedule."""
+    overlap-aware model never predicts a slowdown vs the serial schedule.
+
+    The report is engine-aware (``engine``: "auto" | "xla" | "pallas"):
+    ``engine_chosen`` / ``engine_epochs`` record which compiled-circuit
+    backend the planner picks per epoch of the SCHEDULED circuit
+    (:func:`planner.engine_summary`), so ``A_SCHEDULE_COMM_REGRESSION``
+    comparisons and bench pairs always say which engine the numbers
+    describe."""
     chip = chip or _planner.V5E
     if scheduled is None:
         scheduled = schedule(circuit, num_devices, chip=chip,
@@ -685,8 +693,14 @@ def schedule_savings(circuit, num_devices: int, *, bytes_per_amp: int = 8,
             "chunked_events": o["chunked_events"],
             "hideable_events": o["hideable_events"],
         }
+    eng = _planner.engine_summary(scheduled, num_devices, chip, precision,
+                                  requested=engine)
     return {
         **overlap_cols,
+        "engine_chosen": eng["engine"],
+        "engine_reason": eng["reason"],
+        "engine_epochs": eng["epochs"],
+        "engine_deferred_perm_ops": eng["deferred_perm_ops"],
         "num_devices": num_devices,
         "ops_before": before["ops"], "ops_after": after["ops"],
         "comm_events_before": before["comm_events"],

@@ -56,12 +56,19 @@ DEFAULT_MAX_BYTES = int(os.environ.get("QUEST_TPU_SERVE_CACHE_BYTES",
 @dataclasses.dataclass(frozen=True)
 class CacheOptions:
     """Execution options that select a DIFFERENT compiled program and are
-    therefore part of the structural key (mesh width, scheduler overlap) —
-    precision is not listed because the state dtype is part of every
-    program signature already."""
+    therefore part of the structural key (mesh width, scheduler overlap,
+    compiled-circuit engine) — precision is not listed because the state
+    dtype is part of every program signature already.
+
+    ``engine`` must be RESOLVED ("xla" | "pallas"; ``compile_circuit``
+    resolves "auto" through the planner BEFORE building options), so a
+    class compiled through the XLA gate engine is never served to a
+    request planned for the Pallas epoch executor and the hit/miss
+    counters stay truthful per engine."""
     num_devices: int | None = None
     overlap: bool = False
     pipeline_chunks: int | None = None
+    engine: str = "xla"
 
 
 @dataclasses.dataclass
@@ -219,9 +226,12 @@ class CompileCache:
         return e
 
     def _build_entry(self, skey, ops, num_qubits, options) -> CacheEntry:
-        if options.overlap:
-            # the pipelined executor (PR 4) embeds payloads host-side:
-            # cached, byte-budgeted, but not parameter-lifted
+        if options.overlap or options.engine == "pallas":
+            # the pipelined executor (PR 4) and the Pallas epoch executor
+            # both embed payloads host-side (the epoch planner folds them
+            # into kernel constants and composed packs): cached,
+            # byte-budgeted, but not parameter-lifted — their programs key
+            # on the full op tuple within the class entry
             return CacheEntry(skey, options, num_qubits, None, None,
                               int(sum(_circ.op_param_count(op) for op in ops)))
         if options.num_devices is not None and options.num_devices > 1:
@@ -346,13 +356,44 @@ class CompileCache:
 
         return self._get_program(entry, tag, build)
 
+    def epoch_program(self, entry: CacheEntry, ops: tuple, *,
+                      donate: bool = False) -> _Program:
+        """Opaque per-payload program for a Pallas-epoch class
+        (ops/epoch_pallas.py; payloads are kernel constants and composed
+        packs, so — like overlap classes — the program keys on the FULL op
+        tuple inside the class entry and the byte budget still governs
+        it."""
+        tag = ("epoch", bool(donate), ops)
+
+        def build():
+            from ..ops import epoch_pallas as _ep
+            return _ep.jit_program(ops, donate=donate)
+
+        return self._get_program(entry, tag, build)
+
     # -- execution front-ends -----------------------------------------------
     def execute(self, ops, state, params=None, *, num_qubits=None,
                 options: CacheOptions = CacheOptions(),
                 donate: bool = False):
-        """One-call lookup + compile-if-needed + run for a single request."""
+        """One-call lookup + compile-if-needed + run for a single request.
+
+        ``engine="pallas"`` composes with neither ``overlap`` nor a mesh
+        (compile_circuit rejects both; here too rather than silently
+        preferring one), and — like every pallas entry point — falls back
+        to the plain XLA class for non-f32 states."""
+        if options.engine == "pallas":
+            if options.overlap or (options.num_devices or 1) > 1:
+                raise ValueError(
+                    "engine='pallas' is single-device and incompatible with "
+                    "overlap=True (the deferred qubit map must materialize "
+                    "before sharded collectives; docs/DESIGN.md)")
+            if state.dtype != jnp.float32:   # f32-only engine
+                options = dataclasses.replace(options, engine="xla")
         entry = self.entry_for(ops, num_qubits, options)
         if entry.skeleton is None:
+            if options.engine == "pallas":
+                return self.epoch_program(entry, tuple(ops),
+                                          donate=donate).call(state)
             return self.overlap_program(entry, tuple(ops),
                                         donate=donate).call(state)
         if params is None:
@@ -361,27 +402,39 @@ class CompileCache:
         prog = self.single_program(entry, state, donate=donate)
         return prog.call(state, params)
 
-    def donating_runner(self, ops):
+    def donating_runner(self, ops, engine: str = "xla"):
         """The ``compile_circuit(donate=True)`` adapter: a ``state ->
         state`` callable over this op tuple's operand vector and the
         class's shared donating program.  The resolved (entry, program) is
         memoized per state signature in the closure — donate exists for
         tight iteration loops, which must not take the process-global cache
         lock (or inflate the per-request hit counters) once per step; only
-        an evicted entry re-enters the cache."""
+        an evicted entry re-enters the cache.
+
+        ``engine="pallas"`` routes the class through the epoch executor's
+        opaque donating program (its own class key: CacheOptions.engine);
+        non-f32 states fall back to the lifted XLA program of the plain
+        class — the epoch engine is f32-only."""
         ops = tuple(ops)
         params = jnp.asarray(_circ.param_vector(ops))
+        options = CacheOptions(engine=engine)
         resolved: dict = {}
 
         def run(state):
             sig = _state_sig(state)
             hit = resolved.get(sig)
             if hit is None or not hit[0].alive:
-                entry = self.entry_for(ops)
-                prog = self.single_program(entry, state, donate=True)
+                if engine == "pallas" and state.dtype == jnp.float32:
+                    entry = self.entry_for(ops, options=options)
+                    prog = self.epoch_program(entry, ops, donate=True)
+                    call = prog.call
+                else:
+                    entry = self.entry_for(ops)
+                    prog = self.single_program(entry, state, donate=True)
+                    call = (lambda st, _p=prog: _p.call(st, params))
                 resolved.clear()     # one live signature per loop in practice
-                resolved[sig] = hit = (entry, prog)
-            return hit[1].call(state, params)
+                resolved[sig] = hit = (entry, call)
+            return hit[1](state)
 
         return run
 

@@ -1,0 +1,356 @@
+"""Pallas epoch executor (ops/epoch_pallas.py) — interpret mode on CPU; the
+same kernel code runs Mosaic-compiled on a chip (the pallas_layer /
+qft_inplace engines it generalizes are chip-validated at n=20..30).
+
+Covers: random 1q/2q/diagonal windows vs the XLA gate engine, the deferred
+qubit map carried across 2+ epoch segments, degenerate single-op windows
+(bit-exact f32 for diagonal kinds), the QFT HBM-pass-count regression
+(engine="auto" must NOT silently fall back to the per-gate XLA path), the
+planner's engine selection, and the engine-tagged compile-cache keys.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from quest_tpu.circuit import (Circuit, compile_circuit, qft_circuit,
+                               random_circuit)
+from quest_tpu.ops import epoch_pallas as ep
+from quest_tpu.parallel import planner
+from quest_tpu.validation import QuESTError
+
+N = 17  # the engine floor: one (128, 8, 128) block
+
+
+def _haar(rng, k=1):
+    d = 1 << k
+    g = rng.normal(size=(d, d)) + 1j * rng.normal(size=(d, d))
+    u, r = np.linalg.qr(g)
+    return u * (np.diag(r) / np.abs(np.diag(r)))
+
+
+def _rand_state(n, seed=0):
+    rng = np.random.default_rng(seed)
+    st = rng.normal(size=(2, 1 << n)).astype(np.float32)
+    st /= np.sqrt((st ** 2).sum())
+    return jnp.asarray(st)
+
+
+def _assert_engines_agree(c, seed=0, atol=5e-6):
+    st = _rand_state(c.num_qubits, seed)
+    want = np.asarray(compile_circuit(c, engine="xla")(st))
+    got = np.asarray(compile_circuit(c, engine="pallas")(st))
+    np.testing.assert_allclose(got, want, atol=atol)
+    return got, want
+
+
+# ---------------------------------------------------------------------------
+# property: random mixed windows vs the XLA engine
+# ---------------------------------------------------------------------------
+
+def _random_window(n, seed, length=14):
+    """A window drawing from every supported class: 1q dense anywhere,
+    same-group 2q dense, controlled 1q dense, diagonals (cz / phase / rz),
+    wide mrz, and swaps (which must cost zero passes)."""
+    rng = np.random.default_rng(seed)
+    c = Circuit(n)
+    for _ in range(length):
+        kind = rng.integers(0, 8)
+        if kind == 0:
+            c.unitary(int(rng.integers(0, n)), _haar(rng))
+        elif kind == 1:  # controlled 1q dense, block target
+            t = int(rng.integers(0, 10))
+            ctl = int(rng.choice([q for q in range(n) if q != t]))
+            c.multi_qubit_unitary((t,), _haar(rng), controls=(ctl,))
+        elif kind == 2:  # 2q dense inside one axis group
+            lo, hi = [(0, 7), (7, 10), (10, 17)][rng.integers(0, 3)]
+            a, b = rng.choice(np.arange(lo, hi), size=2, replace=False)
+            c.multi_qubit_unitary((int(a), int(b)), _haar(rng, 2))
+        elif kind == 3:
+            a, b = rng.choice(n, size=2, replace=False)
+            c.cz(int(a), int(b))
+        elif kind == 4:
+            t = int(rng.integers(0, n))
+            ctl = int(rng.choice([q for q in range(n) if q != t]))
+            c.phase_shift(t, float(rng.uniform(-np.pi, np.pi)),
+                          controls=(ctl,) if rng.integers(0, 2) else ())
+        elif kind == 5:
+            c.rz(int(rng.integers(0, n)), float(rng.uniform(-np.pi, np.pi)))
+        elif kind == 6:
+            ts = rng.choice(n, size=12, replace=False)
+            c.multi_rotate_z(tuple(int(t) for t in ts),
+                             float(rng.uniform(-np.pi, np.pi)))
+        else:
+            a, b = rng.choice(n, size=2, replace=False)
+            c.swap(int(a), int(b))
+    return c
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_random_window_matches_xla(seed):
+    c = _random_window(N, seed)
+    _assert_engines_agree(c, seed)
+
+
+def test_swaps_cost_zero_passes():
+    c = Circuit(N)
+    for q in range(N // 2):
+        c.swap(q, N - 1 - q)
+    plan = ep.plan_circuit(c.key(), N)
+    assert plan.hbm_passes == 0
+    assert plan.deferred_ops == N // 2
+    assert plan.residual_perm != tuple(range(N))
+    _assert_engines_agree(c)
+
+
+def test_high_qubit_fiber_ops():
+    """Dense 1q (incl. x/y kinds) on qubits >= 17: the fiber pack path,
+    with consecutive same-group ops merged into one pass."""
+    n = 19
+    rng = np.random.default_rng(7)
+    c = Circuit(n)
+    c.unitary(17, _haar(rng))
+    c.unitary(18, _haar(rng))
+    c.h(17)
+    c.y(18)
+    c.x(17)
+    plan = ep.plan_circuit(c.key(), n)
+    assert plan.hbm_passes == 1  # one merged pack for the whole run
+    _assert_engines_agree(c)
+
+
+def test_control_across_block_boundary():
+    """Controls above the block range select off the global amplitude
+    index reconstructed from program_id."""
+    n = 18
+    rng = np.random.default_rng(3)
+    c = Circuit(n)
+    c.multi_qubit_unitary((2,), _haar(rng), controls=(17,))
+    c.phase_shift(4, 0.7, controls=(17,))
+    plan = ep.plan_circuit(c.key(), n)
+    assert plan.xla_ops == 0
+    _assert_engines_agree(c)
+
+
+# ---------------------------------------------------------------------------
+# deferred qubit map across 2+ epochs
+# ---------------------------------------------------------------------------
+
+def test_deferred_map_carries_across_epochs():
+    """Swaps before, between and after two Pallas segments split by an
+    unsupported op (cross-group 2q dense -> XLA fallback window): the
+    residual permutation must be carried through ALL of it and reconciled
+    once at the end."""
+    rng = np.random.default_rng(11)
+    c = Circuit(N)
+    c.swap(0, 12)
+    c.unitary(0, _haar(rng))          # physically lands on wire 12
+    c.cz(0, 5)
+    c.multi_qubit_unitary((5, 14), _haar(rng, 2))   # cross-group: XLA
+    c.swap(3, 16)
+    c.unitary(3, _haar(rng))
+    c.t(16)
+    c.swap(1, 2)
+    plan = ep.plan_circuit(c.key(), N)
+    engines = [s.engine for s in plan.segments]
+    assert engines == ["pallas", "xla", "pallas"]
+    assert plan.deferred_ops == 3
+    assert plan.residual_perm != tuple(range(N))
+    _assert_engines_agree(c)
+
+
+def test_pure_permutation_circuit():
+    c = Circuit(N)
+    c.swap(0, 16)
+    c.swap(3, 7)
+    c.swap(0, 3)
+    _assert_engines_agree(c, atol=0.0)  # pure data movement: exact
+
+
+# ---------------------------------------------------------------------------
+# degenerate single-op windows
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("build", [
+    lambda c: c.cz(2, 13),
+    lambda c: c.s(9),
+    lambda c: c.rz(16, 0.37),
+])
+def test_single_diagonal_op_bit_exact(build):
+    """A one-op diagonal window must be BIT-exact vs the XLA engine: both
+    paths multiply each amplitude by the same f32-rounded factor with the
+    same complex-product expression."""
+    c = Circuit(N)
+    build(c)
+    got, want = _assert_engines_agree(c, atol=0.0)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_single_mrz_window():
+    """mrz phases precompute host-side in f64 (the angle-precision
+    contract) — one rounding step apart from the XLA engine's in-device
+    f64 trig, so the window agrees to f32 ulp, not bitwise."""
+    c = Circuit(N)
+    c.multi_rotate_z(tuple(range(12)), 1.1)
+    _assert_engines_agree(c, atol=3e-7)
+
+
+@pytest.mark.parametrize("q", [0, 5, 8, 12, 16])
+def test_single_dense_op(q):
+    rng = np.random.default_rng(100 + q)
+    c = Circuit(N)
+    c.unitary(q, _haar(rng))
+    plan = ep.plan_circuit(c.key(), N)
+    assert plan.hbm_passes == 1
+    _assert_engines_agree(c, atol=5e-7)
+
+
+# ---------------------------------------------------------------------------
+# QFT pass-count regression: auto must not silently fall back
+# ---------------------------------------------------------------------------
+
+def test_qft_plan_reproduces_inplace_pass_count():
+    """The general epoch lowering of the QFT must match (here: beat by one,
+    the q=17 ladder fusing into the tail pass) the hand-written
+    qft_inplace engine's ~2(n-17)+1 HBM passes, with the trailing swap
+    network absorbed into the deferred map at zero passes."""
+    for n in (22, 28):
+        plan = ep.plan_circuit(qft_circuit(n).key(), n)
+        assert plan.xla_ops == 0, "silent per-gate fallback"
+        assert plan.hbm_passes <= 2 * (n - 17) + 1
+        assert plan.hbm_passes == 2 * (n - 17)
+        assert plan.deferred_ops == n // 2          # the swap network
+        assert plan.residual_perm != tuple(range(n))
+
+
+def test_compile_circuit_auto_selects_pallas_for_qft(monkeypatch):
+    """compile_circuit(engine='auto') — the default path — must pick the
+    epoch executor for the QFT factory on TPU-class specs (the backend
+    guard lifted via QUEST_TPU_EPOCH_ENGINE=1, since tests run on CPU) and
+    carry the full fused plan, not a per-gate fallback."""
+    monkeypatch.setenv("QUEST_TPU_EPOCH_ENGINE", "1")
+    run = compile_circuit(qft_circuit(28))
+    assert run.engine == "pallas"
+    assert run.engine_plan.hbm_passes <= 2 * (28 - 17) + 1
+    assert run.engine_plan.xla_ops == 0
+    run = compile_circuit(random_circuit(24, 4))
+    assert run.engine == "pallas"
+
+
+# ---------------------------------------------------------------------------
+# engine selection
+# ---------------------------------------------------------------------------
+
+def test_select_engine_rules():
+    qft = qft_circuit(28)
+    # TPU-class spec: pallas for the factories
+    assert planner.select_engine(qft, 1, backend="tpu")["engine"] == "pallas"
+    assert planner.select_engine(random_circuit(24, 4), 1,
+                                 backend="tpu")["engine"] == "pallas"
+    # off-TPU, auto stays on the XLA engine (interpret mode is not a perf
+    # engine); forcing still works
+    assert planner.select_engine(qft, 1, backend="cpu")["engine"] == "xla"
+    assert planner.select_engine(qft, 1, backend="cpu",
+                                 requested="pallas")["engine"] == "pallas"
+    # outside the envelope: f64, small registers, meshes
+    assert planner.select_engine(qft, 1, precision=2,
+                                 backend="tpu")["engine"] == "xla"
+    assert planner.select_engine(qft_circuit(12), 1,
+                                 backend="tpu")["engine"] == "xla"
+    assert planner.select_engine(qft, 8, backend="tpu")["engine"] == "xla"
+    with pytest.raises(QuESTError):
+        planner.select_engine(qft, 8, requested="pallas")
+    with pytest.raises(QuESTError):
+        planner.select_engine(qft_circuit(12), 1, requested="pallas")
+    with pytest.raises(ValueError):
+        planner.select_engine(qft, 1, requested="mosaic")
+
+
+def test_engine_summary_per_epoch():
+    c = Circuit(N)
+    rng = np.random.default_rng(5)
+    c.h(0)
+    c.multi_qubit_unitary((3, 12), _haar(rng, 2))   # cross-group: XLA epoch
+    c.cz(1, 2)
+    s = planner.engine_summary(c, 1, requested="pallas")
+    assert s["engine"] == "pallas"
+    assert [e["engine"] for e in s["epochs"]] == ["pallas", "xla", "pallas"]
+    # infeasible forced engine is REPORTED, not raised
+    s = planner.engine_summary(c, 8, requested="pallas")
+    assert s["engine"] == "xla"
+
+
+def test_f64_state_falls_back_at_call_time():
+    c = Circuit(N)
+    c.h(0)
+    run = compile_circuit(c, engine="pallas")
+    st = _rand_state(N).astype(jnp.float64)
+    want = np.asarray(compile_circuit(c, engine="xla")(st))
+    np.testing.assert_allclose(np.asarray(run(st)), want, atol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# engine-tagged program identity (serve compile cache / Circuit.key)
+# ---------------------------------------------------------------------------
+
+def test_circuit_key_records_engine():
+    c = qft_circuit(N)
+    assert c.key(engine="xla") == c.key()        # backward compatible
+    assert c.key(engine=None) == c.key()
+    assert c.key(engine="pallas") != c.key()
+    assert c.key(engine="pallas")[0] == ("engine", "pallas")
+
+
+def test_cache_class_key_separates_engines():
+    """A class compiled under engine='xla' must never be served to a
+    request planned for engine='pallas': distinct entries, truthful
+    hit/miss counters, and distinct executables."""
+    from quest_tpu.serve.cache import CacheOptions, CompileCache
+    cache = CompileCache(max_bytes=1 << 30)
+    c = Circuit(N)
+    c.h(0)
+    ops = c.key()
+    e_xla = cache.entry_for(ops, options=CacheOptions())
+    e_pal = cache.entry_for(ops, options=CacheOptions(engine="pallas"))
+    assert e_xla is not e_pal
+    assert cache.stats["misses"] == 2 and cache.stats["hits"] == 0
+    assert e_pal.skeleton is None      # opaque: payloads live in kernels
+    assert cache.entry_for(ops, options=CacheOptions(engine="pallas")) is e_pal
+    assert cache.stats["hits"] == 1
+
+    st = _rand_state(N)
+    want = np.asarray(compile_circuit(c, engine="xla")(st))
+    got = np.asarray(
+        cache.epoch_program(e_pal, ops).call(st))
+    np.testing.assert_allclose(got, want, atol=5e-7)
+
+
+def test_donating_runner_engine_dimension():
+    from quest_tpu.serve.cache import CompileCache
+    cache = CompileCache(max_bytes=1 << 30)
+    c = Circuit(N)
+    c.s(4)
+    run_x = cache.donating_runner(c.key())
+    run_p = cache.donating_runner(c.key(), engine="pallas")
+    a = np.asarray(run_x(_rand_state(N, 1)))
+    b = np.asarray(run_p(_rand_state(N, 1)))
+    np.testing.assert_array_equal(a, b)   # diagonal window: bit-exact
+
+
+# ---------------------------------------------------------------------------
+# envelope validation
+# ---------------------------------------------------------------------------
+
+def test_envelope_rejections():
+    with pytest.raises(ValueError):
+        ep.plan_circuit(qft_circuit(12).key(), 12)
+    st = jnp.zeros((2, 1 << 12), jnp.float32)
+    with pytest.raises(ValueError):
+        ep.run_ops_planes(st, qft_circuit(12).key())
+    assert not ep.epoch_supported(12)
+    assert not ep.epoch_supported(31)
+    assert not ep.epoch_supported(20, precision=2)
+    assert ep.epoch_supported(20)
