@@ -44,6 +44,49 @@ BASELINE_AMPS_PER_SEC = 1e8  # driver target (BASELINE.md north star)
 
 HBM_PEAK_BYTES_PER_SEC = 819e9  # v5e HBM bandwidth (public spec ~819 GB/s)
 
+_PROVENANCE: dict | None = None
+
+
+def _provenance() -> dict:
+    """Environment provenance stamped onto every emitted row so the
+    BENCH_r0*.json trajectories are self-describing: a number is only
+    comparable to another number when the software stack that produced it
+    is known (jax/jaxlib/libtpu versions, git sha, backend platform)."""
+    global _PROVENANCE
+    if _PROVENANCE is not None:
+        return _PROVENANCE
+    import platform as _plat
+    import subprocess
+
+    import jax
+    import numpy as np
+    prov = {
+        "jax": jax.__version__,
+        "numpy": np.__version__,
+        "python": _plat.python_version(),
+        "platform": jax.devices()[0].platform,
+        "device_kind": getattr(jax.devices()[0], "device_kind", ""),
+    }
+    try:
+        import jaxlib
+        prov["jaxlib"] = jaxlib.__version__
+    except Exception:
+        pass
+    try:
+        import libtpu
+        prov["libtpu"] = getattr(libtpu, "__version__", "present")
+    except Exception:
+        pass
+    try:
+        prov["git_sha"] = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10).stdout.strip()
+    except Exception:
+        prov["git_sha"] = ""
+    _PROVENANCE = prov
+    return prov
+
 
 def _roofline(num_amps: int, precision: int, passes: float,
               seconds: float) -> dict:
@@ -729,8 +772,10 @@ def bench_sched_pair(circuit, devices, depth=1):
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    from quest_tpu.analysis.jaxpr_audit import count_hlo_async_collectives
+    from quest_tpu.analysis.jaxpr_audit import (count_hlo_async_collectives,
+                                                count_hlo_collectives)
     from quest_tpu.circuit import _apply_one
+    from quest_tpu.obs import global_ledger
     from quest_tpu.parallel import executor as _exec
     from quest_tpu.parallel.scheduler import schedule, schedule_savings
 
@@ -775,6 +820,8 @@ def bench_sched_pair(circuit, devices, depth=1):
             if key == "overlapped" and depth > 1 \
             else fn.lower(state).compile().as_text()
         colls = _hlo_collective_count(text)
+        state_colls = sum(count_hlo_collectives(
+            text, min_elems=(1 << n) // nd // 2).values())
         asyncs = count_hlo_async_collectives(text)
         out = fn(state)
         out.block_until_ready()  # compile + warm
@@ -789,11 +836,23 @@ def bench_sched_pair(circuit, devices, depth=1):
                              + out[1].astype(jnp.float64) ** 2))
         assert abs(norm - 1.0) < 1e-2, f"norm lost ({key}): {norm}"
         measured[key] = {"seconds": best, "hlo_collectives": colls,
+                         "hlo_state_collectives": state_colls,
                          "hlo_async_starts": asyncs["starts"],
                          "hlo_async_separated": asyncs["separated"],
                          "ops": n_ops}
     un, sc = measured["unscheduled"], measured["scheduled"]
     ov = measured["overlapped"]
+    # model-vs-measured ledger row (quest_tpu/obs/ledger.py): predicted
+    # model seconds + comm events of the SCHEDULED program next to its
+    # measured wall and state-sized compiled collectives — wall drift only
+    # judged on TPU platforms (the model is a TPU roofline)
+    drift = global_ledger().record(
+        f"sched_pair_{n}q_x{nd}", engine="xla", num_devices=nd,
+        platform=devices[0].platform,
+        predicted_seconds=predicted["model_seconds_after"],
+        measured_seconds=sc["seconds"],
+        predicted_collectives=predicted["comm_events_after"],
+        measured_hlo_collectives=sc["hlo_state_collectives"])
     value = (1 << n) * len(circuit) * depth / sc["seconds"]
     cfg = {
         "qubits": n, "depth": depth, "precision": 1, "devices": nd,
@@ -833,6 +892,7 @@ def bench_sched_pair(circuit, devices, depth=1):
             "hlo_async_separated": ov["hlo_async_separated"],
         },
         "ops_unscheduled": un["ops"], "ops_scheduled": sc["ops"],
+        "model_vs_measured": drift.as_dict(),
     }
     return value, cfg
 
@@ -883,7 +943,23 @@ def bench_auto_engine(circuit, n, iters=2, label="auto_engine"):
     gates = len(circuit.ops)
     value = (1 << n) * gates * iters / compute_a
     model = spec["model"] or {}
+    # model-vs-measured ledger row: the engine model's prediction for the
+    # LIVE resolved engine next to the measured per-iteration wall
+    from quest_tpu.obs import global_ledger
+    live_model = None
+    if run_auto.engine == "pallas" and model.get("pallas_seconds"):
+        live_model = model["pallas_seconds"] * iters
+    elif model.get("xla_seconds"):
+        live_model = model["xla_seconds"] * iters
+    drift = global_ledger().record(
+        f"auto_engine_{n}q", engine=run_auto.engine, num_devices=1,
+        platform=jax.devices()[0].platform,
+        predicted_seconds=live_model, measured_seconds=compute_a,
+        predicted_hbm_passes=model.get("pallas_hbm_passes")
+        if run_auto.engine == "pallas" else model.get("xla_hbm_passes"),
+        predicted_collectives=0, measured_hlo_collectives=0)
     cfg = {"qubits": n, "gates": gates, "iters": iters, "precision": 1,
+           "model_vs_measured": drift.as_dict(),
            "engine_live": run_auto.engine,
            "engine_live_reason": run_auto.engine_reason,
            "engine_tpu_spec": spec["engine"],
@@ -1049,6 +1125,7 @@ def main() -> None:
         raise RuntimeError("headline config failed: "
                            + "; then ".join(errors)) from _run_config.last_exc
     head_cfg["platform"] = platform
+    head_cfg["provenance"] = _provenance()
 
     matrix = []
 
@@ -1057,6 +1134,7 @@ def main() -> None:
         if value is None:  # a failing config must not kill the headline
             matrix.append({"name": name, "error": "; then ".join(errors)})
         else:
+            cfg["provenance"] = _provenance()
             matrix.append({"name": name, "value": value, "unit": "amps/s",
                            "vs_baseline": value / BASELINE_AMPS_PER_SEC,
                            "config": cfg})

@@ -72,6 +72,11 @@ GROUPS = [
                                "trajectory_expectation_fn"]),
     ("Serving (quest_tpu.serve)", ["QuESTService", "ServeResult",
                                    "CompileCache", "CacheOptions"]),
+    ("Observability (quest_tpu.obs)", ["TraceRecorder", "FlightRecorder",
+                                       "Ledger", "enable_tracing",
+                                       "disable_tracing", "tracing_enabled",
+                                       "chrome_trace", "trace_report",
+                                       "global_ledger"]),
 ]
 
 

@@ -26,6 +26,9 @@ from .trajectories import (trajectory_expectation_fn,  # noqa: F401
                            trajectory_state_fn)
 from .serve import (CacheOptions, CompileCache, QuESTService,  # noqa: F401
                     ServeResult)
+from .obs import (TraceRecorder, FlightRecorder, Ledger,  # noqa: F401
+                  enable_tracing, disable_tracing, tracing_enabled,
+                  chrome_trace, trace_report, global_ledger)
 
 __version__ = "0.1.0"
 __all__ = list(_api_all) + [
@@ -36,4 +39,7 @@ __all__ = list(_api_all) + [
     "state_fn", "adjoint_gradient_fn",
     "trajectory_state_fn", "trajectory_expectation_fn",
     "QuESTService", "ServeResult", "CompileCache", "CacheOptions",
+    "TraceRecorder", "FlightRecorder", "Ledger", "enable_tracing",
+    "disable_tracing", "tracing_enabled", "chrome_trace", "trace_report",
+    "global_ledger",
 ]

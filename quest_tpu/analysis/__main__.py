@@ -19,6 +19,12 @@ Modes (combinable; exit status is 1 iff any ERROR-severity diagnostic):
   counts, unexpected gathers, donation aliasing) — the CI scheduler-
   correctness smoke.  Implies ``--schedule``'s scheduling step.
 
+- ``--trace-report``: execute each circuit single-device with span tracing
+  on (quest_tpu/obs), print the per-span/per-request view, and record a
+  model-vs-measured ledger row (predicted vs measured wall /
+  collective-count); ledger drift reports as ``O_MODEL_DRIFT`` (WARNING —
+  the ``obs-selftest`` CI job gates on zero).
+
 - ``--serve-audit``: machine-prove the serve layer's parameter-lifted
   compilation cache (analysis/serve_audit.py): per structural class, the
   skeleton + operand-vector reconstruction is translation-validated
@@ -172,6 +178,97 @@ def _verify_report(label: str, circuit, args, scheduled, echo) -> tuple:
     return report, found + d2 + d3 + d4 + d5
 
 
+def _trace_report_run(label: str, circuit, args, echo) -> tuple:
+    """The ``--trace-report`` payload for one circuit: compile it for the
+    requested engine, execute it single-device with tracing on, and record
+    a model-vs-measured ledger row (quest_tpu/obs/ledger.py) — predicted
+    seconds / HBM passes / comm events from the planner's engine model next
+    to measured wall time and the compiled-HLO collective count.  Ledger
+    drift findings come back as WARNING diagnostics with the ledger's
+    ``O_MODEL_DRIFT`` code (zero of them is the ci.yml ``obs-selftest``
+    gate on the 17q QFT CPU run)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from .. import obs as _obs
+    from ..circuit import compile_circuit
+    from ..parallel import planner as _planner
+    from .diagnostics import Severity, diag
+    from .jaxpr_audit import count_hlo_collectives
+
+    was_enabled = _obs.tracing_enabled()
+    _obs.enable_tracing()
+    _obs.reset_tracing()
+    try:
+        run = compile_circuit(circuit, engine=args.engine)
+        dtype = _dtype(args.precision)
+        if run.engine == "pallas":
+            dtype = jnp.float32     # the epoch engine's envelope
+        n = circuit.num_qubits
+        state = jnp.zeros((2, 1 << n), dtype).at[0, 0].set(1.0)
+        jax.block_until_ready(run(state))          # compile + warm
+        t0 = time.perf_counter()
+        jax.block_until_ready(run(state))
+        measured_s = time.perf_counter() - t0
+        # compiled-HLO observation: the epoch engine traces with x64 off
+        # (the Mosaic constraint, circuit.py), so its audit lowering must
+        # run under the same flag or aval dtypes drift mid-trace
+        from .. import _compat
+        with _compat.enable_x64(run.engine != "pallas"
+                                and jax.config.jax_enable_x64):
+            text = jax.jit(run).lower(state).compile().as_text()
+        measured_coll = sum(count_hlo_collectives(
+            text, min_elems=(1 << n) // 2).values())
+        model = _planner.engine_time_model(circuit, _chip(args.chip),
+                                           args.precision)
+        if run.engine == "pallas":
+            predicted_s = model["pallas_seconds"]
+            passes = model["pallas_hbm_passes"]
+        else:
+            predicted_s = model["xla_seconds"]
+            passes = model["xla_hbm_passes"]
+        # the run is SINGLE-device (the mode's contract), so the ledger row
+        # compares the single-device model against the single-device
+        # measurement — mixing an --devices N prediction with a 1-device
+        # compile would mask real comm-model drift
+        predicted_coll = _planner.comm_summary(circuit, 1)["comm_events"]
+        rec = _obs.global_ledger().record(
+            label, engine=run.engine, num_devices=1,
+            platform=jax.default_backend(),
+            predicted_seconds=predicted_s,
+            measured_seconds=measured_s,
+            predicted_hbm_passes=passes,
+            predicted_collectives=predicted_coll,
+            measured_hlo_collectives=measured_coll,
+            warn=False)
+        spans = _obs.recorder().spans()
+        report_text = _obs.trace_report(spans)
+        report = {
+            "label": label,
+            "engine": run.engine,
+            "engine_reason": run.engine_reason,
+            "spans": len(spans),
+            "measured_seconds": measured_s,
+            "ledger": rec.as_dict(),
+            "chrome_trace": _obs.chrome_trace(spans),
+            "report": report_text,
+        }
+        echo(f"{label}: trace-report {len(spans)} span(s), engine "
+             f"{run.engine}, {measured_s:.3g}s measured "
+             f"(model {predicted_s:.3g}s), {measured_coll} HLO "
+             f"collective(s) vs {predicted_coll} predicted event(s)")
+        echo(report_text)
+        from ..obs.ledger import MODEL_DRIFT
+        found = [diag(MODEL_DRIFT, Severity.WARNING,
+                      detail=f"{label}: {f}") for f in rec.findings]
+        return report, found
+    finally:
+        if not was_enabled:
+            _obs.disable_tracing()
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m quest_tpu.analysis",
@@ -204,6 +301,13 @@ def main(argv=None) -> int:
                              "the serve selftest workload when none are "
                              "given; --devices > 1 audits the scheduler-"
                              "composed cache path")
+    parser.add_argument("--trace-report", action="store_true",
+                        dest="trace_report",
+                        help="execute each circuit single-device with span "
+                             "tracing on (quest_tpu/obs), print the "
+                             "per-request/per-span report, and record a "
+                             "model-vs-measured ledger row; ledger drift "
+                             "is reported as O_MODEL_DRIFT (WARNING)")
     parser.add_argument("--overlap-chunks", type=int, default=None,
                         dest="overlap_chunks", metavar="C",
                         help="schedule with the pipelined executor's "
@@ -235,7 +339,8 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     doc: dict = {"circuits": [], "schedule": [], "verify": [],
-                 "serve_audit": [], "diagnostics": [], "summary": {}}
+                 "serve_audit": [], "trace_report": [], "diagnostics": [],
+                 "summary": {}}
 
     def echo(line: str) -> None:
         if not args.as_json:
@@ -280,6 +385,10 @@ def main(argv=None) -> int:
                                                scheduled, echo)
                 doc["verify"].append(report)
                 found += extra
+        if args.trace_report:
+            report, extra = _trace_report_run(label, circuit, args, echo)
+            doc["trace_report"].append(report)
+            found += extra
         diagnostics += found
         doc["circuits"].append({"label": label, "ops": len(circuit.ops),
                                 "findings": len(found)})

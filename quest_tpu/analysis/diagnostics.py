@@ -53,6 +53,10 @@ class AnalysisCode:
     SUBTILE_SHARD = "A_SUBTILE_SHARD"
     # serving-layer parameter-lift audit (analysis/serve_audit.py)
     PARAM_LIFT_DIVERGENCE = "A_PARAM_LIFT_DIVERGENCE"
+    # model-vs-measured runtime ledger (quest_tpu/obs/ledger.py); the code
+    # string is defined there — the ledger must warn without importing the
+    # analysis package
+    MODEL_DRIFT = "O_MODEL_DRIFT"
     # optimization hints
     ADJACENT_INVERSE_PAIR = "H_ADJACENT_INVERSE_PAIR"
     FUSABLE_1Q_RUN = "H_FUSABLE_1Q_RUN"
@@ -141,6 +145,14 @@ ANALYSIS_MESSAGES = {
         "an angle-perturbed twin failed to share the class's cache entry. "
         "Serving would return wrong amplitudes for EVERY request of the "
         "class.",
+    AnalysisCode.MODEL_DRIFT:
+        "The measured runtime of this compiled program left the planner "
+        "model's calibrated band (wall-clock ratio on calibrated hardware, "
+        "or compiled-HLO collectives beyond the per-event lowering bound): "
+        "scheduling/engine decisions are being made against a model that "
+        "no longer describes this deployment — re-calibrate "
+        "MEASURED_EFFICIENCY or investigate the partitioner "
+        "(docs/OBSERVABILITY.md).",
     AnalysisCode.ADJACENT_INVERSE_PAIR:
         "Adjacent gates on identical wires compose to the identity and can "
         "be cancelled.",
@@ -168,10 +180,12 @@ ANALYSIS_MESSAGES = {
         "Host callback inside a shard_map region: the callback runs "
         "per-shard on every device and serialises the collective schedule.",
     AnalysisCode.IMPORT_TIME_STATE_MUTATION:
-        "Module-import-time mutation of jax.config or global RNG state: "
-        "import order silently changes numerics for every consumer of the "
-        "process.  Only quest_tpu/_compat.py may do this (the single "
-        "allowlisted site).",
+        "Module-import-time mutation of process-global state (jax.config, "
+        "global RNG state, or process hooks like atexit.register): import "
+        "order silently changes behaviour for every consumer of the "
+        "process.  Allowlisted sites only: quest_tpu/_compat.py (the x64 "
+        "default) and quest_tpu/obs/trace.py (the span recorder's "
+        "crash-dump hook).",
 }
 
 

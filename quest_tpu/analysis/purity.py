@@ -24,10 +24,13 @@ Rules
   ``pure_callback`` / ``io_callback`` / ``host_callback`` inside a
   shard_map-decorated function.
 - ``P_IMPORT_TIME_STATE_MUTATION``: module-import-time mutation of
-  ``jax.config`` or global RNG state (``np.random.seed`` /
-  ``random.seed``): import order silently changes numerics process-wide.
-  Only ``quest_tpu/_compat.py`` is allowlisted — the single site where the
-  package-wide x64 default is set.
+  process-global state — ``jax.config``, global RNG state
+  (``np.random.seed`` / ``random.seed``), or process hooks
+  (``atexit.register``): import order silently changes behaviour
+  process-wide.  Allowlisted sites: ``quest_tpu/_compat.py`` (the single
+  place the package-wide x64 default is set) and ``quest_tpu/obs/trace.py``
+  (the span-recorder singleton's crash-dump atexit hook — one process, one
+  trace).
 """
 
 from __future__ import annotations
@@ -42,15 +45,22 @@ _CALLBACK_NAMES = ("callback", "pure_callback", "io_callback", "host_callback")
 _F64_NAMES = ("float64",)
 
 # import-time global-state mutators (calls) and the config objects whose
-# attribute assignment mutates process state
+# attribute assignment mutates process state.  atexit.register is in the
+# list because an import-time exit hook is process-global state installed
+# by import order — exactly the class of side effect this rule exists to
+# keep out of library modules.
 _IMPORT_MUTATOR_CALLS = ("jax.config.update", "config.update",
                          "np.random.seed", "numpy.random.seed",
                          "random.seed", "np.random.set_state",
-                         "numpy.random.set_state")
+                         "numpy.random.set_state", "atexit.register")
 _IMPORT_MUTATOR_TARGETS = ("jax.config", "config")
-# the single module allowed to mutate global config at import time — a
-# full path suffix, so a stray _compat.py elsewhere is NOT exempt
-_IMPORT_MUTATION_ALLOWLIST = ("quest_tpu/_compat.py",)
+# the modules allowed to mutate process state at import time — full path
+# suffixes, so a stray _compat.py elsewhere is NOT exempt: _compat.py (the
+# single site setting the package-wide x64 default) and obs/trace.py (the
+# module-level span-recorder singleton registers its crash-dump atexit
+# hook; one process, one trace — docs/OBSERVABILITY.md)
+_IMPORT_MUTATION_ALLOWLIST = ("quest_tpu/_compat.py",
+                              "quest_tpu/obs/trace.py")
 
 
 def _dotted(node: ast.AST) -> str:

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import _compat
+from . import obs as _obs
 from .ops import apply as _ap
 
 __all__ = ["Circuit", "GateOp", "compile_circuit", "apply_circuit",
@@ -533,14 +534,18 @@ def compile_circuit(circuit: Circuit, donate: bool = False,
         circuit = circuit.schedule(num_devices, overlap=True,
                                    pipeline_chunks=pipeline_chunks)
         return _exec.overlapped_program(circuit, num_devices, donate=donate)
-    if num_devices is not None and num_devices > 1:
-        choice = _planner.select_engine(circuit, num_devices,
-                                        chip or _planner.V5E,
-                                        requested=engine)
-        circuit = circuit.schedule(num_devices)
-    else:
-        choice = _planner.select_engine(circuit, 1, chip or _planner.V5E,
-                                        requested=engine)
+    with _obs.span("circuit.compile", ops=len(circuit.ops),
+                   num_devices=num_devices or 1) as _csp:
+        if num_devices is not None and num_devices > 1:
+            choice = _planner.select_engine(circuit, num_devices,
+                                            chip or _planner.V5E,
+                                            requested=engine)
+            circuit = circuit.schedule(num_devices)
+        else:
+            choice = _planner.select_engine(circuit, 1, chip or _planner.V5E,
+                                            requested=engine)
+        if _csp is not None:
+            _csp.attrs["engine"] = choice["engine"]
     resolved = choice["engine"]
     ops = circuit.key()
     if donate:
@@ -567,10 +572,21 @@ def compile_circuit(circuit: Circuit, donate: bool = False,
         def run(state: jax.Array) -> jax.Array:
             return _run_ops(state, ops)
 
-    run.engine = resolved
-    run.engine_reason = choice["reason"]
-    run.engine_plan = choice["plan"]
-    return run
+    inner = run
+
+    def traced(state: jax.Array) -> jax.Array:
+        # free when tracing is off; an enabled run records a circuit.run
+        # span (and the matching XProf TraceAnnotation) around dispatch
+        if not _obs.tracing_enabled():
+            return inner(state)
+        with _obs.span("circuit.run", engine=resolved,
+                       ops=len(circuit.ops)):
+            return inner(state)
+
+    traced.engine = resolved
+    traced.engine_reason = choice["reason"]
+    traced.engine_plan = choice["plan"]
+    return traced
 
 
 def apply_circuit(qureg, circuit: Circuit) -> None:

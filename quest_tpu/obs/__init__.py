@@ -1,0 +1,42 @@
+"""quest_tpu.obs — the observability layer: spans, ledger, flight recorder.
+
+The reference has no observability surface at all (``reportQuregParams``
+printfs; SURVEY §5 calls real tracing out as a new capability, not a port),
+and the predictive cost models of the scheduler/planner/epoch planner had no
+systematic runtime counterpart: model drift was only caught when someone
+hand-read a bench row.  This package closes that loop, dependency-free:
+
+- ``trace.py``: a thread-safe span recorder with ``request_id`` correlation
+  propagated from the serving front door through cache lookup, schedule
+  search, engine selection, epoch planning and execution; host spans wrap
+  device work in ``jax.profiler.TraceAnnotation`` so they line up with
+  XProf timelines.
+- ``export.py``: Chrome-trace/Perfetto JSON export, a schema validator (the
+  CI gate), and a human ``--trace-report`` view.
+- ``ledger.py``: the model-vs-measured runtime ledger — every compiled run
+  can record the planner's predicted seconds / HBM passes / collective
+  count next to measured wall time and the compiled-HLO collective count,
+  emitting ``O_MODEL_DRIFT`` when measurement leaves the calibrated band.
+- ``flight.py``: a bounded ring buffer of recent serve request records
+  (admission, queue wait, batch id, deadline outcome, error code) dumped on
+  ``E_QUEUE_FULL``/crash and exposed via ``--selftest --json``.
+
+See docs/OBSERVABILITY.md.
+"""
+
+from .trace import (Span, TraceRecorder, collect_notes, current_request_id,  # noqa: F401
+                    disable_tracing, emit_span, enable_tracing, key_hash,
+                    note, obs_snapshot, recorder, request, reset_tracing,
+                    span, tracing_enabled)
+from .ledger import DriftRecord, Ledger, global_ledger  # noqa: F401
+from .flight import FlightRecord, FlightRecorder  # noqa: F401
+from .export import chrome_trace, trace_report, validate_chrome_trace  # noqa: F401
+
+__all__ = [
+    "Span", "TraceRecorder", "recorder", "span", "emit_span", "request",
+    "current_request_id", "note", "collect_notes", "enable_tracing",
+    "disable_tracing", "reset_tracing", "tracing_enabled", "obs_snapshot",
+    "Ledger", "DriftRecord", "global_ledger",
+    "FlightRecorder", "FlightRecord",
+    "chrome_trace", "trace_report", "validate_chrome_trace",
+]

@@ -1,0 +1,159 @@
+"""Trace export: Chrome-trace/Perfetto JSON, schema validation, human view.
+
+``chrome_trace`` renders the recorder's spans in the Trace Event Format
+(the ``chrome://tracing`` / Perfetto "complete event" shape: ``ph: "X"``
+with microsecond ``ts``/``dur``), one ``tid`` lane per recording thread, so
+a serve run opens directly in Perfetto next to an XProf capture of the same
+process (the spans wrapped device work in ``jax.profiler.TraceAnnotation``
+under the same names).
+
+``validate_chrome_trace`` is the CI gate's schema check
+(docs/OBSERVABILITY.md; ci.yml ``obs-selftest``): every event well-formed,
+every ``parent_id`` resolving to a present span (zero orphans), and every
+serve execution span (``serve.request``) carrying its ``request_id``,
+``class_key``, ``engine`` and ``cache`` outcome — the correlation contract
+that makes a trace navigable from any request.
+
+``trace_report`` is the human view behind ``python -m quest_tpu.analysis
+--trace-report``: spans grouped per request and aggregated per name.
+"""
+
+from __future__ import annotations
+
+from .trace import Span, TraceRecorder, recorder as _recorder
+
+__all__ = ["chrome_trace", "validate_chrome_trace", "trace_report",
+           "EXECUTION_SPAN", "EXECUTION_SPAN_ATTRS"]
+
+#: the serving layer's per-request execution span name (serve/service.py)
+EXECUTION_SPAN = "serve.request"
+#: attributes every execution span must carry (the acceptance contract)
+EXECUTION_SPAN_ATTRS = ("class_key", "engine", "cache")
+
+
+def chrome_trace(spans: list[Span] | None = None,
+                 recorder: TraceRecorder | None = None) -> dict:
+    """Trace Event Format document for ``spans`` (default: the process
+    recorder's).  Timestamps are microseconds relative to the recorder's
+    trace origin; ``args`` carries span/parent/request ids plus every
+    structured attribute."""
+    rec = recorder if recorder is not None else _recorder()
+    if spans is None:
+        spans = rec.spans()
+    tids = {}
+    events = []
+    for sp in spans:
+        tid = tids.setdefault(sp.thread, len(tids) + 1)
+        args = {"span_id": sp.span_id, "parent_id": sp.parent_id,
+                "request_id": sp.request_id}
+        args.update(sp.attrs)
+        events.append({
+            "name": sp.name, "ph": "X", "pid": 1, "tid": tid,
+            "ts": (sp.t0 - rec.t0_perf) * 1e6,
+            "dur": sp.dur * 1e6,
+            "args": args,
+        })
+    meta = [{"name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+             "args": {"name": thread}} for thread, tid in tids.items()]
+    return {"displayTimeUnit": "ms",
+            "otherData": {"origin_epoch_s": rec.t0_epoch,
+                          "dropped_spans": rec.snapshot()["dropped"]},
+            "traceEvents": meta + events}
+
+
+def validate_chrome_trace(doc: dict) -> list[str]:
+    """Schema-check an exported document; returns the list of problems
+    (empty = valid).  Checked: every complete event carries name/ts/dur and
+    a ``span_id``; span ids are unique; every non-None ``parent_id``
+    resolves to a present span (zero orphans); every ``serve.request``
+    event carries a ``request_id`` and the EXECUTION_SPAN_ATTRS."""
+    problems: list[str] = []
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return ["no traceEvents array"]
+    complete = [e for e in events if e.get("ph") == "X"]
+    ids: set = set()
+    for i, e in enumerate(complete):
+        for field in ("name", "ts", "dur", "pid", "tid"):
+            if field not in e:
+                problems.append(f"event {i} missing {field!r}")
+        args = e.get("args") or {}
+        sid = args.get("span_id")
+        if sid is None:
+            problems.append(f"event {i} ({e.get('name')}) has no span_id")
+            continue
+        if sid in ids:
+            problems.append(f"duplicate span_id {sid}")
+        ids.add(sid)
+    for e in complete:
+        args = e.get("args") or {}
+        parent = args.get("parent_id")
+        if parent is not None and parent not in ids:
+            problems.append(
+                f"span {args.get('span_id')} ({e.get('name')}) is an "
+                f"orphan: parent_id {parent} not in this trace")
+        if e.get("name") == EXECUTION_SPAN:
+            if args.get("request_id") is None:
+                problems.append(
+                    f"execution span {args.get('span_id')} has no "
+                    "request_id")
+            for attr in EXECUTION_SPAN_ATTRS:
+                if args.get(attr) in (None, ""):
+                    problems.append(
+                        f"execution span {args.get('span_id')} missing "
+                        f"attr {attr!r}")
+    return problems
+
+
+def _fmt_s(seconds: float) -> str:
+    if seconds >= 1.0:
+        return f"{seconds:.3f}s"
+    if seconds >= 1e-3:
+        return f"{seconds * 1e3:.2f}ms"
+    return f"{seconds * 1e6:.0f}us"
+
+
+def trace_report(spans: list[Span] | None = None,
+                 recorder: TraceRecorder | None = None) -> str:
+    """Human summary: per-name aggregates, then per-request span trees
+    (children indented under their parents, durations inline)."""
+    rec = recorder if recorder is not None else _recorder()
+    if spans is None:
+        spans = rec.spans()
+    if not spans:
+        return "trace: no spans recorded (tracing disabled?)"
+    lines = [f"trace: {len(spans)} span(s)"]
+    agg: dict = {}
+    for sp in spans:
+        count, total = agg.get(sp.name, (0, 0.0))
+        agg[sp.name] = (count + 1, total + sp.dur)
+    lines.append("by span name:")
+    for name in sorted(agg, key=lambda k: -agg[k][1]):
+        count, total = agg[name]
+        lines.append(f"  {name:<28} x{count:<5} total {_fmt_s(total)}")
+    by_request: dict = {}
+    for sp in spans:
+        by_request.setdefault(sp.request_id, []).append(sp)
+    children: dict = {}
+    for sp in spans:
+        children.setdefault(sp.parent_id, []).append(sp)
+
+    def emit(sp: Span, depth: int, group_ids: set) -> None:
+        attrs = " ".join(f"{k}={v}" for k, v in sorted(sp.attrs.items()))
+        lines.append(f"  {'  ' * depth}{sp.name} {_fmt_s(sp.dur)}"
+                     + (f"  [{attrs}]" if attrs else ""))
+        for child in sorted(children.get(sp.span_id, ()),
+                            key=lambda s: s.t0):
+            if child.span_id in group_ids:  # stay inside this request's tree
+                emit(child, depth + 1, group_ids)
+
+    for rid in sorted(by_request, key=lambda r: (r is None, r)):
+        group = by_request[rid]
+        group_ids = {sp.span_id for sp in group}
+        span_time = sum(sp.dur for sp in group)
+        label = "unattributed" if rid is None else f"request {rid}"
+        lines.append(f"{label}: {len(group)} span(s), {_fmt_s(span_time)}")
+        for sp in sorted(group, key=lambda s: s.t0):
+            if sp.parent_id is None or sp.parent_id not in group_ids:
+                emit(sp, 1, group_ids)
+    return "\n".join(lines)

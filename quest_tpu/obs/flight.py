@@ -1,0 +1,128 @@
+"""Serve flight recorder: a bounded ring of recent request records.
+
+Metrics aggregates (serve/metrics.py) answer "how is the service doing";
+they cannot answer "what were the last 256 requests doing when the queue
+filled".  The flight recorder keeps exactly that: one fixed-size record per
+request — admission time, queue depth at admission, batch id, queue wait,
+execution time, and the terminal outcome (``ok`` | ``deadline`` |
+``queue_full`` | ``cancelled`` | ``error:<type>``) — in a ring buffer whose
+memory never grows with traffic.
+
+The service dumps the ring on ``E_QUEUE_FULL`` and on a worker-side
+execution error (the two "something is wrong NOW" moments), keeps the last
+dump for post-mortems, and exposes both the live ring and the last dump
+through ``python -m quest_tpu.serve --selftest --json`` (the
+``flight_recorder`` document key; docs/OBSERVABILITY.md has the format).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+
+__all__ = ["FlightRecord", "FlightRecorder", "DEFAULT_CAPACITY"]
+
+DEFAULT_CAPACITY = 256
+
+
+@dataclasses.dataclass
+class FlightRecord:
+    """One request's flight: times are ``time.time()`` epoch seconds so
+    dumps correlate across processes; ``wait_s``/``exec_s`` are filled when
+    the request reaches a batch."""
+    request_id: int
+    class_key: str
+    enqueue_t: float
+    queue_depth: int
+    deadline_ms: float | None = None
+    admitted: bool = True
+    batch_id: int | None = None
+    wait_s: float | None = None
+    exec_s: float | None = None
+    outcome: str = "pending"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class FlightRecorder:
+    """Thread-safe ring of :class:`FlightRecord`.  ``capacity`` bounds both
+    memory and dump size; old records fall off the far end."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._by_rid: dict = {}
+        self.last_dump: dict | None = None
+        self.dumps = 0
+
+    # -- recording ----------------------------------------------------------
+    def admit(self, request_id: int, class_key: str, queue_depth: int,
+              deadline_ms: float | None = None) -> FlightRecord:
+        rec = FlightRecord(request_id, class_key, time.time(), queue_depth,
+                           deadline_ms)
+        with self._lock:
+            if len(self._ring) == self.capacity:
+                old = self._ring[0]
+                self._by_rid.pop(old.request_id, None)
+            self._ring.append(rec)
+            self._by_rid[request_id] = rec
+        return rec
+
+    def reject(self, request_id: int, class_key: str,
+               queue_depth: int) -> FlightRecord:
+        """Record a request bounced at admission (``E_QUEUE_FULL``).  The
+        serving layer passes a distinct NEGATIVE id here — a bounced
+        request never had a real request id, and a synthetic positive one
+        could alias (and later mis-resolve) an admitted request."""
+        rec = self.admit(request_id, class_key, queue_depth)
+        rec.admitted = False
+        rec.outcome = "queue_full"
+        return rec
+
+    def resolve(self, request_id: int, outcome: str, *,
+                batch_id: int | None = None, wait_s: float | None = None,
+                exec_s: float | None = None) -> None:
+        """Fill a record's terminal fields; unknown ids (already rung out)
+        are ignored — the ring is best-effort recent history, not a
+        database."""
+        with self._lock:
+            rec = self._by_rid.get(request_id)
+            if rec is None:
+                return
+            rec.outcome = outcome
+            if batch_id is not None:
+                rec.batch_id = batch_id
+            if wait_s is not None:
+                rec.wait_s = wait_s
+            if exec_s is not None:
+                rec.exec_s = exec_s
+
+    # -- reading ------------------------------------------------------------
+    def records(self) -> list[FlightRecord]:
+        with self._lock:
+            return list(self._ring)
+
+    def dump(self, reason: str) -> dict:
+        """Snapshot the ring (oldest first) with a reason tag; kept as
+        ``last_dump`` and returned for immediate logging."""
+        with self._lock:
+            doc = {"reason": reason, "time": time.time(),
+                   "capacity": self.capacity,
+                   "records": [r.as_dict() for r in self._ring]}
+            self.last_dump = doc
+            self.dumps += 1
+        return doc
+
+    def snapshot(self) -> dict:
+        """The ``--selftest --json`` payload: the live ring plus the last
+        dump (if any)."""
+        with self._lock:
+            return {"capacity": self.capacity,
+                    "depth": len(self._ring),
+                    "dumps": self.dumps,
+                    "records": [r.as_dict() for r in self._ring],
+                    "last_dump": self.last_dump}

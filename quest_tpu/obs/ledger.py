@@ -1,0 +1,181 @@
+"""Model-vs-measured runtime ledger: the drift detector over the planner.
+
+PRs 2/4/6 each added a *predictive* cost model — comm events and bytes
+(planner.comm_summary), hideable fractions (executor.predict_overlap),
+fused HBM passes (epoch_pallas.plan_circuit) — and the bench rows record
+model next to measurement, but nothing systematically compared them at
+runtime: model drift was caught only when someone hand-read a BENCH row.
+The ledger is that comparison as a pipeline: every compiled run can record
+one :class:`DriftRecord` holding the planner's predicted seconds / HBM
+passes / collective count next to the measured wall time and the
+compiled-HLO collective count (analysis/jaxpr_audit.py's observation), and
+the ledger emits an ``O_MODEL_DRIFT`` warning when measurement leaves the
+calibrated band.
+
+Two drift rules, deliberately asymmetric:
+
+- **Collective counts** are platform-independent (the partitioner emits the
+  same HLO on the CPU mesh CI uses and the TPU pod production uses), so
+  they are checked everywhere: more than ``COLLECTIVES_PER_EVENT`` compiled
+  collectives per predicted comm event — the same factor bound
+  analysis/jaxpr_audit.py gates on — or ANY measured collective on a run
+  predicted comm-free is drift.
+- **Wall time** is only checked against the model when the run executed on
+  the hardware the model describes (``platform == "tpu"``, or
+  ``calibrated=True`` for explicitly calibrated setups): the time model is
+  a TPU roofline at MEASURED_EFFICIENCY, and comparing it to a CPU wall
+  clock would flag every CI run.  The default band is
+  :data:`DEFAULT_WALL_BAND` — measured/predicted within [1/3, 3] — the
+  spread of the BENCH rows the efficiencies were calibrated from
+  (BENCH_r04/r05 hbm_peak_frac 0.20-0.31 vs the 0.26-0.29 constants).
+
+This is what turns ``MEASURED_EFFICIENCY`` calibration (ROADMAP item 2)
+from a one-off into a pipeline: a chip run that drifts out of band is a
+signal to re-measure the efficiency constant, caught by CI instead of by a
+human.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import warnings
+
+__all__ = ["DriftRecord", "Ledger", "global_ledger", "MODEL_DRIFT",
+           "DEFAULT_WALL_BAND", "COLLECTIVES_PER_EVENT"]
+
+#: the diagnostic code drift findings carry (analysis CLI severity: WARNING)
+MODEL_DRIFT = "O_MODEL_DRIFT"
+
+#: measured/predicted wall-clock band considered "the model holds";
+#: calibrated from the BENCH rows MEASURED_EFFICIENCY was fit on
+DEFAULT_WALL_BAND = (1.0 / 3.0, 3.0)
+
+#: how many compiled-HLO collectives one predicted comm event may
+#: legitimately lower to — mirrors analysis/jaxpr_audit._HLO_OPS_PER_EVENT
+#: (a pairwise exchange spells as all-gather + all-reduce partial-sum pairs
+#: per SoA plane); kept as a local constant so obs stays dependency-free
+COLLECTIVES_PER_EVENT = 6
+
+#: ledger retention: FIFO beyond this (long-running serve processes must
+#: not grow the ledger without bound)
+_MAX_RECORDS = 1024
+
+
+@dataclasses.dataclass
+class DriftRecord:
+    """One run's model-vs-measured row.  ``findings`` is empty when the
+    measurement sits inside every applicable band."""
+    label: str
+    engine: str
+    num_devices: int
+    platform: str
+    predicted_seconds: float | None = None
+    measured_seconds: float | None = None
+    predicted_hbm_passes: int | None = None
+    predicted_collectives: int | None = None
+    measured_hlo_collectives: int | None = None
+    wall_ratio: float | None = None          # measured / predicted
+    wall_checked: bool = False
+    findings: tuple = ()
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Ledger:
+    """Thread-safe store of :class:`DriftRecord`; :meth:`record` computes
+    the drift checks and warns (``O_MODEL_DRIFT``) on any finding."""
+
+    def __init__(self, wall_band: tuple = DEFAULT_WALL_BAND,
+                 collectives_per_event: int = COLLECTIVES_PER_EVENT):
+        self.wall_band = (float(wall_band[0]), float(wall_band[1]))
+        self.collectives_per_event = int(collectives_per_event)
+        self._lock = threading.Lock()
+        self._records: list[DriftRecord] = []
+        self.drift_total = 0
+
+    def record(self, label: str, *, engine: str = "xla",
+               num_devices: int = 1, platform: str = "cpu",
+               predicted_seconds: float | None = None,
+               measured_seconds: float | None = None,
+               predicted_hbm_passes: int | None = None,
+               predicted_collectives: int | None = None,
+               measured_hlo_collectives: int | None = None,
+               calibrated: bool = False, warn: bool = True) -> DriftRecord:
+        """Record one run.  Pass whatever the caller has — every check only
+        fires when both of its sides are present.  ``calibrated=True``
+        opts a non-TPU run into the wall-band check (a setup whose
+        efficiency constants have been measured on that platform)."""
+        findings: list[str] = []
+        wall_ratio = None
+        wall_checked = False
+        if predicted_seconds and measured_seconds is not None:
+            wall_ratio = measured_seconds / predicted_seconds
+            wall_checked = calibrated or platform == "tpu"
+            lo, hi = self.wall_band
+            if wall_checked and not lo <= wall_ratio <= hi:
+                findings.append(
+                    f"wall {measured_seconds:.3g}s is {wall_ratio:.2f}x the "
+                    f"model's {predicted_seconds:.3g}s (band [{lo:.2f}, "
+                    f"{hi:.2f}]): re-calibrate MEASURED_EFFICIENCY for "
+                    f"engine {engine!r}")
+        if (predicted_collectives is not None
+                and measured_hlo_collectives is not None):
+            bound = predicted_collectives * self.collectives_per_event
+            if predicted_collectives == 0 and measured_hlo_collectives > 0:
+                findings.append(
+                    f"{measured_hlo_collectives} compiled collectives on a "
+                    "run modeled comm-free: a sharding annotation was lost")
+            elif measured_hlo_collectives > bound:
+                findings.append(
+                    f"{measured_hlo_collectives} compiled collectives vs "
+                    f"{predicted_collectives} predicted events (bound "
+                    f"{bound}): the comm model undercosts this circuit")
+        rec = DriftRecord(label, engine, num_devices, platform,
+                          predicted_seconds, measured_seconds,
+                          predicted_hbm_passes, predicted_collectives,
+                          measured_hlo_collectives, wall_ratio, wall_checked,
+                          tuple(findings))
+        with self._lock:
+            self._records.append(rec)
+            if len(self._records) > _MAX_RECORDS:
+                del self._records[:_MAX_RECORDS // 2]
+            self.drift_total += len(findings)
+        if warn:
+            for f in findings:
+                warnings.warn(f"{MODEL_DRIFT}[{label}] {f}", RuntimeWarning,
+                              stacklevel=2)
+        return rec
+
+    # -- reading ------------------------------------------------------------
+    def records(self) -> list[DriftRecord]:
+        with self._lock:
+            return list(self._records)
+
+    def as_dicts(self) -> list[dict]:
+        return [r.as_dict() for r in self.records()]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"records": len(self._records),
+                    "drift_total": self.drift_total}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records = []
+            self.drift_total = 0
+
+
+_GLOBAL: Ledger | None = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def global_ledger() -> Ledger:
+    """The process-wide ledger (bench rows, the serve layer and the
+    ``--trace-report`` CLI all record into one place)."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = Ledger()
+        return _GLOBAL

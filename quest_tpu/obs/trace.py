@@ -1,0 +1,345 @@
+"""Thread-safe span recorder with request-id correlation.
+
+A :class:`Span` is one timed host-side region (cache lookup, schedule
+search, engine selection, batch execution ...) with structured attributes
+and two correlation links: ``parent_id`` (the enclosing span, carried by a
+``contextvars.ContextVar`` so nesting works across call boundaries inside
+one thread/context) and ``request_id`` (set by the serving layer at the
+front door via :func:`request` and inherited by every span recorded while
+that context is live — the propagation contract of
+docs/OBSERVABILITY.md).
+
+Two properties are load-bearing:
+
+- **Disabled tracing is free.**  ``span()`` with the recorder disabled
+  returns one shared no-op context manager — no allocation, no lock, no
+  clock read — so the serving hot path can be instrumented unconditionally
+  (the ``serve_vqe_16q_batch64`` overhead contract: < 1% wall, asserted in
+  tests/test_obs.py).
+- **Spans line up with device timelines.**  An enabled span enters a
+  ``jax.profiler.TraceAnnotation`` of the same name, so an XProf capture of
+  the same run shows the host spans as named regions above the device
+  lanes.
+
+The module-level recorder singleton (``_RECORDER``) is created at import —
+one process, one trace — and registers an ``atexit`` dump hook so a crash
+still leaves a readable trace when ``QUEST_TPU_TRACE_DUMP`` names a file.
+Import-time process-state mutation is exactly what the purity lint's
+``P_IMPORT_TIME_STATE_MUTATION`` rule exists to flag; this module is the
+one allowlisted observability site (analysis/purity.py), the same contract
+``_compat.py`` has for the x64 default.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import contextvars
+import dataclasses
+import os
+import threading
+import time
+
+__all__ = ["Span", "TraceRecorder", "recorder", "span", "emit_span",
+           "request", "current_request_id", "note", "collect_notes",
+           "enable_tracing", "disable_tracing", "reset_tracing",
+           "tracing_enabled", "obs_snapshot", "key_hash"]
+
+#: recorder capacity default: large enough that no CI/selftest workload
+#: ever overflows.  Beyond it NEW spans are dropped (counted) — except
+#: spans some recorded child already references as parent, which are
+#: admitted so the export never carries a dangling parent_id (the
+#: validator treats an orphan as a hard problem)
+DEFAULT_MAX_SPANS = 1 << 18
+
+_PARENT: contextvars.ContextVar = contextvars.ContextVar(
+    "quest_obs_parent", default=None)
+_REQUEST: contextvars.ContextVar = contextvars.ContextVar(
+    "quest_obs_request", default=None)
+_NOTES: contextvars.ContextVar = contextvars.ContextVar(
+    "quest_obs_notes", default=None)
+
+
+@dataclasses.dataclass
+class Span:
+    """One recorded host region.  ``t0`` is seconds on the recorder's
+    ``perf_counter`` clock (``TraceRecorder.t0_perf`` is the trace
+    origin); ``attrs`` carries the structured payload (class key, engine,
+    cache outcome, pass count, comm bytes ...)."""
+    name: str
+    span_id: int
+    parent_id: int | None
+    request_id: int | None
+    t0: float
+    dur: float
+    thread: str
+    attrs: dict
+
+
+class _NoopSpan:
+    """The disabled-path context manager: one shared instance, no state."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class _LiveSpan:
+    """Context manager recording one span on exit.  Yields the open
+    :class:`Span` so callers can set attributes mid-flight
+    (``sp.attrs["engine"] = resolved``)."""
+    __slots__ = ("_rec", "_span", "_token", "_ann")
+
+    def __init__(self, rec: "TraceRecorder", name: str, attrs: dict):
+        self._rec = rec
+        self._span = Span(name, rec._next_id(), _PARENT.get(),
+                          _REQUEST.get(), 0.0, 0.0,
+                          threading.current_thread().name, attrs)
+        self._token = None
+        self._ann = None
+
+    def __enter__(self) -> Span:
+        self._token = _PARENT.set(self._span.span_id)
+        try:
+            import jax
+            self._ann = jax.profiler.TraceAnnotation(self._span.name)
+            self._ann.__enter__()
+        except Exception:       # profiler unavailable: spans still record
+            self._ann = None
+        self._span.t0 = time.perf_counter()
+        return self._span
+
+    def __exit__(self, *exc):
+        self._span.dur = time.perf_counter() - self._span.t0
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+        _PARENT.reset(self._token)
+        self._rec._append(self._span)
+        return False
+
+
+class TraceRecorder:
+    """Bounded, thread-safe span store.  Disabled by default; spans beyond
+    ``max_spans`` are counted as dropped rather than evicting older ones
+    (see DEFAULT_MAX_SPANS)."""
+
+    def __init__(self, max_spans: int = DEFAULT_MAX_SPANS,
+                 enabled: bool = False):
+        self.max_spans = int(max_spans)
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._spans: list[Span] = []
+        self._referenced: set = set()   # parent ids of recorded spans
+        self._present: set = set()      # ids of recorded spans
+        self._dropped = 0
+        self._ids = 0
+        self.t0_perf = time.perf_counter()
+        self.t0_epoch = time.time()
+
+    # -- recording ----------------------------------------------------------
+    def span(self, name: str, **attrs):
+        """Context manager timing one region; no-op (and allocation-free)
+        while the recorder is disabled."""
+        if not self.enabled:
+            return _NOOP
+        return _LiveSpan(self, name, attrs)
+
+    def emit(self, name: str, *, t0: float, dur: float,
+             parent_id: int | None = None, request_id: int | None = None,
+             **attrs) -> int | None:
+        """Record a span retroactively from explicit ``perf_counter``
+        timestamps — the serving layer's per-request execution spans are
+        emitted after the shared batch completes.  Returns the span id.
+
+        An explicit ``parent_id`` must name an already-RECORDED span; if
+        that parent was dropped at the capacity bound the span is recorded
+        as a root instead, so the export never carries a dangling
+        parent_id."""
+        if not self.enabled:
+            return None
+        if parent_id is None:
+            parent_id = _PARENT.get()
+        elif parent_id not in self._present:
+            parent_id = None
+        sp = Span(name, self._next_id(), parent_id,
+                  request_id if request_id is not None else _REQUEST.get(),
+                  t0, dur, threading.current_thread().name, attrs)
+        self._append(sp)
+        return sp.span_id
+
+    def _next_id(self) -> int:
+        with self._lock:
+            self._ids += 1
+            return self._ids
+
+    def _append(self, sp: Span) -> None:
+        # Spans append on EXIT, children before parents — so a full buffer
+        # must still admit a span some recorded child already references as
+        # parent, or the export would carry a dangling parent_id (the
+        # orphan the validator hard-fails on).  The overshoot is bounded by
+        # open-span nesting depth x threads, not by traffic.
+        with self._lock:
+            if (len(self._spans) >= self.max_spans
+                    and sp.span_id not in self._referenced):
+                self._dropped += 1
+                return
+            self._spans.append(sp)
+            self._present.add(sp.span_id)
+            if sp.parent_id is not None:
+                self._referenced.add(sp.parent_id)
+
+    # -- lifecycle ----------------------------------------------------------
+    def enable(self) -> "TraceRecorder":
+        self.enabled = True
+        return self
+
+    def disable(self) -> "TraceRecorder":
+        self.enabled = False
+        return self
+
+    def reset(self) -> "TraceRecorder":
+        with self._lock:
+            self._spans = []
+            self._referenced = set()
+            self._present = set()
+            self._dropped = 0
+            self._ids = 0
+            self.t0_perf = time.perf_counter()
+            self.t0_epoch = time.time()
+        return self
+
+    # -- reading ------------------------------------------------------------
+    def spans(self) -> list[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"enabled": int(self.enabled),
+                    "spans": len(self._spans),
+                    "dropped": self._dropped}
+
+
+# ---------------------------------------------------------------------------
+# module-level singleton + convenience API
+# ---------------------------------------------------------------------------
+
+_RECORDER = TraceRecorder(
+    enabled=os.environ.get("QUEST_TPU_TRACE") == "1")
+
+
+def recorder() -> TraceRecorder:
+    """The process-wide recorder (one process, one trace)."""
+    return _RECORDER
+
+
+def span(name: str, **attrs):
+    """``with span("cache.lookup", outcome="hit") as sp: ...`` on the
+    process recorder; free while tracing is disabled."""
+    return _RECORDER.span(name, **attrs)
+
+
+def emit_span(name: str, *, t0: float, dur: float,
+              parent_id: int | None = None, request_id: int | None = None,
+              **attrs) -> int | None:
+    return _RECORDER.emit(name, t0=t0, dur=dur, parent_id=parent_id,
+                          request_id=request_id, **attrs)
+
+
+def enable_tracing(max_spans: int | None = None) -> TraceRecorder:
+    if max_spans is not None:
+        _RECORDER.max_spans = int(max_spans)
+    return _RECORDER.enable()
+
+
+def disable_tracing() -> TraceRecorder:
+    return _RECORDER.disable()
+
+
+def reset_tracing() -> TraceRecorder:
+    return _RECORDER.reset()
+
+
+def tracing_enabled() -> bool:
+    return _RECORDER.enabled
+
+
+def obs_snapshot() -> dict:
+    """Tracing + ledger counters for the shared metrics registry (the
+    serve Prometheus scrape re-exports these as ``obs_*`` gauges)."""
+    from .ledger import global_ledger
+    snap = _RECORDER.snapshot()
+    led = global_ledger().snapshot()
+    return {"trace_enabled": snap["enabled"],
+            "trace_spans": snap["spans"],
+            "trace_dropped": snap["dropped"],
+            "ledger_records": led["records"],
+            "ledger_drift_total": led["drift_total"]}
+
+
+@contextlib.contextmanager
+def request(request_id: int | None):
+    """Bind a request id to the current context: every span recorded while
+    inside inherits it — the serving layer's correlation contract."""
+    token = _REQUEST.set(request_id)
+    try:
+        yield
+    finally:
+        _REQUEST.reset(token)
+
+
+def current_request_id() -> int | None:
+    return _REQUEST.get()
+
+
+def note(key: str, value) -> None:
+    """Attach an out-of-band observation to the nearest enclosing
+    :func:`collect_notes` scope (e.g. the cache reports hit/miss to the
+    service without widening its return type).  No-op outside a scope."""
+    notes = _NOTES.get()
+    if notes is not None:
+        notes[key] = value
+
+
+@contextlib.contextmanager
+def collect_notes():
+    """``with collect_notes() as notes: ...`` — collects every
+    :func:`note` recorded by callees into ``notes`` (a dict)."""
+    notes: dict = {}
+    token = _NOTES.set(notes)
+    try:
+        yield notes
+    finally:
+        _NOTES.reset(token)
+
+
+def key_hash(obj) -> str:
+    """Short stable-within-process correlation tag for a hashable key
+    (structural class keys are long tuples; traces want a label)."""
+    return f"{hash(obj) & 0xFFFFFFFFFFFF:012x}"
+
+
+def _dump_at_exit() -> None:
+    """Write the Chrome-trace JSON to ``QUEST_TPU_TRACE_DUMP`` at process
+    exit (crash included, as long as the interpreter unwinds) so a dead
+    serve process still leaves its trace behind."""
+    path = os.environ.get("QUEST_TPU_TRACE_DUMP")
+    if not path or not _RECORDER.spans():
+        return
+    import json
+
+    from .export import chrome_trace
+    try:
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(chrome_trace(recorder=_RECORDER), fh)
+    except OSError:
+        pass
+
+
+atexit.register(_dump_at_exit)

@@ -64,6 +64,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 
 from .. import _compat
+from .. import obs as _obs
 
 from .pallas_layer import (LANE, SUB, _fiber_group, _interpret, _shape3,
                            _state_spec)
@@ -327,7 +328,19 @@ def epoch_supported(num_qubits: int, precision: int = 1) -> bool:
 def plan_circuit(ops: tuple, num_qubits: int) -> EnginePlan:
     """Lower an op tuple (logical wires) into the epoch executor's static
     plan: engine segments, fused passes, and the deferred residual
-    permutation.  Pure host work, cached per (ops, n)."""
+    permutation.  Pure host work, cached per (ops, n); a cache miss records
+    an ``epoch.plan`` span (tracing on) with the lowering's pass counts."""
+    with _obs.span("epoch.plan", ops=len(ops), num_qubits=num_qubits) as sp:
+        plan = _plan_circuit_impl(ops, num_qubits)
+        if sp is not None:
+            sp.attrs["hbm_passes"] = plan.hbm_passes
+            sp.attrs["pallas_passes"] = plan.pallas_passes
+            sp.attrs["xla_ops"] = plan.xla_ops
+            sp.attrs["deferred_ops"] = plan.deferred_ops
+        return plan
+
+
+def _plan_circuit_impl(ops: tuple, num_qubits: int) -> EnginePlan:
     n = num_qubits
     if not MIN_QUBITS <= n <= MAX_QUBITS:
         raise ValueError(

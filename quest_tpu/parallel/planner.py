@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import dataclasses
 
+from .. import obs as _obs
+
 
 def local_qubit_count(num_qubits: int, num_devices: int) -> int:
     """Number of shard-local qubits of an ``num_devices``-way amplitude mesh:
@@ -435,6 +437,24 @@ def engine_time_model(circuit, chip: ChipSpec = V5E, precision: int = 1,
 def select_engine(circuit, num_devices: int | None = None,
                   chip: ChipSpec = V5E, precision: int = 1,
                   requested: str = "auto", backend: str | None = None) -> dict:
+    """Resolve the compiled-circuit engine for a deployment.  The decision
+    is recorded as a ``planner.select_engine`` span (engine + reason) when
+    tracing is on — see :func:`_select_engine_impl` for the rules.
+    """
+    with _obs.span("planner.select_engine", requested=requested,
+                   num_devices=num_devices or 1) as sp:
+        choice = _select_engine_impl(circuit, num_devices, chip, precision,
+                                     requested, backend)
+        if sp is not None:
+            sp.attrs["engine"] = choice["engine"]
+            sp.attrs["reason"] = choice["reason"]
+        return choice
+
+
+def _select_engine_impl(circuit, num_devices: int | None = None,
+                        chip: ChipSpec = V5E, precision: int = 1,
+                        requested: str = "auto",
+                        backend: str | None = None) -> dict:
     """Resolve the compiled-circuit engine for a deployment.
 
     Returns ``{"engine", "reason", "model", "plan"}`` with ``engine`` in

@@ -58,6 +58,7 @@ from __future__ import annotations
 import bisect
 import dataclasses
 
+from .. import obs as _obs
 from . import planner as _planner
 
 __all__ = ["commutation_dag", "reorder_ops", "schedule", "schedule_savings",
@@ -617,22 +618,28 @@ def schedule(circuit, num_devices: int, *, chip=None, precision: int = 1,
         pipeline_chunks = _exec.validate_pipeline_chunks(pipeline_chunks,
                                                          "schedule")
     n = circuit.num_qubits
-    ops = list(circuit.ops)
-    if reorder and num_devices > 1:
-        ops = reorder_ops(ops, n, num_devices)
-    staged = Circuit(n)
-    staged.ops = ops
-    if placement and num_devices > 1:
-        sigma = greedy_placement(staged, num_devices, chip, precision)
-        staged = apply_placement(staged, sigma, num_devices)
-        ops = staged.ops
-    ops = _fuse_swap_runs(ops, n, num_devices)
-    ops = _lower_epochs(ops, n, num_devices)
-    out = Circuit(n)
-    out.ops = ops
-    if overlap:
-        out._overlap_plan = _exec.plan_overlap(out, num_devices,
-                                               pipeline_chunks)
+    with _obs.span("scheduler.schedule", num_devices=num_devices,
+                   ops_in=len(circuit.ops), overlap=bool(overlap)) as sp:
+        ops = list(circuit.ops)
+        if reorder and num_devices > 1:
+            ops = reorder_ops(ops, n, num_devices)
+        staged = Circuit(n)
+        staged.ops = ops
+        if placement and num_devices > 1:
+            sigma = greedy_placement(staged, num_devices, chip, precision)
+            staged = apply_placement(staged, sigma, num_devices)
+            ops = staged.ops
+        ops = _fuse_swap_runs(ops, n, num_devices)
+        ops = _lower_epochs(ops, n, num_devices)
+        out = Circuit(n)
+        out.ops = ops
+        if overlap:
+            out._overlap_plan = _exec.plan_overlap(out, num_devices,
+                                                   pipeline_chunks)
+        if sp is not None:
+            sp.attrs["ops_out"] = len(ops)
+            sp.attrs["comm_events"] = _planner.comm_summary(
+                out, num_devices)["comm_events"]
     if os.environ.get("QUEST_TPU_VALIDATE_SCHEDULE") == "1":
         from ..analysis.diagnostics import Severity
         from ..analysis.equivalence import check_equivalence

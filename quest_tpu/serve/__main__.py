@@ -28,12 +28,18 @@ def main(argv=None) -> int:
                              "(default 1: 64 single-device requests)")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="emit ONE machine-readable JSON document")
+    parser.add_argument("--trace", action="store_true",
+                        help="record the run through the span recorder "
+                             "(quest_tpu/obs) and export/validate the "
+                             "Chrome-trace JSON; QUEST_TPU_TRACE=1 does "
+                             "the same")
     args = parser.parse_args(argv)
     if not args.selftest:
         parser.print_usage()
         return 2
     from .selftest import run_selftest
-    return run_selftest(as_json=args.as_json, scale=max(1, args.scale))
+    return run_selftest(as_json=args.as_json, scale=max(1, args.scale),
+                        trace=True if args.trace else None)
 
 
 if __name__ == "__main__":

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import circuit as _circ
+from .. import obs as _obs
 
 __all__ = ["CacheOptions", "CacheEntry", "CompileCache", "global_cache",
            "circuit_from_params", "DEFAULT_MAX_BYTES"]
@@ -207,14 +208,22 @@ class CompileCache:
         mesh — and registers a fresh entry."""
         skey = (num_qubits, tuple(_circ.structural_op(op) for op in ops),
                 options)
-        with self._lock:
-            e = self._entries.get(skey)
-            if e is not None:
-                self._entries.move_to_end(skey)
-                self.stats["hits"] += 1
-                return e
-            self.stats["misses"] += 1
-        e = self._build_entry(skey, tuple(ops), num_qubits, options)
+        with _obs.span("cache.lookup", class_key=_obs.key_hash(skey),
+                       engine=options.engine) as sp:
+            with self._lock:
+                e = self._entries.get(skey)
+                if e is not None:
+                    self._entries.move_to_end(skey)
+                    self.stats["hits"] += 1
+                    if sp is not None:
+                        sp.attrs["outcome"] = "hit"
+                    _obs.note("cache_outcome", "hit")
+                    return e
+                self.stats["misses"] += 1
+            if sp is not None:
+                sp.attrs["outcome"] = "miss"
+            _obs.note("cache_outcome", "miss")
+            e = self._build_entry(skey, tuple(ops), num_qubits, options)
         with self._lock:
             have = self._entries.get(skey)
             if have is not None:      # raced with another thread's build
@@ -258,7 +267,9 @@ class CompileCache:
             if p is not None:
                 return p
         t0 = time.perf_counter()
-        call = build()
+        with _obs.span("cache.compile", class_key=_obs.key_hash(entry.skey),
+                       tag=str(tag[0]), engine=entry.options.engine):
+            call = build()
         dt = time.perf_counter() - t0
         nbytes = _compiled_bytes(call)
         with self._lock:

@@ -74,13 +74,26 @@ def _check(checks: dict, name: str, ok: bool, detail: str = "") -> bool:
     return bool(ok)
 
 
-def run_selftest(as_json: bool = False, scale: int = 1) -> int:
+def run_selftest(as_json: bool = False, scale: int = 1,
+                 trace: bool | None = None) -> int:
     """Run the workload through fresh services sharing one fresh cache;
     print metrics (human text, or ONE JSON document with ``--json``).
-    Returns the process exit status: 0 iff every check passed."""
+    Returns the process exit status: 0 iff every check passed.
+
+    ``trace=True`` (or ``QUEST_TPU_TRACE=1``) records the whole run through
+    the span recorder (quest_tpu/obs): the JSON document then carries the
+    exported Chrome-trace under ``"trace"`` and a ``trace_valid`` check
+    gates the export's schema — every execution span linked to its
+    request_id with class key / engine / cache outcome, zero orphans (the
+    ci.yml ``obs-selftest`` contract).  The flight-recorder ring is
+    included under ``"flight_recorder"`` unconditionally — it is always
+    on."""
+    import os
+
     import jax
     import jax.numpy as jnp
 
+    from .. import obs as _obs
     from ..circuit import _run_ops
     from ..ops import measure as _meas
     from ..rng import MT19937
@@ -91,6 +104,12 @@ def run_selftest(as_json: bool = False, scale: int = 1) -> int:
     def echo(line: str) -> None:
         if not as_json:
             print(line)
+
+    if trace is None:
+        trace = os.environ.get("QUEST_TPU_TRACE") == "1"
+    if trace:
+        _obs.enable_tracing()
+        _obs.reset_tracing()
 
     cache = CompileCache()
     checks: dict = {}
@@ -199,11 +218,26 @@ def run_selftest(as_json: bool = False, scale: int = 1) -> int:
         ok &= _check(checks, "prometheus_parses", False, str(exc))
 
     metrics = svc.metrics_dict()
+    flight = svc.flight_recorder.snapshot()
+    trace_doc = None
+    if trace:
+        trace_doc = _obs.chrome_trace()
+        problems = _obs.validate_chrome_trace(trace_doc)
+        exec_spans = [e for e in trace_doc["traceEvents"]
+                      if e.get("name") == "serve.request"]
+        want = len(submitted)
+        ok &= _check(checks, "trace_valid",
+                     not problems and len(exec_spans) >= want,
+                     f"{len(exec_spans)} execution span(s) (need >= {want}),"
+                     f" {len(problems)} schema problem(s)"
+                     + (f"; first: {problems[0]}" if problems else ""))
     svc.shutdown()
     if as_json:
-        print(json.dumps({"ok": bool(ok), "checks": checks,
-                          "metrics": metrics, "prometheus": prom},
-                         default=float))
+        doc = {"ok": bool(ok), "checks": checks, "metrics": metrics,
+               "prometheus": prom, "flight_recorder": flight}
+        if trace_doc is not None:
+            doc["trace"] = trace_doc
+        print(json.dumps(doc, default=float))
     else:
         for name, r in checks.items():
             echo(f"[{'ok' if r['ok'] else 'FAIL'}] {name}: {r['detail']}")
@@ -211,4 +245,7 @@ def run_selftest(as_json: bool = False, scale: int = 1) -> int:
         echo(json.dumps(metrics, indent=1, default=float))
         echo("--- prometheus ---")
         echo(prom)
+        if trace:
+            echo("--- trace ---")
+            echo(_obs.trace_report())
     return 0 if ok else 1

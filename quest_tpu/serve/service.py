@@ -35,6 +35,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import circuit as _circ
+from .. import obs as _obs
+from ..obs.export import EXECUTION_SPAN
+from ..obs.flight import FlightRecorder
 from ..rng import MT19937
 from ..validation import ErrorCode, MESSAGES, QuESTError
 from . import batch as _batch
@@ -89,7 +92,8 @@ class QuESTService:
                  max_queue: int = 1024, seed: int = 0, dtype=None,
                  batch_mode: str = "map",
                  cache: CompileCache | None = None,
-                 metrics: Metrics | None = None, start: bool = True):
+                 metrics: Metrics | None = None,
+                 flight_capacity: int = 256, start: bool = True):
         if batch_mode not in ("map", "vmap"):
             raise ValueError(
                 f"batch_mode must be 'map' or 'vmap', got {batch_mode!r}")
@@ -108,6 +112,11 @@ class QuESTService:
         self.dtype = jnp.float64 if dtype is None else dtype
         self._cache = cache if cache is not None else global_cache()
         self.metrics = metrics if metrics is not None else Metrics()
+        # flight recorder (quest_tpu/obs/flight.py): the bounded ring of
+        # recent request records dumped on E_QUEUE_FULL / execution error
+        self.flight_recorder = FlightRecorder(capacity=flight_capacity)
+        self._batch_seq = 0
+        self._reject_seq = 0
         self._sharding = None
         if num_devices is not None and num_devices > 1:
             from ..parallel.mesh import amp_sharding, make_amps_mesh
@@ -221,22 +230,43 @@ class QuESTService:
         deadline = None if deadline_ms is None else now + float(deadline_ms) / 1000.0
         group_key = (circuit.num_qubits, circuit.key(structural=True),
                      state0 is None)
+        class_key = _obs.key_hash(group_key)
+        t0p = time.perf_counter()
         fut: Future = Future()
         with self._cond:
             if not self._accepting or self._stop:
                 raise RuntimeError("QuESTService is shut down")
             if len(self._queue) >= self.max_queue:
                 self.metrics.inc("queue_rejected_total")
-                raise QuESTError(ErrorCode.QUEUE_FULL,
-                                 MESSAGES[ErrorCode.QUEUE_FULL], "submit")
-            rid = self._next_rid
-            self._next_rid += 1
-            self._queue.append(_Request(rid, ops, circuit.num_qubits, pvec,
-                                        shots, deadline, state0, fut, now,
-                                        group_key))
-            self.metrics.inc("requests_submitted_total")
-            self.metrics.set_gauge("queue_depth", len(self._queue))
-            self._cond.notify_all()
+                depth = len(self._queue)
+                # rejected requests never receive a real request id; the
+                # flight record gets a distinct NEGATIVE id so a bounce can
+                # never alias (or later mis-resolve) an admitted request
+                self._reject_seq += 1
+                rejected_rid = -self._reject_seq
+                rid = None
+            else:
+                rid = self._next_rid
+                self._next_rid += 1
+                self._queue.append(_Request(rid, ops, circuit.num_qubits,
+                                            pvec, shots, deadline, state0,
+                                            fut, now, group_key))
+                depth = len(self._queue)
+                self.metrics.inc("requests_submitted_total")
+                self.metrics.set_gauge("queue_depth", depth)
+                self._cond.notify_all()
+        if rid is None:
+            # backpressure is the flight recorder's headline moment: record
+            # the bounce and dump the ring for the post-mortem
+            self.flight_recorder.reject(rejected_rid, class_key, depth)
+            self.flight_recorder.dump(ErrorCode.QUEUE_FULL)
+            raise QuESTError(ErrorCode.QUEUE_FULL,
+                             MESSAGES[ErrorCode.QUEUE_FULL], "submit")
+        self.flight_recorder.admit(rid, class_key, depth,
+                                   deadline_ms=deadline_ms)
+        _obs.emit_span("serve.submit", t0=t0p, dur=time.perf_counter() - t0p,
+                       request_id=rid, class_key=class_key,
+                       queue_depth=depth)
         return fut
 
     # -- worker -------------------------------------------------------------
@@ -265,9 +295,11 @@ class QuESTService:
                 for req in group:
                     self._queue.remove(req)
                 self._inflight += len(group)
+                self._batch_seq += 1
+                batch_id = self._batch_seq
                 self.metrics.set_gauge("queue_depth", len(self._queue))
             try:
-                self._execute(group)
+                self._execute(group, batch_id)
             finally:
                 with self._cond:
                     self._inflight -= len(group)
@@ -293,40 +325,58 @@ class QuESTService:
             st = jax.device_put(st, self._sharding)
         return st
 
-    def _execute(self, group: list) -> None:
+    def _execute(self, group: list, batch_id: int = 0) -> None:
         now = time.monotonic()
         live = []
         for req in group:
             if req.deadline is not None and now > req.deadline:
                 self.metrics.inc("deadline_expired_total")
+                self.flight_recorder.resolve(req.rid, "deadline",
+                                             batch_id=batch_id,
+                                             wait_s=now - req.enqueue_t)
                 self._fail(req, QuESTError(
                     ErrorCode.DEADLINE_EXCEEDED,
                     MESSAGES[ErrorCode.DEADLINE_EXCEEDED], "submit"))
             elif not req.future.set_running_or_notify_cancel():
+                self.flight_recorder.resolve(req.rid, "cancelled",
+                                             batch_id=batch_id)
                 continue        # caller cancelled before execution: drop
             else:
                 live.append(req)
         if not live:
             return
+        completed: set = set()
         try:
-            # one lookup PER REQUEST (not per group): the hit/miss counters
-            # are the per-request serving economics — 64 same-class requests
-            # are 1 miss + 63 hits however they happen to batch
-            for req in live:
-                entry = self._cache.entry_for(req.ops, req.num_qubits,
-                                              self._options)
-            t0 = time.perf_counter()
-            if entry.skeleton is None:
-                # opaque overlapped class (PR 4): per-request programs
-                states = [self._cache.overlap_program(entry, req.ops)
-                          .call(self._state(req)) for req in live]
-                padded = len(live)
-            else:
-                states, padded = _batch.execute_group(
-                    self._cache, entry, live, self._state, self.max_batch,
-                    mode=self.batch_mode)
-            jax.block_until_ready(states[-1])
-            dt = time.perf_counter() - t0
+            with _obs.span("serve.execute_batch", batch=batch_id,
+                           size=len(live)) as bsp:
+                # one lookup PER REQUEST (not per group): the hit/miss
+                # counters are the per-request serving economics — 64
+                # same-class requests are 1 miss + 63 hits however they
+                # happen to batch.  Each lookup runs under its request's
+                # context so the cache's spans correlate, and reports its
+                # hit/miss outcome through the notes channel.
+                outcomes: dict = {}
+                for req in live:
+                    with _obs.request(req.rid), \
+                            _obs.collect_notes() as notes:
+                        entry = self._cache.entry_for(req.ops,
+                                                      req.num_qubits,
+                                                      self._options)
+                    outcomes[req.rid] = notes.get("cache_outcome", "miss")
+                t0 = time.perf_counter()
+                if entry.skeleton is None:
+                    # opaque overlapped class (PR 4): per-request programs
+                    states = [self._cache.overlap_program(entry, req.ops)
+                              .call(self._state(req)) for req in live]
+                    padded = len(live)
+                else:
+                    states, padded = _batch.execute_group(
+                        self._cache, entry, live, self._state,
+                        self.max_batch, mode=self.batch_mode)
+                jax.block_until_ready(states[-1])
+                dt = time.perf_counter() - t0
+                class_key = _obs.key_hash(entry.skey)
+                parent = bsp.span_id if bsp is not None else None
             self.metrics.inc("batches_total")
             self.metrics.observe("batch_size", len(live),
                                  buckets=BATCH_BUCKETS)
@@ -335,19 +385,46 @@ class QuESTService:
                 self.metrics.inc("padded_requests_total", padded - len(live))
             done_t = time.monotonic()
             for req, st in zip(live, states):
+                # the per-request execution span: the trace's link from a
+                # request_id to what ran for it (class, engine, cache
+                # outcome, batch) — the correlation contract
+                # validate_chrome_trace enforces
+                _obs.emit_span(
+                    EXECUTION_SPAN, t0=t0, dur=dt, parent_id=parent,
+                    request_id=req.rid, class_key=class_key,
+                    engine=entry.options.engine, cache=outcomes[req.rid],
+                    batch=batch_id, batch_size=len(live),
+                    queue_wait_s=round(done_t - dt - req.enqueue_t, 6))
                 samples = self._sample(st, req) if req.shots else None
                 try:
                     req.future.set_result(ServeResult(np.asarray(st), samples,
                                                       len(live), req.rid))
                 except InvalidStateError:
+                    self.flight_recorder.resolve(req.rid, "cancelled",
+                                                 batch_id=batch_id)
                     continue        # raced a cancel mid-execution
+                # "ok" is recorded only once the result is DELIVERED, so a
+                # later request's failure in this loop cannot be confused
+                # with (or overwrite) a completed one
+                completed.add(req.rid)
+                self.flight_recorder.resolve(
+                    req.rid, "ok", batch_id=batch_id,
+                    wait_s=done_t - dt - req.enqueue_t, exec_s=dt)
                 self.metrics.inc("requests_completed_total")
                 self.metrics.observe("request_latency_seconds",
                                      done_t - req.enqueue_t)
         except Exception as exc:  # noqa: BLE001 — forwarded to the futures
+            failed = 0
             for req in live:
+                if req.rid in completed:
+                    continue    # delivered before the failure: outcome ok
+                failed += 1
+                self.flight_recorder.resolve(
+                    req.rid, f"error:{type(exc).__name__}",
+                    batch_id=batch_id)
                 self._fail(req, exc)
-            self.metrics.inc("requests_failed_total", len(live))
+            self.flight_recorder.dump(f"error:{type(exc).__name__}")
+            self.metrics.inc("requests_failed_total", failed)
 
     def _sample(self, state, req: _Request) -> np.ndarray:
         """``req.shots`` joint outcomes over all qubits from the request's
@@ -374,10 +451,21 @@ class QuESTService:
         d = self.metrics.as_dict()
         d["cache"] = self._cache.snapshot()
         d["cache_hit_rate"] = d["cache"]["hit_rate"]
+        d["obs"] = self._obs_gauges()
         return d
+
+    def _obs_gauges(self) -> dict:
+        """Tracing/ledger/flight-recorder counters spliced into the same
+        registry as the service metrics: ONE Prometheus scrape covers the
+        whole observability surface (docs/OBSERVABILITY.md)."""
+        g = dict(_obs.obs_snapshot())
+        g["flight_depth"] = len(self.flight_recorder.records())
+        g["flight_dumps"] = self.flight_recorder.dumps
+        return g
 
     def prometheus(self) -> str:
         cache = self._cache.snapshot()
         extra = {f"cache_{k}": v for k, v in cache.items()
                  if isinstance(v, (int, float))}
+        extra.update({f"obs_{k}": v for k, v in self._obs_gauges().items()})
         return self.metrics.to_prometheus(extra_gauges=extra)

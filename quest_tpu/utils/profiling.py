@@ -8,7 +8,10 @@ Three layers:
 - circuit cost model: :func:`circuit_stats` reports, before compiling, how
   many HBM passes / MXU contractions / collective ops a circuit will cost on
   an ``n``-qubit state over ``num_ranks`` shards — the static analogue of the
-  reference's per-gate comm decision (QuEST_cpu_distributed.c:356-361);
+  reference's per-gate comm decision (QuEST_cpu_distributed.c:356-361).
+  Since the epoch engine (ops/epoch_pallas.py) the default pass count is the
+  ENGINE-AWARE one (``select_engine`` + the fused epoch plan);
+  ``fused=False`` keeps the historical one-pass-per-op model;
 - wall-clock: :func:`timed` measures a jitted program with dispatch overhead
   subtracted, the methodology bench.py uses.
 """
@@ -46,43 +49,89 @@ class CircuitStats:
     diagonal_ops: int            # broadcast multiplies (VPU only)
     cross_shard_ops: int         # ops touching the sharded prefix qubits
     bytes_per_pass: int          # state size in bytes (one direction)
+    permutation_ops: int = 0     # swap/bitperm: data movement, not MXU work
+    engine: str = "xla"          # backend the pass count describes
+    deferred_perm_ops: int = 0   # perms the epoch engine absorbs (0 passes)
 
     def __str__(self):
         gb = self.bytes_per_pass / 1e9
         return (f"{self.num_ops} ops: {self.mxu_contractions} dense (MXU), "
                 f"{self.diagonal_ops} diagonal (VPU), "
+                f"{self.permutation_ops} permutation, "
                 f"{self.cross_shard_ops} cross-shard; "
-                f"~{self.hbm_passes} HBM passes x {gb:.3g} GB")
+                f"~{self.hbm_passes} HBM passes x {gb:.3g} GB "
+                f"({self.engine} engine)")
 
 
 def circuit_stats(circuit, num_qubits: int | None = None,
-                  num_ranks: int = 1, bytes_per_real: int = 4) -> CircuitStats:
+                  num_ranks: int = 1, bytes_per_real: int = 4,
+                  fused: bool = True, chip=None) -> CircuitStats:
     """Analyse a :class:`~quest_tpu.circuit.Circuit` without compiling it.
 
     An op is "cross-shard" when it targets (or is controlled on) one of the
     top ``log2(num_ranks)`` qubits — the ops whose GSPMD partitioning inserts
     collectives, the reference's pairwise-exchange case
-    (ref: QuEST_cpu_distributed.c:303-312)."""
+    (ref: QuEST_cpu_distributed.c:303-312).  ``swap``/``bitperm`` ops are
+    data movement (``permutation_ops``), not MXU contractions.
+
+    ``fused=True`` (default) routes the HBM-pass count through the SAME
+    engine cost model ``compile_circuit(engine="auto")`` dispatches on
+    (parallel/planner.py ``select_engine`` at TPU-class specs): when the
+    Pallas epoch executor (ops/epoch_pallas.py) would run the circuit, the
+    reported passes are the plan's FUSED count — a 28q QFT is 22 passes,
+    not 420 — with ``engine``/``deferred_perm_ops`` recording the decision.
+    ``fused=False`` is the historical per-op model: one full read+write
+    sweep per un-fused op, whatever the engine would actually do."""
     n = num_qubits if num_qubits is not None else circuit.num_qubits
     shard_qubits = max(num_ranks.bit_length() - 1, 0)
     lo = n - shard_qubits  # qubits >= lo live on the sharded axis prefix
-    dense = diag = cross = 0
+    dense = diag = perm = cross = 0
     for op in circuit.ops:
         wires = tuple(op.targets) + tuple(op.controls)
         if op.kind in ("diagonal", "mrz"):  # mrz: elementwise parity phase
             diag += 1
+        elif op.kind in ("swap", "bitperm"):
+            perm += 1
         else:
             dense += 1
         if any(q >= lo for q in wires):
             cross += 1
     num_ops = len(circuit.ops)
+    hbm_passes = num_ops  # one read+write sweep per un-fused op
+    engine = "xla"
+    deferred = 0
+    if fused and num_ranks <= 1 and circuit.ops:
+        # spec-level engine decision (backend pinned to "tpu" so the stats
+        # are deployment stats, not dev-box stats): the epoch plan's fused
+        # pass count replaces the per-op sweep count when pallas wins
+        from ..parallel import planner as _planner
+        shim = circuit
+        if n != circuit.num_qubits:
+            from ..circuit import Circuit
+            shim = Circuit(n)
+            shim.ops = list(circuit.ops)
+        precision = 1 if bytes_per_real == 4 else 2
+        try:
+            choice = _planner.select_engine(shim, 1,
+                                            chip or _planner.V5E,
+                                            precision, "auto",
+                                            backend="tpu")
+        except Exception:
+            choice = {"engine": "xla", "plan": None}
+        if choice["engine"] == "pallas" and choice["plan"] is not None:
+            engine = "pallas"
+            hbm_passes = choice["plan"].hbm_passes
+            deferred = choice["plan"].deferred_ops
     return CircuitStats(
         num_ops=num_ops,
-        hbm_passes=num_ops,  # one read+write sweep per un-fused op
+        hbm_passes=hbm_passes,
         mxu_contractions=dense,
         diagonal_ops=diag,
         cross_shard_ops=cross,
         bytes_per_pass=2 * (1 << n) * bytes_per_real,
+        permutation_ops=perm,
+        engine=engine,
+        deferred_perm_ops=deferred,
     )
 
 

@@ -1,0 +1,141 @@
+"""serve/metrics.py round-trip coverage (ISSUE 7 satellite):
+
+- property test: ``parse_prometheus(export())`` reproduces every counter,
+  gauge and histogram bucket EXACTLY for randomized registries (the
+  ``_fmt`` encoding — int-form for integral floats, ``repr`` otherwise —
+  must round-trip through ``float()`` bit-for-bit);
+- the histogram reservoir's FIFO-halving boundary: percentiles beyond
+  8192 observations follow the documented drop-the-oldest-half rule
+  (recent-biased), while the bucket export and count stay exact over ALL
+  observations.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+
+from quest_tpu.serve.metrics import (_RESERVOIR_CAP, BATCH_BUCKETS,
+                                     LATENCY_BUCKETS, Metrics,
+                                     parse_prometheus)
+
+
+def _expected_hist_samples(prefix, name, values, buckets):
+    """Cumulative bucket counts / sum / count the exposition format must
+    carry for ``values`` observed against ``buckets``."""
+    per_bucket = [0] * (len(buckets) + 1)
+    total = 0.0
+    for v in values:
+        total += v            # same accumulation order as _Histogram
+        for i, b in enumerate(buckets):
+            if v <= b:
+                per_bucket[i] += 1
+                break
+        else:
+            per_bucket[-1] += 1
+    out = {}
+    cum = 0
+    for b, c in zip(buckets, per_bucket[:-1]):
+        cum += c
+        out[(f"{prefix}_{name}_bucket", f'le="{_le(b)}"')] = float(cum)
+    out[(f"{prefix}_{name}_bucket", 'le="+Inf"')] = float(cum + per_bucket[-1])
+    out[(f"{prefix}_{name}_sum", "")] = total
+    out[(f"{prefix}_{name}_count", "")] = float(len(values))
+    return out
+
+
+def _le(b: float) -> str:
+    f = float(b)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def test_prometheus_roundtrip_property():
+    """Randomized registries: every exported sample parses back to the
+    exact recorded value — counters, gauges, and cumulative histogram
+    buckets alike."""
+    for seed in range(8):
+        rng = random.Random(seed)
+        m = Metrics()
+        counters = {}
+        for i in range(rng.randint(1, 5)):
+            name = f"ctr{i}_total"
+            # mix integral and fractional values: both _fmt forms covered
+            v = (float(rng.randint(0, 10**6)) if rng.random() < 0.5
+                 else rng.uniform(0, 1e6))
+            m.inc(name, v)
+            counters[name] = v
+        gauges = {}
+        for i in range(rng.randint(1, 5)):
+            name = f"g{i}"
+            v = rng.uniform(-1e3, 1e3) * (10 ** rng.randint(-6, 6))
+            m.set_gauge(name, v)
+            gauges[name] = v
+        hists = {}
+        for i, buckets in enumerate((LATENCY_BUCKETS, BATCH_BUCKETS)):
+            name = f"h{i}"
+            values = [rng.uniform(0, 2 * buckets[-1])
+                      for _ in range(rng.randint(1, 200))]
+            for v in values:
+                m.observe(name, v, buckets=buckets)
+            hists[name] = (values, buckets)
+
+        parsed = parse_prometheus(m.to_prometheus())
+        for name, v in counters.items():
+            assert parsed[f"quest_serve_{name}"][""] == v
+        for name, v in gauges.items():
+            assert parsed[f"quest_serve_{name}"][""] == v
+        for name, (values, buckets) in hists.items():
+            expected = _expected_hist_samples("quest_serve", name, values,
+                                              buckets)
+            for (metric, label), want in expected.items():
+                got = parsed[metric][label]
+                assert got == want, (metric, label, got, want)
+
+
+def test_roundtrip_with_extra_gauges_and_obs_splice():
+    m = Metrics()
+    m.inc("requests_total", 3)
+    text = m.to_prometheus(extra_gauges={"cache_hits": 7,
+                                         "obs_trace_spans": 12.5})
+    parsed = parse_prometheus(text)
+    assert parsed["quest_serve_cache_hits"][""] == 7
+    assert parsed["quest_serve_obs_trace_spans"][""] == 12.5
+    assert parsed["quest_serve_requests_total"][""] == 3
+
+
+def test_reservoir_percentiles_across_fifo_halving_boundary():
+    """> 8192 observations: the reservoir drops its oldest half at the cap
+    (documented O(1)-amortised recency bias) while the histogram's bucket
+    counts, sum and count keep describing EVERY observation."""
+    n_obs = 10_000
+    assert n_obs > _RESERVOIR_CAP
+    m = Metrics()
+    values = [float(i) for i in range(n_obs)]
+    for v in values:
+        m.observe("lat", v, buckets=(2000.0, 6000.0, 9000.0))
+    h = m._hists["lat"]
+
+    # the documented retention rule, simulated independently
+    expected_window: list[float] = []
+    for v in values:
+        expected_window.append(v)
+        if len(expected_window) > _RESERVOIR_CAP:
+            del expected_window[:_RESERVOIR_CAP // 2]
+    assert h.raw == expected_window
+    assert len(h.raw) < n_obs                      # halving happened
+    assert min(h.raw) >= _RESERVOIR_CAP // 2       # oldest half is gone
+
+    xs = sorted(expected_window)
+    for q in (50.0, 99.0):
+        idx = min(len(xs) - 1, max(0, round(q / 100.0 * (len(xs) - 1))))
+        assert h.percentile(q) == xs[idx]
+    assert h.percentile(50.0) > n_obs / 2          # recent-biased by design
+
+    # exports still cover all n_obs observations exactly
+    summary = m.as_dict()["histograms"]["lat"]
+    assert summary["count"] == n_obs
+    assert summary["sum"] == math.fsum(values) == sum(values)
+    parsed = parse_prometheus(m.to_prometheus())
+    assert parsed["quest_serve_lat_count"][""] == n_obs
+    assert parsed["quest_serve_lat_bucket"]['le="2000"'] == 2001
+    assert parsed["quest_serve_lat_bucket"]['le="+Inf"'] == n_obs
