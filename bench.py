@@ -1,8 +1,14 @@
 """Benchmark harness: the full BASELINE.md config matrix on real hardware.
 
-Prints exactly ONE JSON line (driver contract).  The headline metric is the
-24q random-circuit f32 fused throughput; the ``matrix`` field carries every
-BASELINE.md config measured in the same run:
+Prints exactly ONE JSON line (driver contract).  ``--compare`` switches to
+the perf-regression gate instead of running benchmarks: the committed
+``BENCH_r0*.json`` history (or ``--current``) is checked row-by-row against
+the best comparable prior round (quest_tpu/obs/regress.py; the CI
+``bench-regress`` job) and the process exits nonzero on any gating
+regression past tolerance.
+
+The headline metric is the 24q random-circuit f32 fused throughput; the
+``matrix`` field carries every BASELINE.md config measured in the same run:
 
   - random 24q: f32/f64 x fused/unfused  (single-chip hot path)
   - 20q Clifford+T statevector           (BASELINE config 2)
@@ -1192,5 +1198,86 @@ def main() -> None:
     print(json.dumps(result))
 
 
+def compare_main(argv=None) -> int:
+    """``python bench.py --compare`` — the perf-regression gate
+    (quest_tpu/obs/regress.py; docs/OBSERVABILITY.md has the tolerance
+    table).  Compares the newest usable history round (or ``--current``,
+    a raw bench output document or a driver-wrapped capture) against the
+    best comparable row of every EARLIER round, prints ONE JSON report,
+    and exits 1 iff any gating row regressed past its tolerance."""
+    import argparse
+
+    from quest_tpu.obs import regress
+
+    parser = argparse.ArgumentParser(
+        prog="python bench.py --compare",
+        description="Gate the BENCH_r0*.json perf trajectory.")
+    parser.add_argument("--compare", action="store_true",
+                        help="(the mode flag that routed here)")
+    parser.add_argument("--history", nargs="+", metavar="PATH",
+                        help="history files, oldest first (default: the "
+                             "repo's BENCH_r*.json)")
+    parser.add_argument("--current", metavar="PATH",
+                        help="document to gate (default: the newest "
+                             "history round that holds any rows)")
+    parser.add_argument("--tolerance", type=float,
+                        default=regress.DEFAULT_TOLERANCE,
+                        help="default allowed fractional regression "
+                             "(default %(default)s)")
+    parser.add_argument("--row-tolerance", action="append", default=[],
+                        metavar="NAME=FRAC", dest="row_tolerance",
+                        help="per-row tolerance override; repeatable")
+    parser.add_argument("--include-validation", action="store_true",
+                        help="let validation_only (CPU-mesh) rows gate too")
+    parser.add_argument("--inject", action="append", default=[],
+                        metavar="NAME=FACTOR",
+                        help="scale a current row's value by FACTOR before "
+                             "gating — the CI self-test that proves the "
+                             "gate actually fails on a regression")
+    parser.add_argument("--out", metavar="FILE",
+                        help="also write the report document to FILE (the "
+                             "CI workflow artifact)")
+    args = parser.parse_args(argv)
+
+    def parse_kv(items, what):
+        out = {}
+        for item in items:
+            name, _, val = item.partition("=")
+            if not name or not val:
+                parser.error(f"--{what} takes NAME=VALUE, got {item!r}")
+            out[name] = float(val)
+        return out
+
+    history = regress.load_history(args.history)
+    if args.current is not None:
+        current = regress.load_round(args.current)
+        priors = history
+    else:
+        usable = [r for r in history if r["rows"]]
+        if not usable:
+            parser.error("no history round holds any rows")
+        current = usable[-1]
+        priors = [r for r in history if r["label"] != current["label"]]
+    for name, factor in parse_kv(args.inject, "inject").items():
+        if name not in current["rows"]:
+            parser.error(f"--inject {name}: no such row in "
+                         f"{current['label']} (has: "
+                         f"{', '.join(sorted(current['rows']))})")
+        current["rows"][name]["value"] *= factor
+        current["rows"][name]["injected_factor"] = factor
+    report = regress.compare(
+        current, priors, default_tolerance=args.tolerance,
+        row_tolerances=parse_kv(args.row_tolerance, "row-tolerance"),
+        include_validation=args.include_validation)
+    text = json.dumps(report, indent=1)
+    print(text)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(text + "\n")
+    return 0 if report["ok"] else 1
+
+
 if __name__ == "__main__":
+    if "--compare" in sys.argv[1:]:
+        sys.exit(compare_main(sys.argv[1:]))
     sys.exit(main())

@@ -76,7 +76,12 @@ GROUPS = [
                                        "Ledger", "enable_tracing",
                                        "disable_tracing", "tracing_enabled",
                                        "chrome_trace", "trace_report",
-                                       "global_ledger"]),
+                                       "global_ledger",
+                                       "validate_chrome_trace",
+                                       "process_shard", "save_shard",
+                                       "load_shard", "merge_shards",
+                                       "merge_files",
+                                       "SLOConfig", "SLOMonitor"]),
 ]
 
 

@@ -28,7 +28,10 @@ from .serve import (CacheOptions, CompileCache, QuESTService,  # noqa: F401
                     ServeResult)
 from .obs import (TraceRecorder, FlightRecorder, Ledger,  # noqa: F401
                   enable_tracing, disable_tracing, tracing_enabled,
-                  chrome_trace, trace_report, global_ledger)
+                  chrome_trace, trace_report, global_ledger,
+                  SLOConfig, SLOMonitor, process_shard, save_shard,
+                  load_shard, merge_shards, merge_files,
+                  validate_chrome_trace)
 
 __version__ = "0.1.0"
 __all__ = list(_api_all) + [
@@ -42,4 +45,6 @@ __all__ = list(_api_all) + [
     "TraceRecorder", "FlightRecorder", "Ledger", "enable_tracing",
     "disable_tracing", "tracing_enabled", "chrome_trace", "trace_report",
     "global_ledger",
+    "SLOConfig", "SLOMonitor", "process_shard", "save_shard", "load_shard",
+    "merge_shards", "merge_files", "validate_chrome_trace",
 ]

@@ -23,7 +23,11 @@ Modes (combinable; exit status is 1 iff any ERROR-severity diagnostic):
   on (quest_tpu/obs), print the per-span/per-request view, and record a
   model-vs-measured ledger row (predicted vs measured wall /
   collective-count); ledger drift reports as ``O_MODEL_DRIFT`` (WARNING —
-  the ``obs-selftest`` CI job gates on zero).
+  the ``obs-selftest`` CI job gates on zero).  Under ``--json`` the mode
+  honors the ONE-machine-readable-document contract like every other
+  mode: per-circuit rows land in ``"trace_report"`` (ledger row + Chrome
+  trace, no human-text blobs) and the process ledger is summarized in a
+  top-level ``"ledger"`` section CI parses instead of grepping.
 
 - ``--serve-audit``: machine-prove the serve layer's parameter-lifted
   compilation cache (analysis/serve_audit.py): per structural class, the
@@ -38,8 +42,9 @@ against the deployment described by ``--devices/--precision/--chip``.
 
 ``--json`` switches stdout to ONE machine-readable JSON document —
 ``{"diagnostics": [...], "circuits": [...], "schedule": [...],
-"verify": [...], "serve_audit": [...], "summary": {...}}`` — so CI gates
-parse severities instead of grepping text.  Exit status is unchanged.
+"verify": [...], "serve_audit": [...], "trace_report": [...],
+"ledger": {...}, "summary": {...}}`` — so CI gates parse severities
+instead of grepping text.  Exit status is unchanged.
 """
 
 from __future__ import annotations
@@ -244,7 +249,9 @@ def _trace_report_run(label: str, circuit, args, echo) -> tuple:
             measured_hlo_collectives=measured_coll,
             warn=False)
         spans = _obs.recorder().spans()
-        report_text = _obs.trace_report(spans)
+        # the document stays MACHINE-readable end to end (the PR 3 --json
+        # contract): the human span-tree view is echoed in text mode only,
+        # never embedded as a text blob inside the JSON payload
         report = {
             "label": label,
             "engine": run.engine,
@@ -253,13 +260,12 @@ def _trace_report_run(label: str, circuit, args, echo) -> tuple:
             "measured_seconds": measured_s,
             "ledger": rec.as_dict(),
             "chrome_trace": _obs.chrome_trace(spans),
-            "report": report_text,
         }
         echo(f"{label}: trace-report {len(spans)} span(s), engine "
              f"{run.engine}, {measured_s:.3g}s measured "
              f"(model {predicted_s:.3g}s), {measured_coll} HLO "
              f"collective(s) vs {predicted_coll} predicted event(s)")
-        echo(report_text)
+        echo(_obs.trace_report(spans))
         from ..obs.ledger import MODEL_DRIFT
         found = [diag(MODEL_DRIFT, Severity.WARNING,
                       detail=f"{label}: {f}") for f in rec.findings]
@@ -406,6 +412,15 @@ def main(argv=None) -> int:
         diagnostics += found
         for r in reports:
             echo(f"{r['label']}: serve-audit " + json.dumps(r, default=float))
+
+    if args.trace_report:
+        # the process-ledger summary, one section of the single document:
+        # the obs-selftest CI gate reads drift counts from HERE (and
+        # O_MODEL_DRIFT severities from "diagnostics") instead of grepping
+        from .. import obs as _obs
+        led = _obs.global_ledger()
+        doc["ledger"] = {"records": led.as_dicts(),
+                         "drift_total": led.snapshot()["drift_total"]}
 
     if not ran:
         parser.print_usage()

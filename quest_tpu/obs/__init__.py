@@ -19,7 +19,24 @@ hand-read a bench row.  This package closes that loop, dependency-free:
   emitting ``O_MODEL_DRIFT`` when measurement leaves the calibrated band.
 - ``flight.py``: a bounded ring buffer of recent serve request records
   (admission, queue wait, batch id, deadline outcome, error code) dumped on
-  ``E_QUEUE_FULL``/crash and exposed via ``--selftest --json``.
+  ``E_QUEUE_FULL``/deadline drops/crash and exposed via ``--selftest
+  --json``.
+
+PR 8 grew the layer from one process's eyes to the fleet's:
+
+- ``aggregate.py``: cross-process trace aggregation — per-process recorder
+  shards stamped with ``jax.process_index()`` and a broadcast-aligned
+  clock base, merged into ONE Chrome trace with a track per process
+  (request spans correlated by ``request_id``; the single-process merge is
+  the identity).
+- ``slo.py``: the serve SLO monitor — windowed per-structural-class
+  latency, deadline hit rate, queue saturation, and burn-rate early
+  warning (``O_SLO_BURN``), wired into ``QuESTService`` and the one
+  Prometheus scrape.
+- ``regress.py``: the perf-regression ledger — the committed
+  ``BENCH_r0*.json`` trajectory parsed (truncated tails recovered) and
+  gated row-by-row against the best comparable prior round
+  (``python bench.py --compare``; the CI ``bench-regress`` job).
 
 See docs/OBSERVABILITY.md.
 """
@@ -31,6 +48,10 @@ from .trace import (Span, TraceRecorder, collect_notes, current_request_id,  # n
 from .ledger import DriftRecord, Ledger, global_ledger  # noqa: F401
 from .flight import FlightRecord, FlightRecorder  # noqa: F401
 from .export import chrome_trace, trace_report, validate_chrome_trace  # noqa: F401
+from .aggregate import (load_shard, merge_files, merge_shards,  # noqa: F401
+                        process_shard, save_shard)
+from .slo import SLOConfig, SLOMonitor  # noqa: F401
+from . import regress  # noqa: F401
 
 __all__ = [
     "Span", "TraceRecorder", "recorder", "span", "emit_span", "request",
@@ -39,4 +60,8 @@ __all__ = [
     "Ledger", "DriftRecord", "global_ledger",
     "FlightRecorder", "FlightRecord",
     "chrome_trace", "trace_report", "validate_chrome_trace",
+    "process_shard", "save_shard", "load_shard", "merge_shards",
+    "merge_files",
+    "SLOConfig", "SLOMonitor",
+    "regress",
 ]

@@ -1,0 +1,195 @@
+"""Cross-process trace aggregation: N recorder shards, ONE merged trace.
+
+PR 7's recorder is deliberately per-process (one process, one trace) — but
+the ROADMAP north star is a multi-replica multi-host deployment, where a
+request's spans land on whichever process served it and a per-process trace
+is an island.  This module is the bridge:
+
+- :func:`process_shard` snapshots this process's recorder as a plain-JSON
+  **shard** stamped with ``jax.process_index()`` and a host-clock offset
+  estimated against process 0's epoch clock via a ``multihost_utils``
+  broadcast (``parallel/mesh.py broadcast_host_epoch`` — the same
+  ``broadcast_one_to_all`` pattern ``seed_quest_default`` reuses from the
+  reference's seed bcast).
+- :func:`merge_shards` renders any set of shards as ONE Chrome-trace
+  document: one ``pid`` **track per process** (thread lanes within it),
+  span ids namespaced per process so they stay globally unique, and every
+  span's timestamp mapped onto process 0's timeline through the shard's
+  clock offset — spans of the same wall-clock moment line up across host
+  tracks, and a request that crossed processes is correlated by the PR 7
+  ``request_id`` carried in every span's ``args``.
+- the merged document passes the extended ``validate_chrome_trace``
+  (obs/export.py): globally-unique span ids, zero orphans ACROSS processes
+  (a parent id must resolve, and must resolve within its own process
+  track), and a declared-process contract when the document is a merge.
+
+Two invariants the tests pin:
+
+- **Degenerate identity.**  Merging the single shard of a single-process
+  run reproduces ``chrome_trace()`` byte-for-byte (same keys, same values,
+  same order).  Single-process tooling — the selftest CI gate, Perfetto
+  workflows, the atexit crash dump — cannot tell the merge path exists.
+- **Clock-skew alignment.**  For shards whose offsets are exact, two spans
+  recording the same epoch instant on different hosts get the same merged
+  ``ts`` regardless of the skew between their host clocks (property-tested
+  with synthetic skews in tests/test_obs_aggregate.py).
+
+Shards are plain dicts (JSON-serializable as-is): a multi-host launcher
+has each process :func:`save_shard` at shutdown (or on the atexit hook)
+and any process — or an offline tool — :func:`merge_files` afterwards.
+"""
+
+from __future__ import annotations
+
+import json
+
+from .trace import Span, TraceRecorder, recorder as _recorder
+
+__all__ = ["SHARD_FORMAT", "process_shard", "save_shard", "load_shard",
+           "merge_shards", "merge_files"]
+
+#: the shard schema tag (bumped on incompatible changes)
+SHARD_FORMAT = "quest-tpu-trace-shard-v1"
+
+#: per-process span-id namespace stride: merged id = span_id + index*STRIDE.
+#: 2^40 is far above any recorder's id counter (DEFAULT_MAX_SPANS = 2^18)
+#: and keeps process 0's ids IDENTITY-mapped — the degenerate-merge
+#: contract.
+_ID_STRIDE = 1 << 40
+
+
+def process_shard(recorder: TraceRecorder | None = None, *,
+                  align_clock: bool = True) -> dict:
+    """This process's recorder as a serializable shard.
+
+    ``align_clock=True`` (default) estimates this host's clock offset
+    against process 0 via ``broadcast_host_epoch`` — a COLLECTIVE when
+    ``jax.process_count() > 1`` (every process must call it, like the seed
+    broadcast); single-process it is free and the offset is exactly 0.0.
+    Pass ``align_clock=False`` to snapshot without any collective (offline
+    merges can still align on the raw epoch clocks)."""
+    import socket
+
+    from ..parallel.mesh import broadcast_host_epoch, process_info
+    rec = recorder if recorder is not None else _recorder()
+    info = process_info()
+    offset = 0.0
+    if align_clock:
+        _base, offset = broadcast_host_epoch()
+    return {
+        "format": SHARD_FORMAT,
+        "process_index": info["process_index"],
+        "process_count": info["process_count"],
+        "host": socket.gethostname(),
+        "t0_perf": rec.t0_perf,
+        "t0_epoch": rec.t0_epoch,
+        "clock_offset_s": offset,
+        "dropped": rec.snapshot()["dropped"],
+        "spans": [{"name": sp.name, "span_id": sp.span_id,
+                   "parent_id": sp.parent_id, "request_id": sp.request_id,
+                   "t0": sp.t0, "dur": sp.dur, "thread": sp.thread,
+                   "attrs": dict(sp.attrs)} for sp in rec.spans()],
+    }
+
+
+def save_shard(path: str, recorder: TraceRecorder | None = None, *,
+               align_clock: bool = True) -> dict:
+    """Write this process's shard to ``path`` (one JSON document) and
+    return it — the per-process half of a multi-host trace capture."""
+    shard = process_shard(recorder, align_clock=align_clock)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(shard, fh)
+    return shard
+
+
+def load_shard(path: str) -> dict:
+    with open(path, encoding="utf-8") as fh:
+        shard = json.load(fh)
+    if not isinstance(shard, dict) or shard.get("format") != SHARD_FORMAT:
+        raise ValueError(f"{path}: not a {SHARD_FORMAT} shard "
+                         f"(format={shard.get('format') if isinstance(shard, dict) else None!r})")
+    return shard
+
+
+def _remap(span_id, pindex: int):
+    return None if span_id is None else span_id + pindex * _ID_STRIDE
+
+
+def merge_shards(shards: list[dict]) -> dict:
+    """ONE Chrome-trace document over ``shards`` (any order; one shard per
+    process).  Track layout: ``pid = process_index + 1`` (a named process
+    track per shard when the merge is non-degenerate), ``tid`` lanes per
+    recording thread within each process.  Timestamps are microseconds on
+    PROCESS 0's timeline: each span's host-epoch instant is corrected by
+    its shard's ``clock_offset_s`` and re-based on process 0's trace
+    origin, so simultaneous work lines up across host tracks.
+
+    The single-shard process-0 merge is the IDENTITY: byte-identical to
+    ``chrome_trace()`` of the same recorder (tests pin it), so every
+    existing single-process consumer reads merged output unchanged."""
+    if not shards:
+        raise ValueError("merge_shards takes at least one shard")
+    by_proc: dict = {}
+    for sh in shards:
+        if not isinstance(sh, dict) or sh.get("format") != SHARD_FORMAT:
+            raise ValueError(f"not a {SHARD_FORMAT} shard: "
+                             f"{sh.get('format') if isinstance(sh, dict) else sh!r}")
+        p = int(sh["process_index"])
+        if p in by_proc:
+            raise ValueError(f"two shards claim process_index {p}")
+        by_proc[p] = sh
+    multi = len(by_proc) > 1
+    # every shard's trace origin, mapped onto process 0's host clock
+    aligned = {p: sh["t0_epoch"] - sh["clock_offset_s"]
+               for p, sh in by_proc.items()}
+    base_proc = 0 if 0 in by_proc else min(by_proc)
+    base_epoch = aligned[base_proc]
+    meta: list = []
+    events: list = []
+    dropped_total = 0
+    for p in sorted(by_proc):
+        sh = by_proc[p]
+        pid = p + 1
+        shift = aligned[p] - base_epoch     # exactly 0.0 for the base shard
+        dropped_total += int(sh.get("dropped", 0))
+        if multi:
+            meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                         "args": {"name": f"process {p}"
+                                          + (f" ({sh['host']})"
+                                             if sh.get("host") else "")}})
+        tids: dict = {}
+        shard_events = []
+        for sp in sh["spans"]:
+            tid = tids.setdefault(sp["thread"], len(tids) + 1)
+            args = {"span_id": _remap(sp["span_id"], p),
+                    "parent_id": _remap(sp["parent_id"], p),
+                    "request_id": sp["request_id"]}
+            if multi:
+                args["process"] = p
+            args.update(sp["attrs"])
+            shard_events.append({
+                "name": sp["name"], "ph": "X", "pid": pid, "tid": tid,
+                "ts": (sp["t0"] - sh["t0_perf"] + shift) * 1e6,
+                "dur": sp["dur"] * 1e6,
+                "args": args,
+            })
+        meta.extend({"name": "thread_name", "ph": "M", "pid": pid,
+                     "tid": tid, "args": {"name": thread}}
+                    for thread, tid in tids.items())
+        events.extend(shard_events)
+    other = {"origin_epoch_s": base_epoch, "dropped_spans": dropped_total}
+    if multi:
+        other["processes"] = sorted(by_proc)
+        other["clock_offsets_s"] = {str(p): by_proc[p]["clock_offset_s"]
+                                    for p in sorted(by_proc)}
+        other["hosts"] = {str(p): by_proc[p].get("host", "")
+                          for p in sorted(by_proc)}
+    return {"displayTimeUnit": "ms",
+            "otherData": other,
+            "traceEvents": meta + events}
+
+
+def merge_files(paths: list[str]) -> dict:
+    """Load shards from ``paths`` and merge them — the offline half of a
+    multi-host capture (each process ``save_shard``'d its own file)."""
+    return merge_shards([load_shard(p) for p in paths])

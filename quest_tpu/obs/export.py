@@ -64,15 +64,24 @@ def chrome_trace(spans: list[Span] | None = None,
 def validate_chrome_trace(doc: dict) -> list[str]:
     """Schema-check an exported document; returns the list of problems
     (empty = valid).  Checked: every complete event carries name/ts/dur and
-    a ``span_id``; span ids are unique; every non-None ``parent_id``
-    resolves to a present span (zero orphans); every ``serve.request``
-    event carries a ``request_id`` and the EXECUTION_SPAN_ATTRS."""
+    a ``span_id``; span ids are unique ACROSS the whole document (a merged
+    multi-process trace namespaces per-process ids — obs/aggregate.py);
+    every non-None ``parent_id`` resolves to a present span (zero orphans)
+    AND to a span on the same process track (a cross-track parent link
+    would mean the per-process namespacing broke); every ``serve.request``
+    event carries a ``request_id`` and the EXECUTION_SPAN_ATTRS.
+
+    Merged documents (``otherData.processes`` present) additionally must
+    name every declared process track (a ``process_name`` meta event per
+    pid), carry a clock offset per process, and contain no event on an
+    undeclared track."""
     problems: list[str] = []
     events = doc.get("traceEvents")
     if not isinstance(events, list):
         return ["no traceEvents array"]
     complete = [e for e in events if e.get("ph") == "X"]
     ids: set = set()
+    pid_of: dict = {}
     for i, e in enumerate(complete):
         for field in ("name", "ts", "dur", "pid", "tid"):
             if field not in e:
@@ -85,6 +94,7 @@ def validate_chrome_trace(doc: dict) -> list[str]:
         if sid in ids:
             problems.append(f"duplicate span_id {sid}")
         ids.add(sid)
+        pid_of[sid] = e.get("pid")
     for e in complete:
         args = e.get("args") or {}
         parent = args.get("parent_id")
@@ -92,6 +102,11 @@ def validate_chrome_trace(doc: dict) -> list[str]:
             problems.append(
                 f"span {args.get('span_id')} ({e.get('name')}) is an "
                 f"orphan: parent_id {parent} not in this trace")
+        elif parent is not None and pid_of[parent] != e.get("pid"):
+            problems.append(
+                f"span {args.get('span_id')} ({e.get('name')}) parents "
+                f"across process tracks: parent {parent} lives on pid "
+                f"{pid_of[parent]}, span on pid {e.get('pid')}")
         if e.get("name") == EXECUTION_SPAN:
             if args.get("request_id") is None:
                 problems.append(
@@ -102,6 +117,25 @@ def validate_chrome_trace(doc: dict) -> list[str]:
                     problems.append(
                         f"execution span {args.get('span_id')} missing "
                         f"attr {attr!r}")
+    declared = (doc.get("otherData") or {}).get("processes")
+    if declared is not None:
+        # a merged multi-process document (obs/aggregate.py): the declared
+        # track set is a contract, not a hint
+        declared_pids = {p + 1 for p in declared}
+        named_pids = {e.get("pid") for e in events
+                      if e.get("ph") == "M" and e.get("name") == "process_name"}
+        for p in sorted(declared):
+            if p + 1 not in named_pids:
+                problems.append(f"declared process {p} has no process_name "
+                                "meta event")
+            if str(p) not in ((doc.get("otherData") or {})
+                              .get("clock_offsets_s") or {}):
+                problems.append(f"declared process {p} has no clock offset")
+        for e in complete:
+            if e.get("pid") not in declared_pids:
+                problems.append(
+                    f"span {((e.get('args') or {}).get('span_id'))} sits on "
+                    f"undeclared process track pid {e.get('pid')}")
     return problems
 
 

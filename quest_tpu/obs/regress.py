@@ -1,0 +1,273 @@
+"""Perf-regression ledger: gate the BENCH trajectory, don't just record it.
+
+Five rounds of ``BENCH_r0*.json`` snapshots exist and every row now carries
+a provenance stamp (PR 7) — but the history was write-only: a regression in
+a headline row (``qft_30q_f32_public_api`` at 2.59e11 amps/s) would ship
+silently, because nothing ever read two rounds side by side.  This module
+is the reader and the gate:
+
+- :func:`load_history` parses the committed history files, which are
+  DRIVER-wrapped (``{"n", "cmd", "rc", "tail", "parsed"}``) and imperfect
+  in exactly the ways real telemetry is: r01 is a timeout with no data,
+  r02 has a parsed headline but no matrix, and r03–r05 carry only the
+  truncated *tail* of the output line.  Rows are recovered from those
+  tails by scanning for balanced ``{"name": ...}`` JSON objects — a row
+  that survived truncation is a row we can gate on; rows that didn't are
+  reported as unrecoverable, never silently invented.
+- :func:`compare` matches rows between a current document and the best
+  comparable prior row — same row name AND same platform (a CPU dev-box
+  run is never judged against a TPU history row; ``unknown`` platforms,
+  the pre-provenance rounds, match anything since the history is
+  single-fleet) — and flags ``status: "regressed"`` when
+
+      value < best_comparable_prior * (1 - tolerance)
+
+  with ``tolerance`` default :data:`DEFAULT_TOLERANCE` (20%) and
+  per-row overrides for rows with known larger run-to-run spread.
+  Rows marked ``validation_only`` (the CPU-mesh communication-structure
+  configs) are compared and reported but do NOT gate by default: their
+  wall clocks measure a virtual-device CPU mesh, not the chip, and round
+  over round they swing with host load (docs/OBSERVABILITY.md has the
+  tolerance table).
+
+The CLI is ``python bench.py --compare`` (one JSON report document on
+stdout, exit 1 iff a gating row regressed) and the CI ``bench-regress``
+job runs it twice: once over the real committed history (must pass), once
+with ``--inject`` scaling a headline row by 0.75 (must fail) — the gate
+gates itself.  Dependency-free like the rest of ``quest_tpu.obs``: the CI
+job needs nothing beyond the stdlib to refuse a regressing PR.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+__all__ = ["DEFAULT_TOLERANCE", "DEFAULT_ROW_TOLERANCES", "PERF_REGRESSION",
+           "recover_rows", "load_round", "load_history", "compare",
+           "default_history_paths"]
+
+#: the regression finding code (analysis severity taxonomy: ERROR — unlike
+#: O_MODEL_DRIFT/O_SLO_BURN this one fails CI, that is its whole point)
+PERF_REGRESSION = "O_PERF_REGRESSION"
+
+#: default per-row tolerance: fail on > 20% amps/s regression vs the best
+#: comparable prior row
+DEFAULT_TOLERANCE = 0.20
+
+#: per-row overrides for rows with measured larger run-to-run spread
+#: (docs/OBSERVABILITY.md "regression-gate tolerances" documents why):
+#: the serve row times a threaded queue+batch wall (scheduling jitter on a
+#: shared host), the f64 density row is the slowest config on a shared-chip
+#: tunnel with observed bad-window noise (bench.py best-of-2 bounds but
+#: does not remove it)
+DEFAULT_ROW_TOLERANCES = {
+    "serve_vqe_16q_batch64": 0.40,
+    "densmatr_14q_damping_depol_f64": 0.30,
+}
+
+_NAME_ROW = re.compile(r'\{"name":')
+_METRIC_DOC = re.compile(r'\{"metric":')
+
+
+def _scan_objects(text: str, pattern: re.Pattern) -> list:
+    """Every balanced JSON object starting at a ``pattern`` match.  The
+    history tails are TRUNCATED AT THE FRONT, so the first row fragment is
+    usually cut mid-object — raw_decode fails on it and succeeds on every
+    complete one after; recovery is exactly the survivable suffix."""
+    decoder = json.JSONDecoder()
+    out = []
+    for m in pattern.finditer(text):
+        try:
+            obj, _end = decoder.raw_decode(text, m.start())
+        except ValueError:
+            continue
+        out.append(obj)
+    return out
+
+
+def _row_platform(row: dict, round_platform: str) -> str:
+    cfg = row.get("config") or {}
+    return (cfg.get("platform")
+            or (cfg.get("provenance") or {}).get("platform")
+            or round_platform)
+
+
+def _normalize_row(row: dict, round_platform: str) -> dict | None:
+    """A matrix row as the compare shape, or None for error rows."""
+    if row.get("error") is not None or not isinstance(
+            row.get("value"), (int, float)):
+        return None
+    cfg = row.get("config") or {}
+    return {"name": row["name"], "value": float(row["value"]),
+            "platform": _row_platform(row, round_platform),
+            "validation_only": bool(cfg.get("validation_only", False))}
+
+
+def recover_rows(text: str) -> tuple[dict | None, list[dict]]:
+    """(headline document or None, matrix row dicts) recovered from raw
+    bench output text — including a front-truncated tail."""
+    docs = _scan_objects(text, _METRIC_DOC)
+    headline = docs[0] if docs else None
+    rows = [r for r in _scan_objects(text, _NAME_ROW)
+            if isinstance(r.get("name"), str)
+            and ("value" in r or "error" in r)]
+    if headline is not None:
+        # the full document embeds the matrix rows (the scan re-finds them
+        # as separate matches): keep the document's copy, don't double-count
+        names = {e.get("name") for e in headline.get("matrix") or []}
+        rows = list(headline.get("matrix") or ()) \
+            + [r for r in rows if r["name"] not in names]
+    return headline, rows
+
+
+def load_round(path: str) -> dict:
+    """One history file as ``{label, path, rc, platform, rows, skipped,
+    recovered}`` — ``rows`` keyed by row name (the parsed document when the
+    driver captured one, else whatever the truncated tail still holds)."""
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    label = os.path.splitext(os.path.basename(path))[0]
+    if isinstance(doc, dict) and "tail" in doc and "rc" in doc:
+        rc = doc.get("rc")
+        parsed = doc.get("parsed")
+        recovered = False
+        if parsed and (parsed.get("matrix") or parsed.get("value")):
+            headline, raw_rows = parsed, list(parsed.get("matrix") or ())
+        else:
+            headline, raw_rows = recover_rows(doc.get("tail") or "")
+            recovered = True
+    else:           # a raw `python bench.py` output document
+        rc, recovered = 0, False
+        headline, raw_rows = doc, list(doc.get("matrix") or ())
+    round_platform = "unknown"
+    if headline is not None:
+        round_platform = (headline.get("config") or {}).get(
+            "platform", "unknown") or "unknown"
+    if round_platform == "unknown":
+        for r in raw_rows:
+            p = _row_platform(r, "unknown")
+            # a mesh row's platform is the virtual CPU mesh, not the
+            # round's chip — never promote it to the round default
+            # (pre-PR4 rounds carried the platform without the
+            # validation_only marker, hence the devices guard too)
+            cfg = r.get("config") or {}
+            if p != "unknown" and not cfg.get("validation_only") \
+                    and not cfg.get("devices"):
+                round_platform = p
+                break
+    rows: dict = {}
+    skipped: list = []
+    if headline is not None and isinstance(headline.get("value"),
+                                           (int, float)):
+        rows["headline"] = {
+            "name": "headline", "value": float(headline["value"]),
+            "platform": (headline.get("config") or {}).get(
+                "platform", round_platform),
+            "validation_only": False}
+    for raw in raw_rows:
+        norm = _normalize_row(raw, round_platform)
+        if norm is None:
+            skipped.append({"name": raw.get("name"),
+                            "error": raw.get("error")})
+            continue
+        rows[norm["name"]] = norm
+    return {"label": label, "path": path, "rc": rc,
+            "platform": round_platform, "rows": rows, "skipped": skipped,
+            "recovered": recovered}
+
+
+def default_history_paths(root: str | None = None) -> list[str]:
+    """The committed ``BENCH_r*.json`` trajectory, oldest first."""
+    import glob
+    if root is None:
+        root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+    return sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+
+
+def load_history(paths: list[str] | None = None) -> list[dict]:
+    return [load_round(p) for p in (paths if paths is not None
+                                    else default_history_paths())]
+
+
+def _comparable(a_platform: str, b_platform: str) -> bool:
+    # "unknown" (pre-provenance rounds) matches anything: the committed
+    # history is a single fleet's trajectory, and refusing to compare
+    # would un-gate most of it.  Two KNOWN platforms must agree.
+    if "unknown" in (a_platform, b_platform):
+        return True
+    return a_platform == b_platform
+
+
+def compare(current: dict, priors: list[dict], *,
+            default_tolerance: float = DEFAULT_TOLERANCE,
+            row_tolerances: dict | None = None,
+            include_validation: bool = False) -> dict:
+    """ONE report document comparing ``current`` round against the best
+    comparable row anywhere in ``priors``.  ``ok`` is False iff any
+    GATING row regressed past its tolerance; validation-only rows gate
+    only with ``include_validation``."""
+    tol_map = dict(DEFAULT_ROW_TOLERANCES)
+    tol_map.update(row_tolerances or {})
+    report_rows: list = []
+    regressed = improved = new = ok_count = 0
+    for name in sorted(current["rows"]):
+        row = current["rows"][name]
+        tolerance = tol_map.get(name, default_tolerance)
+        best = None
+        best_round = None
+        for prior in priors:
+            cand = prior["rows"].get(name)
+            if cand is None or not _comparable(row["platform"],
+                                               cand["platform"]):
+                continue
+            if best is None or cand["value"] > best:
+                best, best_round = cand["value"], prior["label"]
+        gating = include_validation or not row["validation_only"]
+        entry = {"name": name, "value": row["value"],
+                 "platform": row["platform"],
+                 "validation_only": row["validation_only"],
+                 "tolerance": tolerance, "gating": gating,
+                 "best_prior": best, "best_prior_round": best_round}
+        if best is None:
+            entry["status"] = "new"
+            entry["ratio"] = None
+            new += 1
+        else:
+            ratio = row["value"] / best
+            entry["ratio"] = ratio
+            if ratio < 1.0 - tolerance:
+                entry["status"] = "regressed"
+                entry["code"] = PERF_REGRESSION
+                entry["detail"] = (
+                    f"{name}: {row['value']:.3g} amps/s is "
+                    f"{(1.0 - ratio):.1%} below the best comparable prior "
+                    f"{best:.3g} ({best_round}); tolerance {tolerance:.0%}")
+                regressed += 1
+            elif ratio > 1.0 + tolerance:
+                entry["status"] = "improved"
+                improved += 1
+            else:
+                entry["status"] = "ok"
+                ok_count += 1
+        report_rows.append(entry)
+    gating_regressions = [r for r in report_rows
+                          if r["status"] == "regressed" and r["gating"]]
+    return {
+        "metric": "bench_compare",
+        "current": current["label"],
+        "history": [p["label"] for p in priors],
+        "default_tolerance": default_tolerance,
+        "rows": report_rows,
+        "summary": {
+            "rows": len(report_rows),
+            "regressed": regressed,
+            "gating_regressions": len(gating_regressions),
+            "improved": improved, "ok": ok_count, "new": new,
+            "unrecoverable_prior_rounds": [p["label"] for p in priors
+                                           if not p["rows"]],
+        },
+        "ok": not gating_regressions,
+    }

@@ -1,0 +1,223 @@
+"""Serve SLO monitor: windowed latency, deadline hit rate, burn rate.
+
+The metrics registry (serve/metrics.py) keeps *cumulative* truth — total
+requests, lifetime latency percentiles — which is the wrong shape for the
+question an operator of a pod-scale deployment actually asks: "is the
+service meeting its deadline objective NOW, and if not, how fast is it
+burning the error budget?"  A lifetime p99 dilutes a live incident into
+noise; a deadline counter says how many were ever missed, not whether the
+miss *rate* is accelerating.  This module keeps the windowed view:
+
+- **Per-structural-class latency** over a sliding window: a single global
+  histogram would let one heavy class (a 22q mesh circuit) mask a latency
+  cliff in a cheap one (an 8q QFT class) — per-class p50/p99/max is the
+  resolution the class-affinity router of ROADMAP item 1 will balance on.
+- **Deadline hit rate**: of the requests that carried a ``deadline_ms``,
+  the windowed fraction that met it.  Requests without deadlines are
+  tracked for latency but do not consume error budget (no objective was
+  stated for them).
+- **Queue saturation**: depth / max_queue sampled at every admission, with
+  the window peak — the early load-shedding signal, since ``E_QUEUE_FULL``
+  bounces only start after saturation has already hit 1.0.
+- **Burn rate** (the SRE early-warning form): with objective ``target``
+  (default 0.999 of deadline'd requests meeting their deadline), the error
+  budget is ``1 - target``; the burn rate over window ``W`` is
+
+      burn(W) = miss_rate(W) / (1 - target)
+
+  i.e. 1.0 means the budget is being consumed exactly as fast as the
+  objective allows; ``burn_warn`` (default 10) over the short window emits
+  an ``O_SLO_BURN`` warning entry — alongside PR 7's ``O_MODEL_DRIFT`` in
+  the analysis severity taxonomy — long before the monthly budget is gone.
+  Both a short window (default 60 s: fast detection) and a long window
+  (default 600 s: smooths batch-boundary blips) are reported; the warning
+  keys off the short window, the long one is the page-worthiness context.
+
+Everything is computed on read (``snapshot()``): the request hot path pays
+one lock + deque append per completed request (asserted < 20 us/observe in
+tests/test_obs.py — the PR 7 < 1% serve-bench overhead budget covers it),
+and stays dependency-free like the rest of ``quest_tpu.obs``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+__all__ = ["SLOConfig", "SLOMonitor", "SLO_BURN",
+           "nearest_rank_percentile"]
+
+#: the burn-rate warning code (analysis severity: WARNING), next to
+#: ledger.MODEL_DRIFT in the O_* observability taxonomy
+SLO_BURN = "O_SLO_BURN"
+
+#: sample retention cap — bounds memory on a long-running service the same
+#: way the flight ring and the metrics reservoir do
+_MAX_SAMPLES = 16384
+
+
+def nearest_rank_percentile(xs: list, q: float) -> float:
+    """Nearest-rank percentile over raw observations — THE percentile
+    definition of the whole serving surface (the metrics registry's
+    histogram summaries use it too, serve/metrics.py): one definition, so
+    a p99 from the cumulative registry and a p99 from an SLO window can
+    never disagree on method."""
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    idx = min(len(xs) - 1, max(0, round(q / 100.0 * (len(xs) - 1))))
+    return xs[int(idx)]
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOConfig:
+    """The objective and its windows.  ``deadline_hit_target`` is the SLO
+    proper (fraction of deadline-carrying requests that must meet their
+    deadline); ``window_s``/``long_window_s`` are the burn-rate windows;
+    ``burn_warn`` is the short-window burn rate that raises ``O_SLO_BURN``;
+    ``saturation_warn`` raises a warning entry when the window-peak queue
+    saturation crosses it (load shedding is near)."""
+    deadline_hit_target: float = 0.999
+    window_s: float = 60.0
+    long_window_s: float = 600.0
+    burn_warn: float = 10.0
+    saturation_warn: float = 0.8
+
+
+class SLOMonitor:
+    """Thread-safe windowed SLO state.  ``observe``/``observe_queue`` are
+    the hot-path writers; ``snapshot()`` computes the windowed view and
+    ``gauges()`` flattens it for the shared Prometheus scrape."""
+
+    def __init__(self, config: SLOConfig | None = None):
+        self.config = config if config is not None else SLOConfig()
+        self._lock = threading.Lock()
+        # (t_mono, class_key, latency_s, deadline_ok: bool | None)
+        self._samples: list = []
+        # (t_mono, depth / capacity)
+        self._saturation: list = []
+        self.deadline_misses_total = 0
+        self.deadline_hits_total = 0
+
+    # -- recording ----------------------------------------------------------
+    def observe(self, class_key: str, latency_s: float,
+                deadline_ok: bool | None = None,
+                now: float | None = None) -> None:
+        """One completed (or deadline-dropped) request.  ``deadline_ok`` is
+        None when the request carried no deadline — it is tracked for
+        latency but consumes no error budget."""
+        t = time.monotonic() if now is None else now
+        with self._lock:
+            self._samples.append((t, class_key, float(latency_s),
+                                  deadline_ok))
+            if deadline_ok is True:
+                self.deadline_hits_total += 1
+            elif deadline_ok is False:
+                self.deadline_misses_total += 1
+            if len(self._samples) > _MAX_SAMPLES:
+                del self._samples[:_MAX_SAMPLES // 2]
+
+    def observe_queue(self, depth: int, capacity: int,
+                      now: float | None = None) -> None:
+        """Queue depth at one admission (or bounce), as a saturation
+        fraction of the bounded queue."""
+        t = time.monotonic() if now is None else now
+        frac = depth / capacity if capacity else 1.0
+        with self._lock:
+            self._saturation.append((t, frac))
+            if len(self._saturation) > _MAX_SAMPLES:
+                del self._saturation[:_MAX_SAMPLES // 2]
+
+    # -- reading ------------------------------------------------------------
+    def _burn(self, samples: list, now: float, window: float) -> tuple:
+        """(hits, misses, hit_rate, burn_rate) over [now - window, now]."""
+        hits = misses = 0
+        for t, _ck, _lat, ok in samples:
+            if now - t > window or ok is None:
+                continue
+            if ok:
+                hits += 1
+            else:
+                misses += 1
+        total = hits + misses
+        hit_rate = hits / total if total else 1.0
+        budget = 1.0 - self.config.deadline_hit_target
+        burn = ((misses / total) / budget) if total and budget > 0 else 0.0
+        return hits, misses, hit_rate, burn
+
+    def snapshot(self, now: float | None = None) -> dict:
+        """The windowed SLO view: per-class latency over the short window,
+        deadline hit rate + burn rates, queue saturation, and the warning
+        entries (``O_SLO_BURN``) the early-warning contract is about."""
+        cfg = self.config
+        t = time.monotonic() if now is None else now
+        with self._lock:
+            samples = list(self._samples)
+            saturation = list(self._saturation)
+        classes: dict = {}
+        for ts, ck, lat, _ok in samples:
+            if t - ts <= cfg.window_s:
+                classes.setdefault(ck, []).append(lat)
+        class_view = {
+            ck: {"count": len(xs),
+                 "mean_s": sum(xs) / len(xs),
+                 "p50_s": nearest_rank_percentile(xs, 50.0),
+                 "p99_s": nearest_rank_percentile(xs, 99.0),
+                 "max_s": max(xs)}
+            for ck, xs in sorted(classes.items())
+        }
+        h_s, m_s, rate_s, burn_s = self._burn(samples, t, cfg.window_s)
+        h_l, m_l, rate_l, burn_l = self._burn(samples, t, cfg.long_window_s)
+        sat_window = [f for ts, f in saturation if t - ts <= cfg.window_s]
+        sat_now = saturation[-1][1] if saturation else 0.0
+        sat_peak = max(sat_window) if sat_window else sat_now
+        warnings: list = []
+        if burn_s >= cfg.burn_warn:
+            warnings.append({
+                "code": SLO_BURN,
+                "detail": (f"deadline error budget burning {burn_s:.1f}x "
+                           f"sustainable over the last {cfg.window_s:.0f}s "
+                           f"({m_s} miss(es) / {h_s + m_s} deadline'd "
+                           f"request(s); long-window burn {burn_l:.1f}x): "
+                           f"the {cfg.deadline_hit_target:.3%} objective "
+                           "fails if this holds")})
+        if sat_peak >= cfg.saturation_warn:
+            warnings.append({
+                "code": SLO_BURN,
+                "detail": (f"queue saturation peaked at {sat_peak:.2f} in "
+                           f"the last {cfg.window_s:.0f}s (warn at "
+                           f"{cfg.saturation_warn:.2f}): E_QUEUE_FULL "
+                           "bounces are imminent")})
+        return {
+            "target": cfg.deadline_hit_target,
+            "window_s": cfg.window_s,
+            "long_window_s": cfg.long_window_s,
+            "classes": class_view,
+            "deadline": {
+                "window_hits": h_s, "window_misses": m_s,
+                "hit_rate": rate_s,
+                "long_hit_rate": rate_l,
+                "burn_rate": burn_s,
+                "long_burn_rate": burn_l,
+                "hits_total": self.deadline_hits_total,
+                "misses_total": self.deadline_misses_total,
+            },
+            "queue": {"saturation": sat_now, "peak_saturation": sat_peak},
+            "warnings": warnings,
+        }
+
+    def gauges(self, now: float | None = None) -> dict:
+        """Flat numeric view for the shared Prometheus scrape
+        (``quest_serve_slo_*``); one scrape covers serving economics,
+        tracing health AND the live SLO."""
+        snap = self.snapshot(now=now)
+        return {
+            "deadline_hit_rate": snap["deadline"]["hit_rate"],
+            "deadline_misses_total": snap["deadline"]["misses_total"],
+            "burn_rate": snap["deadline"]["burn_rate"],
+            "long_burn_rate": snap["deadline"]["long_burn_rate"],
+            "queue_saturation": snap["queue"]["saturation"],
+            "queue_peak_saturation": snap["queue"]["peak_saturation"],
+            "burn_warnings": float(len(snap["warnings"])),
+        }

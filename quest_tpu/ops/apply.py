@@ -277,7 +277,12 @@ def _expand_matrix(u: jax.Array, plan: _Plan, dtype) -> jax.Array:
     u = u.astype(dtype)
     if plan.fold_pattern is not None:
         dim = 1 << (plan.fold_k + plan.fold_c)
-        off = plan.fold_pattern << plan.fold_k
+        # int32 start indices: with x64 on, a bare Python int lowers as an
+        # s64 constant that the SPMD partitioner compares against its own
+        # s32 shard arithmetic — jaxlib 0.4.36's HLO verifier rejects the
+        # mixed compare AFTER partitioning (s64[] vs s32[]), killing every
+        # sharded minor-control gate (the dist8 suite)
+        off = jnp.int32(plan.fold_pattern << plan.fold_k)
         ur = jax.lax.dynamic_update_slice(jnp.eye(dim, dtype=dtype), u[0], (off, off))
         ui = jax.lax.dynamic_update_slice(jnp.zeros((dim, dim), dtype=dtype), u[1], (off, off))
         u = jnp.stack([ur, ui])
@@ -295,7 +300,9 @@ def _expand_diag(d: jax.Array, plan: _Plan, dtype) -> jax.Array:
     d = d.astype(dtype)
     if plan.fold_pattern is not None:
         dim = 1 << (plan.fold_k + plan.fold_c)
-        off = plan.fold_pattern << plan.fold_k
+        # int32 start index — same partitioner s64/s32 story as
+        # _expand_matrix above
+        off = jnp.int32(plan.fold_pattern << plan.fold_k)
         dr = jax.lax.dynamic_update_slice(jnp.ones(dim, dtype=dtype), d[0], (off,))
         di = jax.lax.dynamic_update_slice(jnp.zeros(dim, dtype=dtype), d[1], (off,))
         d = jnp.stack([dr, di])

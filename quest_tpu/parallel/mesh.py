@@ -16,10 +16,59 @@ DCN-adjacent one.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AMPS_AXIS = "amps"
+
+
+def process_info() -> dict:
+    """``{"process_index", "process_count"}`` of the live JAX runtime —
+    the stamp every cross-process artifact (trace shards, checkpoints)
+    carries.  Falls back to the single-process identity (0 of 1) when JAX
+    is not importable/initialised, so observability exports never fail for
+    lack of a distributed runtime."""
+    try:
+        import jax
+        return {"process_index": int(jax.process_index()),
+                "process_count": int(jax.process_count())}
+    except Exception:
+        return {"process_index": 0, "process_count": 1}
+
+
+def broadcast_host_epoch() -> tuple[float, float]:
+    """``(base_epoch_s, local_offset_s)``: process 0's epoch clock is
+    broadcast to every process (the same ``multihost_utils``
+    ``broadcast_one_to_all`` pattern ``seed_quest_default`` uses for the
+    reference's seed bcast), and each process estimates its own host-clock
+    offset against it as ``midpoint(local before, local after) - base``.
+
+    The midpoint bounds the estimate's error by half the broadcast's
+    round-trip — microseconds on ICI, milliseconds on DCN — which is enough
+    to line request spans up across host tracks in one merged trace
+    (obs/aggregate.py).  Single-process: ``(time.time(), 0.0)`` with no
+    collective (the degenerate merge must not require a distributed
+    runtime).  Multi-process this is a COLLECTIVE: every process must call
+    it, like any other broadcast.
+
+    Backends that cannot run cross-process collectives at all (the pinned
+    jaxlib's CPU backend — docs/DESIGN.md "Known stack regressions")
+    degrade to offset 0.0 rather than raise: observability must never be
+    the thing that kills a run, and on an NTP-synced fleet the raw epoch
+    clocks are already close."""
+    if process_info()["process_count"] <= 1:
+        return time.time(), 0.0
+    try:
+        from jax.experimental import multihost_utils
+        t_before = time.time()
+        base = float(multihost_utils.broadcast_one_to_all(
+            np.asarray([time.time()], np.float64))[0])
+        t_after = time.time()
+        return base, 0.5 * (t_before + t_after) - base
+    except Exception:
+        return time.time(), 0.0
 
 
 def make_amps_mesh(devices) -> Mesh:

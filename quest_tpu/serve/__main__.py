@@ -5,8 +5,10 @@ three single-device structural classes plus, on an 8+-device backend, a
 scheduled mesh class — asserting bit-identical results against the eager
 oracle, a >= 0.9 cache hit rate and a well-formed Prometheus export, then
 printing the metrics.  ``--json`` switches stdout to ONE machine-readable
-document (``{"ok":, "checks":, "metrics":, "prometheus":}``) for the CI
-gate.  Exit status 0 iff every check passed.
+document (``{"ok":, "checks":, "metrics":, "prometheus":,
+"flight_recorder":, "slo":}`` plus ``"trace"`` under ``--trace``: the
+merged multi-track Chrome trace from obs/aggregate.py) for the CI gate.
+Exit status 0 iff every check passed.
 """
 
 from __future__ import annotations

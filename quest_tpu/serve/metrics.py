@@ -54,11 +54,10 @@ class _Histogram:
             del self.raw[:_RESERVOIR_CAP // 2]
 
     def percentile(self, q: float) -> float:
-        if not self.raw:
-            return 0.0
-        xs = sorted(self.raw)
-        idx = min(len(xs) - 1, max(0, round(q / 100.0 * (len(xs) - 1))))
-        return xs[int(idx)]
+        # the one shared percentile definition (obs/slo.py): the registry's
+        # lifetime p99 and an SLO window's p99 must never differ on method
+        from ..obs.slo import nearest_rank_percentile
+        return nearest_rank_percentile(self.raw, q)
 
     def summary(self) -> dict:
         mean = self.total / self.count if self.count else 0.0
@@ -109,8 +108,13 @@ class Metrics:
 
     def to_prometheus(self, extra_gauges: dict | None = None) -> str:
         """The Prometheus text exposition format.  ``extra_gauges`` lets the
-        service splice point-in-time values (cache stats snapshot) into the
-        same scrape without them living in the registry."""
+        service splice point-in-time values into the same scrape without
+        them living in the registry — the ONE-scrape contract
+        (docs/OBSERVABILITY.md): ``QuESTService.prometheus()`` splices the
+        cache snapshot (``cache_*``), the tracing/ledger/flight counters
+        (``obs_*``) and the windowed SLO view (``slo_*`` — hit rate, burn
+        rates, queue saturation from quest_tpu/obs/slo.py) next to the
+        cumulative registry families."""
         with self._lock:
             counters = dict(self._counters)
             gauges = dict(self._gauges)

@@ -82,12 +82,18 @@ def run_selftest(as_json: bool = False, scale: int = 1,
 
     ``trace=True`` (or ``QUEST_TPU_TRACE=1``) records the whole run through
     the span recorder (quest_tpu/obs): the JSON document then carries the
-    exported Chrome-trace under ``"trace"`` and a ``trace_valid`` check
-    gates the export's schema — every execution span linked to its
-    request_id with class key / engine / cache outcome, zero orphans (the
-    ci.yml ``obs-selftest`` contract).  The flight-recorder ring is
-    included under ``"flight_recorder"`` unconditionally — it is always
-    on."""
+    exported Chrome-trace under ``"trace"`` — produced through the
+    CROSS-PROCESS merge path (obs/aggregate.py: this process's shard,
+    merged; the degenerate single-process merge is the identity, so the
+    document is byte-equal to the direct export while exercising the
+    multi-host pipeline CI gates on) — and a ``trace_valid`` check gates
+    the extended export schema: every execution span linked to its
+    request_id with class key / engine / cache outcome, zero orphans
+    across processes (the ci.yml ``obs-selftest`` contract).  The
+    flight-recorder ring (``"flight_recorder"``) and the windowed SLO view
+    (``"slo"``: per-class latency, deadline hit rate + burn rate, queue
+    saturation — obs/slo.py) are included unconditionally — both are
+    always on."""
     import os
 
     import jax
@@ -115,20 +121,34 @@ def run_selftest(as_json: bool = False, scale: int = 1,
     checks: dict = {}
     ok = True
 
+    from ..obs.slo import SLOConfig
+    # a wide SLO window: the correctness verification below (mesh class,
+    # serial + eager oracles) runs for minutes on a slow CI host, and the
+    # windowed per-class view must still hold the workload's samples when
+    # the slo_clean gate reads it
     svc = QuESTService(max_batch=16, max_delay_ms=10, seed=_SEED,
-                       cache=cache, start=False)
+                       cache=cache, slo=SLOConfig(window_s=3600.0),
+                       start=False)
     submitted = []  # (label, circuit, shots, future)
     classes = workload_classes(scale)
-    # interleave classes round-robin: the aggregator must re-group them
+    # interleave classes round-robin: the aggregator must re-group them.
+    # The qft8 class carries a (generous) deadline so the SLO monitor's
+    # deadline-hit-rate / burn-rate path is exercised by the gate, not
+    # just the no-objective latency path.
     longest = max(len(cs) for _, cs, _ in classes)
     for i in range(longest):
         for label, circuits, shots in classes:
             if i < len(circuits):
+                deadline = 600_000.0 if label == "qft8" else None
                 submitted.append((label, circuits[i], shots,
-                                  svc.submit(circuits[i], shots=shots)))
+                                  svc.submit(circuits[i], shots=shots,
+                                             deadline_ms=deadline)))
     svc.start()
     drained = svc.drain(timeout=600)
     ok &= _check(checks, "drain", drained, "queue drained within timeout")
+    # snapshot the SLO view NOW, while the drained workload is fresh in
+    # the window; this one snapshot is the document's "slo" block
+    slo = svc.slo.snapshot()
 
     # mesh class through the PR 2 scheduler (composition proof)
     mesh_pair = None
@@ -218,10 +238,38 @@ def run_selftest(as_json: bool = False, scale: int = 1,
         ok &= _check(checks, "prometheus_parses", False, str(exc))
 
     metrics = svc.metrics_dict()
+    # ONE snapshot serves both homes (metrics_dict re-snapshots on every
+    # call; two point-in-time copies in one document would just invite
+    # diff-hunting between them)
+    metrics["slo"] = slo
     flight = svc.flight_recorder.snapshot()
+
+    # the windowed SLO view (obs/slo.py): the default workload must show a
+    # clean objective — every deadline'd request met it (the qft8 class
+    # carried one), zero budget burn, no O_SLO_BURN warnings
+    ok &= _check(checks, "slo_clean",
+                 slo["deadline"]["hit_rate"] == 1.0
+                 and slo["deadline"]["burn_rate"] == 0.0
+                 and slo["deadline"]["hits_total"] > 0
+                 and not slo["warnings"] and slo["classes"],
+                 f"hit rate {slo['deadline']['hit_rate']:.3f} over "
+                 f"{slo['deadline']['hits_total']} deadline'd request(s), "
+                 f"burn {slo['deadline']['burn_rate']:.2f}, "
+                 f"{len(slo['classes'])} windowed class(es), "
+                 f"{len(slo['warnings'])} warning(s)")
+
     trace_doc = None
     if trace:
-        trace_doc = _obs.chrome_trace()
+        # export THROUGH the cross-process merge (obs/aggregate.py): the
+        # single-process degenerate merge is the identity, asserted here,
+        # so the CI gate exercises the multi-host path on every run
+        direct = _obs.chrome_trace()
+        trace_doc = _obs.merge_shards([_obs.process_shard()])
+        ok &= _check(checks, "trace_merge_identity",
+                     json.dumps(trace_doc, default=float)
+                     == json.dumps(direct, default=float),
+                     "single-process merged trace byte-equals the direct "
+                     "export")
         problems = _obs.validate_chrome_trace(trace_doc)
         exec_spans = [e for e in trace_doc["traceEvents"]
                       if e.get("name") == "serve.request"]
@@ -234,7 +282,7 @@ def run_selftest(as_json: bool = False, scale: int = 1,
     svc.shutdown()
     if as_json:
         doc = {"ok": bool(ok), "checks": checks, "metrics": metrics,
-               "prometheus": prom, "flight_recorder": flight}
+               "prometheus": prom, "flight_recorder": flight, "slo": slo}
         if trace_doc is not None:
             doc["trace"] = trace_doc
         print(json.dumps(doc, default=float))

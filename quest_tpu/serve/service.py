@@ -38,6 +38,7 @@ from .. import circuit as _circ
 from .. import obs as _obs
 from ..obs.export import EXECUTION_SPAN
 from ..obs.flight import FlightRecorder
+from ..obs.slo import SLOConfig, SLOMonitor
 from ..rng import MT19937
 from ..validation import ErrorCode, MESSAGES, QuESTError
 from . import batch as _batch
@@ -72,6 +73,7 @@ class _Request:
     future: Future
     enqueue_t: float
     group_key: tuple
+    class_key: str = ""             # obs.key_hash(group_key), for SLO/trace
 
 
 class QuESTService:
@@ -93,7 +95,9 @@ class QuESTService:
                  batch_mode: str = "map",
                  cache: CompileCache | None = None,
                  metrics: Metrics | None = None,
-                 flight_capacity: int = 256, start: bool = True):
+                 flight_capacity: int = 256,
+                 slo: SLOMonitor | SLOConfig | None = None,
+                 start: bool = True):
         if batch_mode not in ("map", "vmap"):
             raise ValueError(
                 f"batch_mode must be 'map' or 'vmap', got {batch_mode!r}")
@@ -113,8 +117,13 @@ class QuESTService:
         self._cache = cache if cache is not None else global_cache()
         self.metrics = metrics if metrics is not None else Metrics()
         # flight recorder (quest_tpu/obs/flight.py): the bounded ring of
-        # recent request records dumped on E_QUEUE_FULL / execution error
+        # recent request records dumped on E_QUEUE_FULL / deadline drops /
+        # execution errors
         self.flight_recorder = FlightRecorder(capacity=flight_capacity)
+        # SLO monitor (quest_tpu/obs/slo.py): windowed per-class latency,
+        # deadline hit rate and burn-rate early warning — always on, like
+        # the metrics registry (one deque append per completed request)
+        self.slo = slo if isinstance(slo, SLOMonitor) else SLOMonitor(slo)
         self._batch_seq = 0
         self._reject_seq = 0
         self._sharding = None
@@ -250,11 +259,15 @@ class QuESTService:
                 self._next_rid += 1
                 self._queue.append(_Request(rid, ops, circuit.num_qubits,
                                             pvec, shots, deadline, state0,
-                                            fut, now, group_key))
+                                            fut, now, group_key, class_key))
                 depth = len(self._queue)
                 self.metrics.inc("requests_submitted_total")
                 self.metrics.set_gauge("queue_depth", depth)
                 self._cond.notify_all()
+        # saturation is sampled on EVERY admission attempt, bounces
+        # included: the gauge must rise before E_QUEUE_FULL starts, not
+        # first appear in the post-mortem
+        self.slo.observe_queue(depth, self.max_queue)
         if rid is None:
             # backpressure is the flight recorder's headline moment: record
             # the bounce and dump the ring for the post-mortem
@@ -328,12 +341,16 @@ class QuESTService:
     def _execute(self, group: list, batch_id: int = 0) -> None:
         now = time.monotonic()
         live = []
+        deadline_drops = 0
         for req in group:
             if req.deadline is not None and now > req.deadline:
                 self.metrics.inc("deadline_expired_total")
                 self.flight_recorder.resolve(req.rid, "deadline",
                                              batch_id=batch_id,
                                              wait_s=now - req.enqueue_t)
+                self.slo.observe(req.class_key, now - req.enqueue_t,
+                                 deadline_ok=False)
+                deadline_drops += 1
                 self._fail(req, QuESTError(
                     ErrorCode.DEADLINE_EXCEEDED,
                     MESSAGES[ErrorCode.DEADLINE_EXCEEDED], "submit"))
@@ -343,6 +360,13 @@ class QuESTService:
                 continue        # caller cancelled before execution: drop
             else:
                 live.append(req)
+        if deadline_drops:
+            # deadline expiry is as much a "something is wrong NOW" moment
+            # as a queue bounce (the queue sat long enough to eat a tenant's
+            # whole budget): dump the ring once on the first drop in a batch
+            # so the post-mortem shows what the co-queued requests were
+            # doing, without a storm of drops producing a storm of dumps
+            self.flight_recorder.dump(ErrorCode.DEADLINE_EXCEEDED)
         if not live:
             return
         completed: set = set()
@@ -413,8 +437,19 @@ class QuESTService:
                 self.metrics.inc("requests_completed_total")
                 self.metrics.observe("request_latency_seconds",
                                      done_t - req.enqueue_t)
+                # windowed SLO sample: deadline_ok=None when no deadline
+                # was stated (latency tracked, no error budget consumed).
+                # A deadline'd request only HITS if it completed IN TIME —
+                # admission-time enforcement lets a request that was
+                # admitted punctually still finish late, and counting that
+                # as a hit would blind the burn-rate warning to exactly
+                # the slow-execution incidents it exists for
+                self.slo.observe(req.class_key, done_t - req.enqueue_t,
+                                 deadline_ok=done_t <= req.deadline
+                                 if req.deadline is not None else None)
         except Exception as exc:  # noqa: BLE001 — forwarded to the futures
             failed = 0
+            fail_t = time.monotonic()
             for req in live:
                 if req.rid in completed:
                     continue    # delivered before the failure: outcome ok
@@ -422,6 +457,13 @@ class QuESTService:
                 self.flight_recorder.resolve(
                     req.rid, f"error:{type(exc).__name__}",
                     batch_id=batch_id)
+                if req.deadline is not None:
+                    # a failed deadline'd request did not meet its
+                    # objective: burn budget, or a crash-loop outage reads
+                    # as a 1.0 hit rate while every request dies
+                    self.slo.observe(req.class_key,
+                                     fail_t - req.enqueue_t,
+                                     deadline_ok=False)
                 self._fail(req, exc)
             self.flight_recorder.dump(f"error:{type(exc).__name__}")
             self.metrics.inc("requests_failed_total", failed)
@@ -452,6 +494,7 @@ class QuESTService:
         d["cache"] = self._cache.snapshot()
         d["cache_hit_rate"] = d["cache"]["hit_rate"]
         d["obs"] = self._obs_gauges()
+        d["slo"] = self.slo.snapshot()
         return d
 
     def _obs_gauges(self) -> dict:
@@ -468,4 +511,5 @@ class QuESTService:
         extra = {f"cache_{k}": v for k, v in cache.items()
                  if isinstance(v, (int, float))}
         extra.update({f"obs_{k}": v for k, v in self._obs_gauges().items()})
+        extra.update({f"slo_{k}": v for k, v in self.slo.gauges().items()})
         return self.metrics.to_prometheus(extra_gauges=extra)

@@ -118,6 +118,19 @@ print("SEEDWORKER" + str(pid) + " OUTCOMES=" + "".join(map(str, outcomes)))
 """
 
 
+#: jaxlib 0.4.36 cannot run cross-process computations on the CPU backend
+#: ("Multiprocess computations aren't implemented on the CPU backend"):
+#: both workers execute sharded programs over the 2-process global mesh,
+#: so the whole scenario is stack-blocked — see docs/DESIGN.md "Known
+#: stack regressions".  strict=False: a jaxlib restoring multi-process
+#: CPU collectives turns these back into plain passes.
+_MULTIPROC_CPU_XFAIL = pytest.mark.xfail(
+    reason="multi-process CPU collectives unimplemented in jaxlib 0.4.36 "
+           "— see docs/DESIGN.md 'Known stack regressions'",
+    strict=False)
+
+
+@_MULTIPROC_CPU_XFAIL
 @pytest.mark.skipif(sys.platform != "linux", reason="needs local TCP coordinator")
 def test_two_process_default_seed_broadcast(tmp_path):
     """Both processes, seeded only by the DEFAULT path, must draw identical
@@ -153,6 +166,7 @@ def test_two_process_default_seed_broadcast(tmp_path):
     assert seqs[0] == seqs[1], f"divergent outcome streams: {seqs}"
 
 
+@_MULTIPROC_CPU_XFAIL
 @pytest.mark.skipif(sys.platform != "linux", reason="needs local TCP coordinator")
 def test_two_process_distributed_checkpoint(tmp_path):
     with socket.socket() as s:

@@ -1,0 +1,296 @@
+"""Cross-process trace aggregation (quest_tpu/obs/aggregate.py):
+
+- the DEGENERATE contract: merging the single shard of a single-process
+  run is the identity — byte-identical JSON to ``chrome_trace()``;
+- clock-skew alignment: spans recording the same epoch instant on hosts
+  with (synthetically) skewed clocks land on the same merged timestamp,
+  property-tested over random skews/offsets;
+- REAL two-process merge à la tests/test_multihost.py: two OS processes
+  under one ``jax.distributed`` coordinator each record + save a shard,
+  and the merged document carries a track per process, globally-unique
+  namespaced span ids, zero orphans across processes, and request spans
+  correlated by the shared ``request_id`` — validated by the extended
+  ``validate_chrome_trace``;
+- the extended validator itself: cross-process parent links, undeclared
+  process tracks and missing process metadata are each a reported problem.
+
+The workers do NOT run cross-process computations: the pinned jaxlib's
+CPU backend cannot (docs/DESIGN.md "Known stack regressions"), which is
+also why ``broadcast_host_epoch`` degrades to offset 0.0 there — the
+degradation path is itself exercised by the worker calling the default
+``align_clock=True``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from quest_tpu import obs
+from quest_tpu.obs import aggregate
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def traced():
+    obs.enable_tracing()
+    obs.reset_tracing()
+    yield obs.recorder()
+    obs.disable_tracing()
+    obs.reset_tracing()
+
+
+# ---------------------------------------------------------------------------
+# degenerate single-process merge
+# ---------------------------------------------------------------------------
+
+def test_single_process_merge_is_byte_identical(traced):
+    with obs.request(3):
+        with obs.span("outer", phase="x"):
+            with obs.span("inner"):
+                pass
+    obs.emit_span("retro", t0=time.perf_counter(), dur=0.25, request_id=4)
+    direct = obs.chrome_trace()
+    merged = aggregate.merge_shards([aggregate.process_shard()])
+    assert json.dumps(merged, sort_keys=False) \
+        == json.dumps(direct, sort_keys=False)
+    assert obs.validate_chrome_trace(merged) == []
+
+
+def test_shard_save_load_roundtrip(traced, tmp_path):
+    with obs.span("s"):
+        pass
+    path = str(tmp_path / "shard.json")
+    written = aggregate.save_shard(path)
+    loaded = aggregate.load_shard(path)
+    assert loaded == json.loads(json.dumps(written))  # JSON-stable
+    assert loaded["format"] == aggregate.SHARD_FORMAT
+    assert loaded["process_index"] == 0 and loaded["process_count"] == 1
+    assert loaded["clock_offset_s"] == 0.0  # single-process: no broadcast
+    # merging from files == merging in memory
+    assert aggregate.merge_files([path]) == aggregate.merge_shards([loaded])
+    with pytest.raises(ValueError, match="not a quest-tpu-trace-shard"):
+        bad = tmp_path / "bad.json"
+        bad.write_text("{}")
+        aggregate.load_shard(str(bad))
+
+
+# ---------------------------------------------------------------------------
+# clock-skew alignment
+# ---------------------------------------------------------------------------
+
+def _synthetic_shard(pindex, *, t0_perf, t0_epoch, offset, spans):
+    """A hand-built shard: ``spans`` is [(name, t0_perf_s, dur, rid)]."""
+    return {"format": aggregate.SHARD_FORMAT,
+            "process_index": pindex, "process_count": 2,
+            "host": f"host{pindex}", "t0_perf": t0_perf,
+            "t0_epoch": t0_epoch, "clock_offset_s": offset,
+            "dropped": 0,
+            "spans": [{"name": name, "span_id": i + 1, "parent_id": None,
+                       "request_id": rid, "t0": t0, "dur": dur,
+                       "thread": "MainThread", "attrs": {}}
+                      for i, (name, t0, dur, rid) in enumerate(spans)]}
+
+
+def test_clock_skew_alignment_property():
+    """Two hosts record the same wall-clock instant; whatever the skew
+    between their clocks, the merged timestamps agree (to float noise)
+    once each shard's broadcast-estimated offset is applied."""
+    import random
+    rng = random.Random(7)
+    for _ in range(50):
+        # ground truth: an event happens at true epoch instant T
+        T = 1.7e9 + rng.uniform(0, 1e6)
+        skew = rng.uniform(-300.0, 300.0)       # host1's clock error
+        # process 0: clock exact; trace origin a bit before T
+        t0_epoch_0 = T - rng.uniform(0.1, 5.0)
+        sh0 = _synthetic_shard(
+            0, t0_perf=rng.uniform(0, 1e4), t0_epoch=t0_epoch_0, offset=0.0,
+            spans=[("evt", 0.0, 0.001, 9)])
+        sh0["spans"][0]["t0"] = sh0["t0_perf"] + (T - t0_epoch_0)
+        # process 1: its epoch clock reads true+skew; the broadcast
+        # estimated exactly that skew as its offset
+        t0_epoch_1_local = (T + skew) - rng.uniform(0.1, 5.0)
+        sh1 = _synthetic_shard(
+            1, t0_perf=rng.uniform(0, 1e4), t0_epoch=t0_epoch_1_local,
+            offset=skew, spans=[("evt", 0.0, 0.001, 9)])
+        sh1["spans"][0]["t0"] = sh1["t0_perf"] \
+            + ((T + skew) - t0_epoch_1_local)
+        doc = aggregate.merge_shards([sh0, sh1])
+        evts = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        assert len(evts) == 2
+        ts = sorted(e["ts"] for e in evts)
+        # both tracks place the instant at the same merged microsecond
+        # (float noise: the epoch numbers are ~1e9 s and ts is in us)
+        assert abs(ts[1] - ts[0]) < 1.0, (skew, ts)
+        assert obs.validate_chrome_trace(doc) == []
+
+
+def test_merge_two_shards_tracks_and_namespacing():
+    sh0 = _synthetic_shard(0, t0_perf=0.0, t0_epoch=100.0, offset=0.0,
+                           spans=[("a", 0.5, 0.1, 1), ("b", 0.7, 0.1, None)])
+    sh1 = _synthetic_shard(1, t0_perf=50.0, t0_epoch=100.2, offset=0.2,
+                           spans=[("a", 50.5, 0.1, 1)])
+    doc = aggregate.merge_shards([sh1, sh0])       # order must not matter
+    assert obs.validate_chrome_trace(doc) == []
+    assert doc["otherData"]["processes"] == [0, 1]
+    assert doc["otherData"]["clock_offsets_s"] == {"0": 0.0, "1": 0.2}
+    evts = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert {e["pid"] for e in evts} == {1, 2}
+    # namespaced ids stay globally unique; process 0 keeps raw ids
+    ids = [e["args"]["span_id"] for e in evts]
+    assert len(set(ids)) == 3
+    p0_ids = [e["args"]["span_id"] for e in evts if e["pid"] == 1]
+    assert p0_ids == [1, 2]
+    # request correlation across tracks: the shared request_id survives
+    rid1 = [e for e in evts if e["args"]["request_id"] == 1]
+    assert {e["pid"] for e in rid1} == {1, 2}
+    # both "a" spans recorded the same aligned instant (100.5 on process
+    # 0's clock): same merged ts across tracks
+    a_ts = [e["ts"] for e in evts if e["name"] == "a"]
+    assert abs(a_ts[0] - a_ts[1]) < 1e-6
+    # process metadata names both tracks
+    metas = [e for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"]
+    assert {m["pid"] for m in metas} == {1, 2}
+    with pytest.raises(ValueError, match="two shards claim"):
+        aggregate.merge_shards([sh0, sh0])
+
+
+# ---------------------------------------------------------------------------
+# extended validator
+# ---------------------------------------------------------------------------
+
+def test_validator_rejects_cross_process_parent():
+    sh0 = _synthetic_shard(0, t0_perf=0.0, t0_epoch=100.0, offset=0.0,
+                           spans=[("root", 0.5, 0.1, None)])
+    sh1 = _synthetic_shard(1, t0_perf=0.0, t0_epoch=100.0, offset=0.0,
+                           spans=[("child", 0.6, 0.1, None)])
+    doc = aggregate.merge_shards([sh0, sh1])
+    # hand-corrupt: the process-1 span claims the process-0 root as parent
+    child = [e for e in doc["traceEvents"]
+             if e.get("ph") == "X" and e["pid"] == 2][0]
+    child["args"]["parent_id"] = 1
+    problems = obs.validate_chrome_trace(doc)
+    assert any("across process tracks" in p for p in problems)
+
+
+def test_validator_enforces_declared_process_contract():
+    sh0 = _synthetic_shard(0, t0_perf=0.0, t0_epoch=100.0, offset=0.0,
+                           spans=[("a", 0.5, 0.1, None)])
+    sh1 = _synthetic_shard(1, t0_perf=0.0, t0_epoch=100.0, offset=0.0,
+                           spans=[("b", 0.6, 0.1, None)])
+    doc = aggregate.merge_shards([sh0, sh1])
+    # an event on a track nobody declared
+    stray = dict(doc["traceEvents"][-1])
+    stray = {**stray, "pid": 9,
+             "args": {**stray["args"], "span_id": 777}}
+    doc2 = {**doc, "traceEvents": doc["traceEvents"] + [stray]}
+    assert any("undeclared process track" in p
+               for p in obs.validate_chrome_trace(doc2))
+    # a declared process with its name meta stripped
+    doc3 = {**doc, "traceEvents": [
+        e for e in doc["traceEvents"]
+        if not (e.get("ph") == "M" and e.get("name") == "process_name"
+                and e.get("pid") == 2)]}
+    assert any("no process_name meta" in p
+               for p in obs.validate_chrome_trace(doc3))
+    # a declared process with no clock offset recorded
+    doc4 = json.loads(json.dumps(doc))
+    del doc4["otherData"]["clock_offsets_s"]["1"]
+    assert any("no clock offset" in p
+               for p in obs.validate_chrome_trace(doc4))
+
+
+# ---------------------------------------------------------------------------
+# REAL two-process capture (a la tests/test_multihost.py)
+# ---------------------------------------------------------------------------
+
+AGG_WORKER = r"""
+import os, sys, time
+pid = int(sys.argv[1]); port = sys.argv[2]; out = sys.argv[3]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ.pop("JAX_PLATFORMS", None)
+sys.path.insert(0, @REPO@)
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address="127.0.0.1:" + port,
+                           num_processes=2, process_id=pid)
+assert jax.process_count() == 2
+
+import jax.numpy as jnp
+from quest_tpu import obs
+from quest_tpu.obs import aggregate
+
+obs.enable_tracing()
+obs.reset_tracing()
+# request 7 is served across BOTH processes (the multi-replica routing
+# shape): each process records its own execution spans under the same
+# request_id, plus one private local-work span.  Device work stays
+# process-local: the pinned jaxlib cannot run cross-process CPU
+# computations (docs/DESIGN.md "Known stack regressions").
+with obs.request(7):
+    with obs.span("serve.request_part", process=pid):
+        x = jnp.arange(8.0) * (pid + 1)
+        float(x.sum())
+with obs.span("local.work", process=pid):
+    time.sleep(0.01)
+# align_clock=True exercises broadcast_host_epoch: on this stack the CPU
+# broadcast degrades to offset 0.0 instead of raising
+shard = aggregate.save_shard(out)
+assert shard["process_index"] == pid and shard["process_count"] == 2
+print("AGGWORKER%d OK spans=%d offset=%r"
+      % (pid, len(shard["spans"]), shard["clock_offset_s"]))
+"""
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="needs local TCP coordinator")
+def test_two_process_capture_merges_into_one_trace(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    src = tmp_path / "agg_worker.py"
+    src.write_text(AGG_WORKER.replace("@REPO@", repr(REPO)))
+    shards = [str(tmp_path / f"shard{p}.json") for p in (0, 1)]
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen([sys.executable, str(src), str(p), str(port),
+                          shards[p]],
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True, cwd=REPO, env=env)
+        for p in (0, 1)
+    ]
+    for p_i, proc in enumerate(procs):
+        try:
+            out, err = proc.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("aggregation workers timed out (coordinator hang?)")
+        assert proc.returncode == 0, \
+            f"worker {p_i} failed\nstdout:\n{out}\nstderr:\n{err[-2000:]}"
+        assert f"AGGWORKER{p_i} OK" in out
+
+    doc = aggregate.merge_files(shards)
+    assert obs.validate_chrome_trace(doc) == []
+    assert doc["otherData"]["processes"] == [0, 1]
+    evts = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert {e["pid"] for e in evts} == {1, 2}          # a track per process
+    assert len({e["args"]["span_id"] for e in evts}) == len(evts) == 4
+    # the cross-process request: both tracks carry request 7's spans
+    parts = [e for e in evts if e["name"] == "serve.request_part"]
+    assert {e["args"]["request_id"] for e in parts} == {7}
+    assert {e["pid"] for e in parts} == {1, 2}
+    # same host, both offsets 0.0: the two capture windows overlap, so the
+    # aligned timelines must too (a gross misalignment would separate them
+    # by the ~seconds of process startup skew)
+    assert abs(parts[0]["ts"] - parts[1]["ts"]) < 60e6

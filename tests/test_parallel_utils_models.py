@@ -224,6 +224,11 @@ def test_distributed_qft_example_runs():
     assert "8 x cpu devices" in r.stdout or "tpu devices" in r.stdout
 
 
+@pytest.mark.xfail(
+    reason="multi-process CPU collectives unimplemented in jaxlib 0.4.36 "
+           "(the rehearsal's seed broadcast is the first to hit it) — see "
+           "docs/DESIGN.md 'Known stack regressions'",
+    strict=False)
 def test_multihost_example_rehearsal():
     """examples/multihost_example.py --rehearse: the pod submission-script
     code path (jax.distributed.initialize + one env over the global mesh)

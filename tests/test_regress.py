@@ -1,0 +1,191 @@
+"""Perf-regression ledger (quest_tpu/obs/regress.py + bench.py --compare):
+
+- row recovery from the REAL committed BENCH_r0*.json history — including
+  the driver-wrapped rounds whose only payload is a front-truncated output
+  tail (r03-r05) and the timeout round with no payload at all (r01);
+- the gate semantics: exit 0 on the real history, nonzero on an injected
+  25% regression of a headline row (the acceptance contract, also wired
+  as the CI ``bench-regress`` job's self-test), per-row tolerance
+  overrides, platform comparability, and validation-only rows reporting
+  without gating;
+- the CLI (``python bench.py --compare``) end to end via compare_main.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import pytest
+
+from quest_tpu.obs import regress
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)                   # for `import bench`
+
+
+def _round(label, rows, platform="tpu"):
+    return {"label": label, "path": label, "rc": 0, "platform": platform,
+            "rows": {r["name"]: r for r in rows}, "skipped": [],
+            "recovered": False}
+
+
+def _row(name, value, platform="tpu", validation_only=False):
+    return {"name": name, "value": float(value), "platform": platform,
+            "validation_only": validation_only}
+
+
+# ---------------------------------------------------------------------------
+# loading the real committed history
+# ---------------------------------------------------------------------------
+
+def test_real_history_rows_recovered():
+    hist = regress.load_history()
+    assert [h["label"] for h in hist] == [
+        "BENCH_r01", "BENCH_r02", "BENCH_r03", "BENCH_r04", "BENCH_r05"]
+    by = {h["label"]: h for h in hist}
+    assert by["BENCH_r01"]["rows"] == {}          # the rc=124 timeout round
+    assert by["BENCH_r01"]["rc"] == 124
+    assert by["BENCH_r02"]["rows"]["headline"]["value"] == pytest.approx(
+        5.43e10, rel=0.01)
+    # r03-r05 carry only truncated tails; the scan recovers the suffix
+    assert by["BENCH_r03"]["recovered"]
+    assert len(by["BENCH_r03"]["rows"]) >= 8
+    assert by["BENCH_r05"]["rows"]["qft_30q_f32_public_api"]["value"] \
+        == pytest.approx(2.59e11, rel=0.01)
+    # the CPU-mesh validation row is marked and platform-resolved
+    r5shard = by["BENCH_r05"]["rows"]["qft_20q_f32_cpu8shard"]
+    assert r5shard["platform"] == "cpu" and r5shard["validation_only"]
+
+
+def test_real_history_gate_passes_and_injection_fails():
+    """The acceptance pair: the committed r01-r05 trajectory holds no
+    gating regression; scaling a headline row by 0.75 (a 25% regression)
+    flips the gate."""
+    hist = regress.load_history()
+    current, priors = hist[-1], hist[:-1]
+    report = regress.compare(current, priors)
+    assert report["ok"], [r for r in report["rows"]
+                          if r["status"] == "regressed"]
+    assert report["summary"]["unrecoverable_prior_rounds"] == ["BENCH_r01"]
+    # inject: 25% off a headline row that HAS a comparable prior
+    hist2 = regress.load_history()
+    hist2[-1]["rows"]["qft_28q_f32_inplace_ordered"]["value"] *= 0.75
+    bad = regress.compare(hist2[-1], hist2[:-1])
+    assert not bad["ok"]
+    (reg,) = [r for r in bad["rows"] if r["status"] == "regressed"]
+    assert reg["name"] == "qft_28q_f32_inplace_ordered"
+    assert reg["code"] == regress.PERF_REGRESSION
+    assert reg["gating"]
+
+
+def test_recover_rows_from_truncated_text():
+    full = json.dumps({
+        "metric": "m", "value": 1.0, "config": {"platform": "tpu"},
+        "matrix": [{"name": "a", "value": 2.0, "config": {}},
+                   {"name": "b", "value": 3.0, "config": {}}]})
+    headline, rows = regress.recover_rows(full)
+    assert headline["value"] == 1.0
+    assert [r["name"] for r in rows] == ["a", "b"]
+    # front truncation mid-object: the broken first row is dropped, the
+    # complete suffix survives — never invented, never doubled
+    cut = full[full.find('"name": "a"') + 5:]
+    headline2, rows2 = regress.recover_rows(cut)
+    assert headline2 is None
+    assert [r["name"] for r in rows2] == ["b"]
+    assert regress.recover_rows("no json here") == (None, [])
+
+
+def test_load_round_accepts_raw_bench_document(tmp_path):
+    doc = {"metric": "m", "value": 5e9, "config": {"platform": "cpu"},
+           "matrix": [{"name": "x", "value": 1e9, "config": {}},
+                      {"name": "broken", "error": "boom"}]}
+    p = tmp_path / "run.json"
+    p.write_text(json.dumps(doc))
+    rnd = regress.load_round(str(p))
+    assert rnd["platform"] == "cpu"
+    assert rnd["rows"]["headline"]["value"] == 5e9
+    assert rnd["rows"]["x"]["platform"] == "cpu"    # round default applied
+    assert rnd["skipped"] == [{"name": "broken", "error": "boom"}]
+
+
+# ---------------------------------------------------------------------------
+# compare semantics
+# ---------------------------------------------------------------------------
+
+def test_tolerance_default_and_per_row_override():
+    prior = _round("r1", [_row("a", 100.0), _row("b", 100.0)])
+    cur = _round("r2", [_row("a", 79.0), _row("b", 79.0)])
+    rep = regress.compare(cur, [prior])
+    assert not rep["ok"]                       # 21% > 20% default
+    assert {r["name"]: r["status"] for r in rep["rows"]} \
+        == {"a": "regressed", "b": "regressed"}
+    rep2 = regress.compare(cur, [prior], row_tolerances={"a": 0.3, "b": 0.3})
+    assert rep2["ok"]
+    rep3 = regress.compare(cur, [prior], default_tolerance=0.25)
+    assert rep3["ok"]
+    # the built-in noisy-row defaults (docs/OBSERVABILITY.md table)
+    noisy = regress.compare(
+        _round("r2", [_row("serve_vqe_16q_batch64", 65.0)]),
+        [_round("r1", [_row("serve_vqe_16q_batch64", 100.0)])])
+    assert noisy["ok"]                         # 35% < the 40% override
+    assert noisy["rows"][0]["tolerance"] == pytest.approx(0.40)
+
+
+def test_best_comparable_prior_across_rounds_and_platforms():
+    priors = [
+        _round("r1", [_row("a", 120.0)]),      # the best prior: r1, not r2
+        _round("r2", [_row("a", 90.0), _row("cpu_only", 50.0, "cpu")]),
+    ]
+    cur = _round("r3", [_row("a", 100.0), _row("cpu_only", 10.0, "tpu")])
+    rep = regress.compare(cur, priors)
+    a = [r for r in rep["rows"] if r["name"] == "a"][0]
+    assert a["best_prior"] == 120.0 and a["best_prior_round"] == "r1"
+    assert a["status"] == "ok"                 # 100/120 = 0.83 within 20%
+    # a tpu row never gates against a cpu prior: no comparable prior = new
+    c = [r for r in rep["rows"] if r["name"] == "cpu_only"][0]
+    assert c["status"] == "new" and c["best_prior"] is None
+    # unknown platform is a wildcard (the pre-provenance rounds)
+    rep2 = regress.compare(
+        _round("r3", [_row("a", 50.0, platform="unknown")]),
+        [_round("r1", [_row("a", 100.0)])])
+    assert not rep2["ok"]
+
+
+def test_validation_only_rows_report_but_do_not_gate():
+    prior = _round("r1", [_row("mesh", 100.0, "cpu", validation_only=True)])
+    cur = _round("r2", [_row("mesh", 40.0, "cpu", validation_only=True)])
+    rep = regress.compare(cur, [prior])
+    assert rep["ok"]                           # reported, not gating
+    assert rep["rows"][0]["status"] == "regressed"
+    assert not rep["rows"][0]["gating"]
+    assert rep["summary"]["gating_regressions"] == 0
+    strict = regress.compare(cur, [prior], include_validation=True)
+    assert not strict["ok"]
+
+
+# ---------------------------------------------------------------------------
+# the CLI
+# ---------------------------------------------------------------------------
+
+def test_compare_cli_end_to_end(tmp_path, capsys):
+    import bench
+    out = tmp_path / "report.json"
+    rc = bench.compare_main(["--compare", "--out", str(out)])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["metric"] == "bench_compare" and doc["ok"]
+    assert json.loads(out.read_text()) == doc    # the CI artifact
+    # the self-test flag: inject a 25% regression, the gate must fail
+    rc = bench.compare_main(["--compare", "--inject",
+                             "qft_28q_f32_inplace_ordered=0.75"])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert not doc["ok"]
+    (reg,) = [r for r in doc["rows"] if r["status"] == "regressed"]
+    assert reg["name"] == "qft_28q_f32_inplace_ordered"
+    # unknown row name in --inject is a usage error, not a silent pass
+    with pytest.raises(SystemExit):
+        bench.compare_main(["--compare", "--inject", "nope=0.5"])
+    capsys.readouterr()

@@ -1,0 +1,265 @@
+"""Serve SLO monitor (quest_tpu/obs/slo.py) + its service wiring:
+
+- the burn-rate formula (miss_rate / error budget) over both windows,
+  window aging, and the O_SLO_BURN / saturation warning triggers — all on
+  injected timestamps so the math is checked exactly;
+- per-class windowed latency views;
+- QuESTService integration: deadline-carrying requests feed the hit rate,
+  a deadline drop burns budget AND dumps the flight ring with reason
+  E_DEADLINE_EXCEEDED (the PR 8 satellite regression: deadline drops
+  previously left no dump), metrics_dict()["slo"] and the single
+  Prometheus scrape carry the gauges;
+- the hot-path overhead budget: observe() stays microseconds-cheap (the
+  PR 7 < 1% serve-bench budget covers the always-on monitor).
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from quest_tpu.obs.slo import SLO_BURN, SLOConfig, SLOMonitor
+from quest_tpu.serve import CompileCache, QuESTService
+from quest_tpu.serve.metrics import parse_prometheus
+from quest_tpu.serve.selftest import vqe_ansatz
+from quest_tpu.validation import QuESTError
+
+
+def _monitor(**kw):
+    return SLOMonitor(SLOConfig(**kw))
+
+
+# ---------------------------------------------------------------------------
+# the formula, on injected clocks
+# ---------------------------------------------------------------------------
+
+def test_burn_rate_formula_exact():
+    """target 0.99 -> budget 0.01; 2 misses in 10 deadline'd requests is
+    miss_rate 0.2 -> burn 20x.  No-deadline samples don't touch budget."""
+    m = _monitor(deadline_hit_target=0.99, window_s=60, long_window_s=600,
+                 burn_warn=10.0)
+    t0 = 1000.0
+    for i in range(8):
+        m.observe("ckA", 0.010, deadline_ok=True, now=t0 + i)
+    for i in range(2):
+        m.observe("ckA", 0.500, deadline_ok=False, now=t0 + 8 + i)
+    for i in range(5):
+        m.observe("ckB", 0.001, deadline_ok=None, now=t0 + i)  # no budget
+    snap = m.snapshot(now=t0 + 10)
+    d = snap["deadline"]
+    assert d["window_hits"] == 8 and d["window_misses"] == 2
+    assert d["hit_rate"] == pytest.approx(0.8)
+    assert d["burn_rate"] == pytest.approx(0.2 / 0.01)        # 20x
+    assert d["long_burn_rate"] == pytest.approx(20.0)
+    assert d["hits_total"] == 8 and d["misses_total"] == 2
+    # burn 20 >= burn_warn 10: the early warning fires with the numbers
+    burn_warns = [w for w in snap["warnings"]
+                  if "error budget" in w["detail"]]
+    assert len(burn_warns) == 1 and burn_warns[0]["code"] == SLO_BURN
+    assert "20.0x" in burn_warns[0]["detail"]
+
+
+def test_windows_age_out_and_long_window_keeps_context():
+    m = _monitor(deadline_hit_target=0.9, window_s=60, long_window_s=600)
+    t0 = 5000.0
+    m.observe("ck", 0.1, deadline_ok=False, now=t0)            # old miss
+    m.observe("ck", 0.1, deadline_ok=True, now=t0 + 120)       # recent hit
+    snap = m.snapshot(now=t0 + 130)
+    assert snap["deadline"]["window_misses"] == 0              # aged out
+    assert snap["deadline"]["hit_rate"] == 1.0
+    assert snap["deadline"]["long_hit_rate"] == pytest.approx(0.5)
+    assert snap["deadline"]["long_burn_rate"] == pytest.approx(5.0)
+    assert snap["warnings"] == []        # short window clean: no page
+    # totals never age (the cumulative truth stays in the counters)
+    assert snap["deadline"]["misses_total"] == 1
+    # with NO deadline'd samples at all, the objective trivially holds
+    empty = _monitor().snapshot(now=0.0)
+    assert empty["deadline"]["hit_rate"] == 1.0
+    assert empty["deadline"]["burn_rate"] == 0.0
+
+
+def test_per_class_windowed_latency():
+    m = _monitor(window_s=60)
+    t0 = 100.0
+    for i in range(100):
+        m.observe("fast", 0.001 * (i + 1), now=t0)
+    m.observe("slow", 2.0, now=t0)
+    m.observe("gone", 9.0, now=t0 - 120)          # outside the window
+    snap = m.snapshot(now=t0 + 1)
+    assert set(snap["classes"]) == {"fast", "slow"}
+    fast = snap["classes"]["fast"]
+    assert fast["count"] == 100
+    assert fast["p50_s"] == pytest.approx(0.050, abs=0.002)
+    assert fast["p99_s"] == pytest.approx(0.099, abs=0.002)
+    assert fast["max_s"] == pytest.approx(0.100)
+    assert snap["classes"]["slow"]["count"] == 1
+
+
+def test_queue_saturation_gauge_and_warning():
+    m = _monitor(window_s=60, saturation_warn=0.8)
+    t0 = 10.0
+    m.observe_queue(10, 100, now=t0)
+    snap = m.snapshot(now=t0 + 1)
+    assert snap["queue"]["saturation"] == pytest.approx(0.1)
+    assert snap["warnings"] == []
+    m.observe_queue(90, 100, now=t0 + 2)          # peak crosses the line
+    m.observe_queue(20, 100, now=t0 + 3)
+    snap = m.snapshot(now=t0 + 4)
+    assert snap["queue"]["saturation"] == pytest.approx(0.2)   # latest
+    assert snap["queue"]["peak_saturation"] == pytest.approx(0.9)
+    sat_warns = [w for w in snap["warnings"] if "saturation" in w["detail"]]
+    assert len(sat_warns) == 1 and sat_warns[0]["code"] == SLO_BURN
+
+
+def test_gauges_flatten_for_prometheus():
+    m = _monitor(deadline_hit_target=0.99)
+    m.observe("ck", 0.1, deadline_ok=False, now=1.0)
+    g = m.gauges(now=2.0)
+    assert g["deadline_hit_rate"] == 0.0
+    assert g["burn_rate"] == pytest.approx(100.0)
+    assert g["burn_warnings"] >= 1.0
+    assert set(g) == {"deadline_hit_rate", "deadline_misses_total",
+                      "burn_rate", "long_burn_rate", "queue_saturation",
+                      "queue_peak_saturation", "burn_warnings"}
+
+
+def test_sample_store_is_bounded():
+    from quest_tpu.obs import slo as slo_mod
+    m = _monitor()
+    for i in range(slo_mod._MAX_SAMPLES + 10):
+        m.observe("ck", 0.001, now=float(i))
+        m.observe_queue(1, 10, now=float(i))
+    assert len(m._samples) <= slo_mod._MAX_SAMPLES
+    assert len(m._saturation) <= slo_mod._MAX_SAMPLES
+
+
+def test_observe_overhead_within_budget():
+    """The monitor is ALWAYS on: one observe per completed request must
+    stay microseconds-cheap.  Budget: < 20 us/call keeps 64 requests'
+    samples under 1.3 ms against the >= 1 s serve-bench batch wall — the
+    same < 1% envelope the PR 7 disabled-span contract lives in."""
+    m = _monitor()
+    reps = 20_000
+    t0 = time.perf_counter()
+    for i in range(reps):
+        m.observe("ck", 0.001, deadline_ok=True)
+    per_call = (time.perf_counter() - t0) / reps
+    assert per_call < 20e-6, f"observe costs {per_call * 1e6:.2f}us"
+
+
+# ---------------------------------------------------------------------------
+# service wiring
+# ---------------------------------------------------------------------------
+
+def _small_service(**kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_delay_ms", 5)
+    kw.setdefault("cache", CompileCache())
+    kw.setdefault("start", False)
+    return QuESTService(**kw)
+
+
+def test_service_slo_block_and_scrape():
+    svc = _small_service()
+    futs = [svc.submit(vqe_ansatz(5, 1, seed=s), deadline_ms=600_000)
+            for s in range(3)]
+    futs.append(svc.submit(vqe_ansatz(5, 1, seed=9)))   # no objective
+    svc.start()
+    assert svc.drain(timeout=300)
+    for f in futs:
+        f.result(timeout=60)
+    d = svc.metrics_dict()
+    slo = d["slo"]
+    assert slo["deadline"]["hits_total"] == 3
+    assert slo["deadline"]["hit_rate"] == 1.0
+    assert slo["deadline"]["burn_rate"] == 0.0
+    assert slo["warnings"] == []
+    # all four requests are the same structural class; the windowed class
+    # view carries its latency
+    (ck,) = slo["classes"]
+    assert slo["classes"][ck]["count"] == 4
+    assert slo["queue"]["peak_saturation"] > 0
+    parsed = parse_prometheus(svc.prometheus())
+    assert parsed["quest_serve_slo_deadline_hit_rate"][""] == 1.0
+    assert parsed["quest_serve_slo_burn_rate"][""] == 0.0
+    assert "quest_serve_slo_queue_saturation" in parsed
+    svc.shutdown()
+
+
+def test_late_completion_burns_budget_even_when_admitted_in_time():
+    """Admission-time deadline enforcement lets a punctually-admitted
+    request still FINISH late (the first request eats the class compile).
+    Wherever the lateness lands — dropped at admission or completed past
+    deadline — the SLO must record a miss; a hit would blind the
+    burn-rate warning to slow-execution incidents."""
+    import numpy as np
+    svc = _small_service(max_delay_ms=1)
+    # 30 ms deadline vs a cold-compile execution (hundreds of ms on CPU):
+    # the request is admitted almost immediately but cannot finish in time
+    fut = svc.submit(vqe_ansatz(5, 1, seed=0), deadline_ms=30)
+    svc.start()
+    assert svc.drain(timeout=300)
+    try:
+        res = fut.result(timeout=60)
+        assert isinstance(res.state, np.ndarray)   # late, but delivered
+    except QuESTError as err:                      # or dropped at admission
+        assert err.code == "E_DEADLINE_EXCEEDED"
+    slo = svc.metrics_dict()["slo"]
+    assert slo["deadline"]["misses_total"] == 1
+    assert slo["deadline"]["hits_total"] == 0
+    svc.shutdown()
+
+
+def test_execution_error_burns_budget_for_deadlined_requests():
+    """A deadline'd request that dies in a worker-side execution error
+    consumed its budget too — without this, a crash-loop outage reads as
+    a 1.0 hit rate while 100% of deadline'd requests fail."""
+    import numpy as np
+    n = 4
+    svc = _small_service()
+    fut = svc.submit(vqe_ansatz(n, 1, seed=0), shots=4,
+                     initial_state=np.zeros((2, 1 << n)),  # unnormalisable
+                     deadline_ms=600_000)
+    svc.start()
+    assert svc.drain(timeout=120)
+    assert isinstance(fut.exception(timeout=60), ValueError)
+    slo = svc.metrics_dict()["slo"]
+    assert slo["deadline"]["misses_total"] == 1
+    assert slo["deadline"]["hits_total"] == 0
+    svc.shutdown()
+
+
+def test_deadline_drop_burns_budget_and_dumps_flight_ring():
+    """The satellite regression: a deadline-exceeded request must (a) feed
+    the SLO monitor as a miss and (b) dump the flight ring with reason
+    E_DEADLINE_EXCEEDED — previously only E_QUEUE_FULL bounces and
+    execution errors dumped, so the most latency-shaped failure mode left
+    no post-mortem."""
+    svc = _small_service()
+    expired = [svc.submit(vqe_ansatz(5, 1, seed=s), deadline_ms=1)
+               for s in range(2)]
+    alive = svc.submit(vqe_ansatz(5, 1, seed=7), deadline_ms=600_000)
+    time.sleep(0.05)
+    svc.start()
+    assert svc.drain(timeout=300)
+    for f in expired:
+        with pytest.raises(QuESTError) as err:
+            f.result(timeout=60)
+        assert err.value.code == "E_DEADLINE_EXCEEDED"
+    assert alive.result(timeout=60).state is not None
+    # the flight ring dumped ONCE for the batch's drops (not once per
+    # drop), with the distinct deadline outcome on each dropped record
+    assert svc.flight_recorder.dumps == 1
+    dump = svc.flight_recorder.last_dump
+    assert dump["reason"] == "E_DEADLINE_EXCEEDED"
+    outcomes = [r["outcome"] for r in dump["records"]]
+    assert outcomes.count("deadline") == 2
+    # budget burned: 2 misses / 3 deadline'd requests
+    slo = svc.metrics_dict()["slo"]
+    assert slo["deadline"]["misses_total"] == 2
+    assert slo["deadline"]["hits_total"] == 1
+    assert slo["deadline"]["hit_rate"] == pytest.approx(1.0 / 3.0)
+    assert slo["deadline"]["burn_rate"] > 100     # way past sustainable
+    assert any(w["code"] == SLO_BURN for w in slo["warnings"])
+    svc.shutdown()
