@@ -90,6 +90,14 @@ def _provenance() -> dict:
             capture_output=True, text=True, timeout=10).stdout.strip()
     except Exception:
         prov["git_sha"] = ""
+    try:
+        # which cost-model constants this round's model columns used
+        # (obs/calibrate.py): the fitted profile's id, or "default"
+        from quest_tpu.obs import active_profile
+        prof = active_profile()
+        prov["calibration"] = "default" if prof is None else prof.profile_id
+    except Exception:
+        prov["calibration"] = "default"
     _PROVENANCE = prov
     return prov
 
@@ -111,15 +119,34 @@ def _roofline(num_amps: int, precision: int, passes: float,
             "hbm_peak_frac": round(gbps * 1e9 / HBM_PEAK_BYTES_PER_SEC, 4)}
 
 
+def _stamp_counters(cfg: dict, compile_seconds: float | None = None) -> dict:
+    """Fold the runtime counters (quest_tpu/obs/counters.py) into a row
+    config: the compile wall and — where the backend exposes
+    ``memory_stats()`` (TPU/GPU; the CPU backend reports none) — the live
+    HBM watermark.  ``--compare`` reports compile-time deltas from these
+    fields alongside amps/s; it never gates on them."""
+    from quest_tpu.obs import update_hbm_watermark
+    if compile_seconds is not None:
+        cfg["compile_seconds"] = compile_seconds
+    wm = update_hbm_watermark()
+    if wm is not None:
+        cfg["hbm_peak_bytes"] = wm["peak_bytes_in_use"]
+        cfg["hbm_bytes_in_use"] = wm["bytes_in_use"]
+    return cfg
+
+
 def _run_layered(ops_apply, state, depth, best_of=1):
     """(compute_seconds, norm, wall, overhead) — best of ``best_of`` timed
     runs of ONE compiled program (retries reuse the jitted function, so the
     only extra cost is the measured seconds; they defend against
     remote-tunnel run-to-run variance, observed up to ~15x on a bad
-    window)."""
+    window).  The compile+warm wall is kept as
+    ``_run_layered.last_compile_seconds`` (the bench.py attribute idiom,
+    cf. _run_config.last_exc) and recorded into the runtime counters."""
     import jax
     import jax.numpy as jnp
     from functools import partial
+    from quest_tpu.obs import record_compile
 
     @partial(jax.jit, static_argnames=())
     def run(s, iters):
@@ -128,7 +155,10 @@ def _run_layered(ops_apply, state, depth, best_of=1):
         s = jax.lax.fori_loop(0, iters, body, s)
         return jnp.sum(s[0] * s[0] + s[1] * s[1])
 
+    t0 = time.perf_counter()
     float(run(state, 1))  # compile + warm
+    _run_layered.last_compile_seconds = time.perf_counter() - t0
+    record_compile(_run_layered.last_compile_seconds)
     dts, overheads = [], []
     total = 0.0
     for _ in range(max(1, best_of)):
@@ -178,6 +208,7 @@ def bench_random(n, depth, precision, fuse, seed=11, best_of=1):
            "fused": fuse, "ops_per_layer": len(ops),
            "seconds": dt, "overhead_seconds": overhead}
     cfg.update(_roofline(1 << n, precision, len(ops) * depth, compute))
+    _stamp_counters(cfg, _run_layered.last_compile_seconds)
     return value, cfg
 
 
@@ -829,8 +860,12 @@ def bench_sched_pair(circuit, devices, depth=1):
         state_colls = sum(count_hlo_collectives(
             text, min_elems=(1 << n) // nd // 2).values())
         asyncs = count_hlo_async_collectives(text)
+        t0 = time.perf_counter()
         out = fn(state)
         out.block_until_ready()  # compile + warm
+        compile_s = time.perf_counter() - t0
+        from quest_tpu.obs import record_compile
+        record_compile(compile_s)
         best = None
         for _ in range(2):
             t0 = time.perf_counter()
@@ -845,6 +880,7 @@ def bench_sched_pair(circuit, devices, depth=1):
                          "hlo_state_collectives": state_colls,
                          "hlo_async_starts": asyncs["starts"],
                          "hlo_async_separated": asyncs["separated"],
+                         "compile_seconds": compile_s,
                          "ops": n_ops}
     un, sc = measured["unscheduled"], measured["scheduled"]
     ov = measured["overlapped"]
@@ -852,13 +888,17 @@ def bench_sched_pair(circuit, devices, depth=1):
     # model seconds + comm events of the SCHEDULED program next to its
     # measured wall and state-sized compiled collectives — wall drift only
     # judged on TPU platforms (the model is a TPU roofline)
+    from quest_tpu.obs import hbm_watermark
+    wm = hbm_watermark()
     drift = global_ledger().record(
         f"sched_pair_{n}q_x{nd}", engine="xla", num_devices=nd,
         platform=devices[0].platform,
         predicted_seconds=predicted["model_seconds_after"],
         measured_seconds=sc["seconds"],
         predicted_collectives=predicted["comm_events_after"],
-        measured_hlo_collectives=sc["hlo_state_collectives"])
+        measured_hlo_collectives=sc["hlo_state_collectives"],
+        compile_seconds=sc["compile_seconds"],
+        hbm_peak_bytes=(wm or {}).get("peak_bytes_in_use"))
     value = (1 << n) * len(circuit) * depth / sc["seconds"]
     cfg = {
         "qubits": n, "depth": depth, "precision": 1, "devices": nd,
@@ -900,6 +940,7 @@ def bench_sched_pair(circuit, devices, depth=1):
         "ops_unscheduled": un["ops"], "ops_scheduled": sc["ops"],
         "model_vs_measured": drift.as_dict(),
     }
+    _stamp_counters(cfg, sc["compile_seconds"])
     return value, cfg
 
 
@@ -942,6 +983,7 @@ def bench_auto_engine(circuit, n, iters=2, label="auto_engine"):
 
     state = jnp.zeros((2, 1 << n), jnp.float32).at[0, 0].set(1.0)
     compute_a, total, dt, overhead = _run_layered(run_auto, state, iters)
+    compile_s = _run_layered.last_compile_seconds
     assert abs(total - 1.0) < 1e-2, f"state not normalised: {total}"
     compute_x, total_x, _, _ = _run_layered(run_xla, state, iters)
     assert abs(total_x - 1.0) < 1e-2, f"state not normalised: {total_x}"
@@ -957,13 +999,17 @@ def bench_auto_engine(circuit, n, iters=2, label="auto_engine"):
         live_model = model["pallas_seconds"] * iters
     elif model.get("xla_seconds"):
         live_model = model["xla_seconds"] * iters
+    from quest_tpu.obs import hbm_watermark
+    wm = hbm_watermark()
     drift = global_ledger().record(
         f"auto_engine_{n}q", engine=run_auto.engine, num_devices=1,
         platform=jax.devices()[0].platform,
         predicted_seconds=live_model, measured_seconds=compute_a,
         predicted_hbm_passes=model.get("pallas_hbm_passes")
         if run_auto.engine == "pallas" else model.get("xla_hbm_passes"),
-        predicted_collectives=0, measured_hlo_collectives=0)
+        predicted_collectives=0, measured_hlo_collectives=0,
+        compile_seconds=compile_s,
+        hbm_peak_bytes=(wm or {}).get("peak_bytes_in_use"))
     cfg = {"qubits": n, "gates": gates, "iters": iters, "precision": 1,
            "model_vs_measured": drift.as_dict(),
            "engine_live": run_auto.engine,
@@ -981,6 +1027,7 @@ def bench_auto_engine(circuit, n, iters=2, label="auto_engine"):
     passes = (model.get("pallas_hbm_passes") or gates) \
         if run_auto.engine == "pallas" else gates
     cfg.update(_roofline(1 << n, 1, passes * iters, compute_a))
+    _stamp_counters(cfg, compile_s)
     return value, cfg
 
 
@@ -1053,7 +1100,11 @@ def bench_qft(n, precision=1, devices=None):
             "measured_hlo_by_kind": by_kind,
         }
 
+    t0 = time.perf_counter()
     float(run(state, 1))  # compile + warm
+    compile_s = time.perf_counter() - t0
+    from quest_tpu.obs import record_compile
+    record_compile(compile_s)
     float(run(state, 0))  # compile the overhead-probe variant too
     t0 = time.perf_counter()
     base = float(run(state, 0))
@@ -1066,6 +1117,7 @@ def bench_qft(n, precision=1, devices=None):
     value = (1 << n) * gates / compute
     cfg = {"qubits": n, "precision": precision, "gates": gates,
            "fused_ops": len(ops), "seconds": dt}
+    _stamp_counters(cfg, compile_s)
     if devices is None:
         # roofline fields only for single-chip runs — normalising a virtual
         # CPU-mesh run against the TPU's HBM peak would be meaningless

@@ -82,6 +82,11 @@ GROUPS = [
                                        "load_shard", "merge_shards",
                                        "merge_files",
                                        "SLOConfig", "SLOMonitor"]),
+    ("Calibration & runtime counters (quest_tpu.obs)",
+     ["CalibrationProfile", "run_calibration", "save_profile",
+      "load_profile", "validate_profile", "activate_calibration",
+      "deactivate_calibration", "active_profile", "use_profile",
+      "RuntimeCounters", "global_counters", "hbm_watermark"]),
 ]
 
 

@@ -31,7 +31,11 @@ from .obs import (TraceRecorder, FlightRecorder, Ledger,  # noqa: F401
                   chrome_trace, trace_report, global_ledger,
                   SLOConfig, SLOMonitor, process_shard, save_shard,
                   load_shard, merge_shards, merge_files,
-                  validate_chrome_trace)
+                  validate_chrome_trace,
+                  CalibrationProfile, run_calibration, save_profile,
+                  load_profile, validate_profile, activate_calibration,
+                  deactivate_calibration, active_profile, use_profile,
+                  RuntimeCounters, global_counters, hbm_watermark)
 
 __version__ = "0.1.0"
 __all__ = list(_api_all) + [
@@ -47,4 +51,8 @@ __all__ = list(_api_all) + [
     "global_ledger",
     "SLOConfig", "SLOMonitor", "process_shard", "save_shard", "load_shard",
     "merge_shards", "merge_files", "validate_chrome_trace",
+    "CalibrationProfile", "run_calibration", "save_profile",
+    "load_profile", "validate_profile", "activate_calibration",
+    "deactivate_calibration", "active_profile", "use_profile",
+    "RuntimeCounters", "global_counters", "hbm_watermark",
 ]

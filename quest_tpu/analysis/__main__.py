@@ -37,14 +37,28 @@ Modes (combinable; exit status is 1 iff any ERROR-severity diagnostic):
   the cache entry — any violation is ``A_PARAM_LIFT_DIVERGENCE``.  Audits
   the listed circuits, or the serve selftest workload when none are given.
 
+- ``--calibrate``: run the on-device calibration harness
+  (quest_tpu/obs/calibrate.py) on the live backend — per-gate XLA
+  appliers by qubit position class, Pallas epoch passes (interpret mode
+  off-TPU), collectives by payload bytes when a mesh is visible — fit
+  the planner's constants, write the versioned profile to
+  ``--calibration-out`` (default ``calibration_profile.json``), ACTIVATE
+  it for the rest of the invocation (so a combined ``--trace-report``
+  runs under the fitted band), and report which engine/placement
+  decisions flip under measured constants vs the hard-coded defaults.
+  ``--calibration PATH`` loads and activates an existing profile
+  instead (the deployment path: schedule/trace-report/serve decisions
+  under the fleet's own measured constants).
+
 Circuit modes run the IR pass and the eager/compiled abstract-eval pass
 against the deployment described by ``--devices/--precision/--chip``.
 
 ``--json`` switches stdout to ONE machine-readable JSON document —
 ``{"diagnostics": [...], "circuits": [...], "schedule": [...],
 "verify": [...], "serve_audit": [...], "trace_report": [...],
-"ledger": {...}, "summary": {...}}`` — so CI gates parse severities
-instead of grepping text.  Exit status is unchanged.
+"calibration": {...}, "ledger": {...}, "summary": {...}}`` — so CI
+gates parse severities instead of grepping text.  Exit status is
+unchanged.
 """
 
 from __future__ import annotations
@@ -183,6 +197,72 @@ def _verify_report(label: str, circuit, args, scheduled, echo) -> tuple:
     return report, found + d2 + d3 + d4 + d5
 
 
+def _calibrate_report(args, circuits, echo) -> dict:
+    """The ``--calibrate`` payload: run the harness, persist + activate
+    the profile, and report which engine/placement decisions flip under
+    the measured constants (the proof the planner is actually reading
+    them).  Engine decisions are scored on the TPU-class spec (the
+    deterministic dispatch rule); placement flips are reported when
+    ``--devices`` names a mesh."""
+    from ..obs import calibrate as _cal
+    from ..parallel import planner as _planner
+    from ..parallel.scheduler import greedy_placement
+
+    chip = _chip(args.chip)
+    profile = _cal.run_calibration(chip=chip)
+    doc = _cal.save_profile(profile, args.calibration_out)
+    _cal.activate(profile)
+    echo(f"calibration: profile {profile.profile_id} "
+         f"({profile.platform}/{profile.device_kind or '-'}) written to "
+         f"{args.calibration_out}; wall band "
+         f"[{profile.wall_band[0]:.3g}, {profile.wall_band[1]:.3g}]")
+
+    suite = list(circuits)
+    if not suite:
+        from ..circuit import qft_circuit, random_circuit
+        suite = [("qft(17)", qft_circuit(17)), ("qft(22)", qft_circuit(22)),
+                 ("random(20,3)", random_circuit(20, 3, seed=11))]
+    decisions = []
+    engine_flips = placement_flips = 0
+    for label, circuit in suite:
+        row: dict = {"label": label}
+        with _cal.use_profile(None):
+            base = _planner.select_engine(circuit, 1, chip, args.precision,
+                                          backend="tpu")
+        with _cal.use_profile(profile):
+            cal = _planner.select_engine(circuit, 1, chip, args.precision,
+                                         backend="tpu")
+        row["engine_default"] = base["engine"]
+        row["engine_calibrated"] = cal["engine"]
+        row["engine_flipped"] = base["engine"] != cal["engine"]
+        row["engine_reason_calibrated"] = cal["reason"]
+        # the decision's OWN provenance stamp (select_engine attaches it):
+        # the CI gate checks the profile id here, proving the decision was
+        # actually scored on the fitted constants
+        row["calibration"] = cal["calibration"]
+        engine_flips += row["engine_flipped"]
+        if args.devices > 1:
+            with _cal.use_profile(None):
+                sig0 = greedy_placement(circuit, args.devices, chip,
+                                        args.precision)
+            with _cal.use_profile(profile):
+                sig1 = greedy_placement(circuit, args.devices, chip,
+                                        args.precision)
+            row["placement_default"] = list(sig0)
+            row["placement_calibrated"] = list(sig1)
+            row["placement_flipped"] = sig0 != sig1
+            placement_flips += row["placement_flipped"]
+        decisions.append(row)
+        echo(f"{label}: engine {row['engine_default']} -> "
+             f"{row['engine_calibrated']}"
+             + (" (FLIPPED)" if row["engine_flipped"] else "")
+             + (f"; placement flipped: {row.get('placement_flipped')}"
+                if args.devices > 1 else ""))
+    return {"profile": doc, "path": args.calibration_out,
+            "decisions": decisions, "engine_flips": engine_flips,
+            "placement_flips": placement_flips}
+
+
 def _trace_report_run(label: str, circuit, args, echo) -> tuple:
     """The ``--trace-report`` payload for one circuit: compile it for the
     requested engine, execute it single-device with tracing on, and record
@@ -213,10 +293,14 @@ def _trace_report_run(label: str, circuit, args, echo) -> tuple:
             dtype = jnp.float32     # the epoch engine's envelope
         n = circuit.num_qubits
         state = jnp.zeros((2, 1 << n), dtype).at[0, 0].set(1.0)
+        t0 = time.perf_counter()
         jax.block_until_ready(run(state))          # compile + warm
+        compile_s = time.perf_counter() - t0
+        _obs.record_compile(compile_s)
         t0 = time.perf_counter()
         jax.block_until_ready(run(state))
         measured_s = time.perf_counter() - t0
+        hbm = _obs.update_hbm_watermark()          # None on CPU backends
         # compiled-HLO observation: the epoch engine traces with x64 off
         # (the Mosaic constraint, circuit.py), so its audit lowering must
         # run under the same flag or aval dtypes drift mid-trace
@@ -247,6 +331,8 @@ def _trace_report_run(label: str, circuit, args, echo) -> tuple:
             predicted_hbm_passes=passes,
             predicted_collectives=predicted_coll,
             measured_hlo_collectives=measured_coll,
+            compile_seconds=compile_s,
+            hbm_peak_bytes=(hbm or {}).get("peak_bytes_in_use"),
             warn=False)
         spans = _obs.recorder().spans()
         # the document stays MACHINE-readable end to end (the PR 3 --json
@@ -314,6 +400,23 @@ def main(argv=None) -> int:
                              "per-request/per-span report, and record a "
                              "model-vs-measured ledger row; ledger drift "
                              "is reported as O_MODEL_DRIFT (WARNING)")
+    parser.add_argument("--calibrate", action="store_true",
+                        help="run the on-device calibration harness "
+                             "(quest_tpu/obs/calibrate.py), write the "
+                             "fitted profile to --calibration-out, "
+                             "activate it for this invocation, and report "
+                             "which engine/placement decisions flip under "
+                             "measured constants")
+    parser.add_argument("--calibration", metavar="PATH",
+                        help="load + activate an existing calibration "
+                             "profile before any other mode runs (the "
+                             "planner then reads its fitted constants and "
+                             "the ledger checks walls against its band)")
+    parser.add_argument("--calibration-out", metavar="PATH",
+                        dest="calibration_out",
+                        default="calibration_profile.json",
+                        help="where --calibrate writes the profile "
+                             "(default %(default)s)")
     parser.add_argument("--overlap-chunks", type=int, default=None,
                         dest="overlap_chunks", metavar="C",
                         help="schedule with the pipelined executor's "
@@ -371,6 +474,19 @@ def main(argv=None) -> int:
         circuits.append((f"random({n},{depth})", random_circuit(n, depth)))
     for spec in args.circuit or ():
         circuits.append((spec, _load_circuit(spec)))
+
+    if args.calibration:
+        # load BEFORE any model runs: every schedule/engine/trace-report
+        # decision below is then scored on the profile's fitted constants
+        from ..obs import calibrate as _cal
+        prof = _cal.activate(_cal.load_profile(args.calibration))
+        echo(f"calibration: profile {prof.profile_id} loaded from "
+             f"{args.calibration} (age {prof.age_s():.0f}s"
+             + (", STALE" if prof.stale() else "") + ")")
+    if args.calibrate:
+        ran = True
+        doc["calibration"] = _calibrate_report(args, circuits, echo)
+
     for label, circuit in circuits:
         ran = True
         found = analyze_circuit(circuit, num_devices=args.devices,

@@ -576,16 +576,23 @@ def compile_circuit(circuit: Circuit, donate: bool = False,
 
     def traced(state: jax.Array) -> jax.Array:
         # free when tracing is off; an enabled run records a circuit.run
-        # span (and the matching XProf TraceAnnotation) around dispatch
+        # span (and the matching XProf TraceAnnotation) around dispatch,
+        # and folds the host-side dispatch wall into the runtime counters
+        # (obs/counters.py) so the scrape reports dispatch totals next to
+        # compile totals
         if not _obs.tracing_enabled():
             return inner(state)
         with _obs.span("circuit.run", engine=resolved,
-                       ops=len(circuit.ops)):
-            return inner(state)
+                       ops=len(circuit.ops)) as sp:
+            out = inner(state)
+        if sp is not None:
+            _obs.record_dispatch(sp.dur)
+        return out
 
     traced.engine = resolved
     traced.engine_reason = choice["reason"]
     traced.engine_plan = choice["plan"]
+    traced.engine_calibration = choice.get("calibration")
     return traced
 
 

@@ -38,6 +38,22 @@ PR 8 grew the layer from one process's eyes to the fleet's:
   gated row-by-row against the best comparable prior round
   (``python bench.py --compare``; the CI ``bench-regress`` job).
 
+PR 9 closed the cost-model loop:
+
+- ``calibrate.py``: the on-device calibration harness — times the real
+  execution primitives (per-gate appliers by qubit position class,
+  Pallas epoch passes, collectives by payload bytes), fits the
+  planner's constants from the measurements, and persists a versioned
+  **calibration profile** that ``planner.time_model`` /
+  ``select_engine`` / the scheduler's placement search load in place of
+  the hard-coded defaults (``analysis --calibrate``; the CI
+  ``calibrate-selftest`` job).  With a profile active the ledger checks
+  walls on ANY platform against the fitted residual band.
+- ``counters.py``: runtime counters — process-wide compile wall seconds,
+  dispatch walls, and the live-HBM watermark (``device.memory_stats()``)
+  — recorded into trace spans, ledger records, bench rows, and the one
+  Prometheus scrape (including calibration-staleness gauges).
+
 See docs/OBSERVABILITY.md.
 """
 
@@ -51,6 +67,14 @@ from .export import chrome_trace, trace_report, validate_chrome_trace  # noqa: F
 from .aggregate import (load_shard, merge_files, merge_shards,  # noqa: F401
                         process_shard, save_shard)
 from .slo import SLOConfig, SLOMonitor  # noqa: F401
+from .counters import (RuntimeCounters, global_counters, hbm_watermark,  # noqa: F401
+                       record_compile, record_dispatch,
+                       update_hbm_watermark)
+from .calibrate import (CalibrationProfile, active_profile,  # noqa: F401
+                        active_summary, activate as activate_calibration,
+                        deactivate as deactivate_calibration, load_profile,
+                        make_profile, run_calibration, save_profile,
+                        use_profile, validate_profile)
 from . import regress  # noqa: F401
 
 __all__ = [
@@ -63,5 +87,11 @@ __all__ = [
     "process_shard", "save_shard", "load_shard", "merge_shards",
     "merge_files",
     "SLOConfig", "SLOMonitor",
+    "RuntimeCounters", "global_counters", "record_compile",
+    "record_dispatch", "hbm_watermark", "update_hbm_watermark",
+    "CalibrationProfile", "run_calibration", "make_profile",
+    "save_profile", "load_profile", "validate_profile",
+    "activate_calibration", "deactivate_calibration", "active_profile",
+    "active_summary", "use_profile",
     "regress",
 ]

@@ -1,0 +1,141 @@
+"""Runtime counters: compile wall seconds, dispatch walls, HBM watermark.
+
+The predictive half of the cost loop (planner models, calibration
+profiles) is host arithmetic; this module is the cheap always-available
+measured half.  Three families, all dependency-free and thread-safe:
+
+- **Compile seconds.**  Every XLA compile the process pays — the serve
+  cache's AOT lowers (serve/cache.py ``_get_program``), the donated
+  program adapter, the calibration harness's own probes — folds its wall
+  seconds into one process-wide total, so "how much of this deployment's
+  wall is compilation" is one gauge, not a per-cache spelunk.
+- **Dispatch walls.**  Traced runs (``compile_circuit``'s ``circuit.run``
+  span) record their host-side dispatch wall here too, so the scrape can
+  report dispatch totals next to compile totals without replaying a trace.
+- **HBM watermark.**  :func:`hbm_watermark` reads the live backend's
+  ``device.memory_stats()`` (bytes in use + the allocator's peak) where
+  the platform exposes it — TPU and GPU backends do, the CPU backend
+  returns None — and :func:`update_hbm_watermark` folds the peak into the
+  process counters so a serve scrape carries the high-water mark even
+  between stats reads.
+
+Consumers: ``obs.obs_snapshot()`` (and through it ``QuESTService``'s one
+Prometheus scrape, as ``obs_*`` gauges), bench.py row configs
+(``compile_seconds`` / ``hbm_peak_bytes``), and the ledger's per-run
+``DriftRecord`` fields.  See docs/OBSERVABILITY.md "Runtime counters".
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["RuntimeCounters", "global_counters", "record_compile",
+           "record_dispatch", "hbm_watermark", "update_hbm_watermark"]
+
+
+class RuntimeCounters:
+    """Thread-safe process totals.  One lock, plain adds — cheap enough to
+    sit on the compile path (compiles are seconds; the lock is ns)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.compiles_total = 0
+        self.compile_seconds_total = 0.0
+        self.dispatches_total = 0
+        self.dispatch_seconds_total = 0.0
+        self.hbm_peak_bytes = 0
+        self.hbm_bytes_in_use = 0
+
+    def record_compile(self, seconds: float) -> None:
+        with self._lock:
+            self.compiles_total += 1
+            self.compile_seconds_total += float(seconds)
+
+    def record_dispatch(self, seconds: float) -> None:
+        with self._lock:
+            self.dispatches_total += 1
+            self.dispatch_seconds_total += float(seconds)
+
+    def record_hbm(self, bytes_in_use: int, peak_bytes: int) -> None:
+        with self._lock:
+            self.hbm_bytes_in_use = int(bytes_in_use)
+            self.hbm_peak_bytes = max(self.hbm_peak_bytes, int(peak_bytes))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "compiles_total": self.compiles_total,
+                "compile_seconds_total": self.compile_seconds_total,
+                "dispatches_total": self.dispatches_total,
+                "dispatch_seconds_total": self.dispatch_seconds_total,
+                "hbm_peak_bytes": self.hbm_peak_bytes,
+                "hbm_bytes_in_use": self.hbm_bytes_in_use,
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self.compiles_total = 0
+            self.compile_seconds_total = 0.0
+            self.dispatches_total = 0
+            self.dispatch_seconds_total = 0.0
+            self.hbm_peak_bytes = 0
+            self.hbm_bytes_in_use = 0
+
+
+_GLOBAL: RuntimeCounters | None = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def global_counters() -> RuntimeCounters:
+    """The process-wide counters (the serve cache, compile_circuit and the
+    bench harness all record into one place — like the global ledger)."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = RuntimeCounters()
+        return _GLOBAL
+
+
+def record_compile(seconds: float) -> None:
+    global_counters().record_compile(seconds)
+
+
+def record_dispatch(seconds: float) -> None:
+    global_counters().record_dispatch(seconds)
+
+
+def hbm_watermark(device=None) -> dict | None:
+    """Live device-memory stats of ``device`` (default: the first visible
+    device), or None where the backend exposes none (the CPU backend).
+
+    Returns ``{"bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+    "device_kind", "platform"}`` with missing allocator fields as 0 — the
+    keys a capacity dashboard needs next to
+    ``planner.memory_footprint``'s static model."""
+    try:
+        import jax
+        dev = device if device is not None else jax.devices()[0]
+        stats = dev.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    return {
+        "bytes_in_use": int(stats.get("bytes_in_use", 0) or 0),
+        "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", 0) or 0),
+        "bytes_limit": int(stats.get("bytes_limit", 0) or 0),
+        "device_kind": getattr(dev, "device_kind", ""),
+        "platform": getattr(dev, "platform", ""),
+    }
+
+
+def update_hbm_watermark(device=None) -> dict | None:
+    """Read :func:`hbm_watermark` and fold it into the process counters;
+    returns the reading (None where unavailable).  Call sites: bench rows
+    after each timed config, serve batch completion under tracing."""
+    wm = hbm_watermark(device)
+    if wm is not None:
+        global_counters().record_hbm(wm["bytes_in_use"],
+                                     wm["peak_bytes_in_use"]
+                                     or wm["bytes_in_use"])
+    return wm

@@ -147,16 +147,9 @@ def _fmt_s(seconds: float) -> str:
     return f"{seconds * 1e6:.0f}us"
 
 
-def trace_report(spans: list[Span] | None = None,
-                 recorder: TraceRecorder | None = None) -> str:
-    """Human summary: per-name aggregates, then per-request span trees
-    (children indented under their parents, durations inline)."""
-    rec = recorder if recorder is not None else _recorder()
-    if spans is None:
-        spans = rec.spans()
-    if not spans:
-        return "trace: no spans recorded (tracing disabled?)"
-    lines = [f"trace: {len(spans)} span(s)"]
+def _render_spans(spans: list[Span], lines: list) -> None:
+    """The shared per-span-set rendering: per-name aggregates, then
+    per-request span trees (children indented, durations inline)."""
     agg: dict = {}
     for sp in spans:
         count, total = agg.get(sp.name, (0, 0.0))
@@ -190,4 +183,88 @@ def trace_report(spans: list[Span] | None = None,
         for sp in sorted(group, key=lambda s: s.t0):
             if sp.parent_id is None or sp.parent_id not in group_ids:
                 emit(sp, 1, group_ids)
+
+
+def _doc_spans(events: list, thread_names: dict) -> list[Span]:
+    """Rebuild :class:`Span` views from one process track's complete
+    events (the merged-document report path; ids/attrs live in args)."""
+    spans = []
+    for e in events:
+        args = dict(e.get("args") or {})
+        spans.append(Span(
+            e.get("name", "?"), args.pop("span_id", None),
+            args.pop("parent_id", None), args.pop("request_id", None),
+            float(e.get("ts", 0.0)) / 1e6, float(e.get("dur", 0.0)) / 1e6,
+            thread_names.get(e.get("tid"), f"tid {e.get('tid')}"),
+            {k: v for k, v in args.items() if k != "process"}))
+    return spans
+
+
+def _merged_trace_report(doc: dict) -> str:
+    """The report over a MERGED Chrome-trace document
+    (obs/aggregate.py merge_shards/merge_files): one section per process
+    track — named, with its clock offset noted — instead of assuming the
+    single-process recorder.  A degenerate (single-process) merge renders
+    as one unlabeled section, matching the recorder path's shape."""
+    events = doc.get("traceEvents") or []
+    complete = [e for e in events if e.get("ph") == "X"]
+    if not complete:
+        return "trace: no spans recorded (tracing disabled?)"
+    other = doc.get("otherData") or {}
+    declared = other.get("processes")
+    offsets = other.get("clock_offsets_s") or {}
+    hosts = other.get("hosts") or {}
+    by_pid: dict = {}
+    for e in complete:
+        by_pid.setdefault(e.get("pid"), []).append(e)
+    proc_names: dict = {}
+    thread_names: dict = {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            proc_names[e.get("pid")] = (e.get("args") or {}).get("name")
+        elif e.get("name") == "thread_name":
+            thread_names.setdefault(e.get("pid"), {})[e.get("tid")] = \
+                (e.get("args") or {}).get("name")
+    multi = declared is not None and len(by_pid) >= 1
+    lines = [f"merged trace: {len(complete)} span(s) across "
+             f"{len(by_pid)} process(es)"
+             + (f", dropped {other['dropped_spans']}"
+                if other.get("dropped_spans") else "")]
+    for pid in sorted(by_pid):
+        if multi:
+            p = pid - 1
+            off = offsets.get(str(p), 0.0)
+            name = proc_names.get(pid) or f"process {p}"
+            host = hosts.get(str(p))
+            lines.append(f"-- {name}"
+                         + (f" on {host}" if host and host not in name
+                            else "")
+                         + f" (clock offset {off:+.6f}s): "
+                         f"{len(by_pid[pid])} span(s)")
+        _render_spans(_doc_spans(by_pid[pid],
+                                 thread_names.get(pid, {})), lines)
+    return "\n".join(lines)
+
+
+def trace_report(spans: list[Span] | dict | None = None,
+                 recorder: TraceRecorder | None = None) -> str:
+    """Human summary: per-name aggregates, then per-request span trees
+    (children indented under their parents, durations inline).
+
+    ``spans`` may also be a MERGED multi-process Chrome-trace document
+    (``obs.merge_shards``/``merge_files`` output): the report then
+    renders one section per process track, each named and annotated with
+    its clock offset, so a pod capture reads as one document instead of
+    N islands."""
+    if isinstance(spans, dict):
+        return _merged_trace_report(spans)
+    rec = recorder if recorder is not None else _recorder()
+    if spans is None:
+        spans = rec.spans()
+    if not spans:
+        return "trace: no spans recorded (tracing disabled?)"
+    lines = [f"trace: {len(spans)} span(s)"]
+    _render_spans(spans, lines)
     return "\n".join(lines)

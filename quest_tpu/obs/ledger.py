@@ -20,19 +20,27 @@ Two drift rules, deliberately asymmetric:
   collectives per predicted comm event — the same factor bound
   analysis/jaxpr_audit.py gates on — or ANY measured collective on a run
   predicted comm-free is drift.
-- **Wall time** is only checked against the model when the run executed on
-  the hardware the model describes (``platform == "tpu"``, or
-  ``calibrated=True`` for explicitly calibrated setups): the time model is
-  a TPU roofline at MEASURED_EFFICIENCY, and comparing it to a CPU wall
-  clock would flag every CI run.  The default band is
-  :data:`DEFAULT_WALL_BAND` — measured/predicted within [1/3, 3] — the
-  spread of the BENCH rows the efficiencies were calibrated from
-  (BENCH_r04/r05 hbm_peak_frac 0.20-0.31 vs the 0.26-0.29 constants).
+- **Wall time** is only checked against the model when the constants the
+  model ran on describe the hardware the run executed on.  With a
+  **calibration profile** loaded (obs/calibrate.py — the planner is then
+  reading efficiencies fitted on THIS backend by ``analysis
+  --calibrate``) the wall band is checked on *any* platform, against the
+  profile's fitted residual spread instead of the hard-coded default
+  band: calibration is exactly what makes a CPU wall clock comparable to
+  the model.  Without a profile the old gate stands — ``platform ==
+  "tpu"`` or an explicit ``calibrated=True``, with the default band
+  :data:`DEFAULT_WALL_BAND` ([1/3, 3], the spread of the BENCH rows
+  MEASURED_EFFICIENCY was fit on) — because the defaults are a TPU
+  roofline and judging a CPU clock against them would flag every CI run.
 
-This is what turns ``MEASURED_EFFICIENCY`` calibration (ROADMAP item 2)
-from a one-off into a pipeline: a chip run that drifts out of band is a
-signal to re-measure the efficiency constant, caught by CI instead of by a
-human.
+Every record carries **calibration provenance** (profile id, age,
+residual-derived band — or the explicit ``{"source": "default"}``
+marker) plus the runtime counters of its run when the caller has them
+(compile wall seconds, HBM watermark — obs/counters.py).  This is what
+turns ``MEASURED_EFFICIENCY`` calibration (ROADMAP item 2) from a
+one-off into a pipeline: ``O_MODEL_DRIFT`` says re-calibrate, ``analysis
+--calibrate`` re-fits the constants, and the refreshed profile's band is
+what the next run is judged by.
 """
 
 from __future__ import annotations
@@ -78,6 +86,15 @@ class DriftRecord:
     wall_ratio: float | None = None          # measured / predicted
     wall_checked: bool = False
     findings: tuple = ()
+    # which constants judged this run (planner.calibration_provenance())
+    # and the wall band that applied — so a drift row is auditable without
+    # knowing what profile happened to be live at record time
+    calibration: dict | None = None
+    wall_band: tuple | None = None
+    # runtime counters of the run (obs/counters.py), when the caller has
+    # them: compile wall seconds and the live-HBM peak watermark
+    compile_seconds: float | None = None
+    hbm_peak_bytes: int | None = None
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -102,24 +119,42 @@ class Ledger:
                predicted_hbm_passes: int | None = None,
                predicted_collectives: int | None = None,
                measured_hlo_collectives: int | None = None,
-               calibrated: bool = False, warn: bool = True) -> DriftRecord:
+               calibrated: bool = False, warn: bool = True,
+               compile_seconds: float | None = None,
+               hbm_peak_bytes: int | None = None) -> DriftRecord:
         """Record one run.  Pass whatever the caller has — every check only
-        fires when both of its sides are present.  ``calibrated=True``
-        opts a non-TPU run into the wall-band check (a setup whose
-        efficiency constants have been measured on that platform)."""
+        fires when both of its sides are present.
+
+        The wall gate resolves in this order: an ACTIVE calibration
+        profile (obs/calibrate.py) checks the wall on any platform
+        against its fitted residual band — the predictions were made
+        with constants measured on this backend, so the comparison is
+        meaningful everywhere; else ``calibrated=True`` opts a non-TPU
+        run into the ledger's default band (legacy explicit opt-in);
+        else only ``platform == "tpu"`` runs are judged.  Either way the
+        record carries the provenance and the band that applied."""
+        from ..parallel.planner import calibration_provenance
+        calibration = calibration_provenance()
         findings: list[str] = []
         wall_ratio = None
         wall_checked = False
+        lo, hi = self.wall_band
+        if calibration.get("source") == "profile":
+            lo, hi = calibration["wall_band"]
         if predicted_seconds and measured_seconds is not None:
             wall_ratio = measured_seconds / predicted_seconds
-            wall_checked = calibrated or platform == "tpu"
-            lo, hi = self.wall_band
+            wall_checked = (calibration.get("source") == "profile"
+                            or calibrated or platform == "tpu")
             if wall_checked and not lo <= wall_ratio <= hi:
+                source = ("the calibration profile "
+                          + calibration["profile_id"]
+                          if calibration.get("source") == "profile"
+                          else "MEASURED_EFFICIENCY")
                 findings.append(
                     f"wall {measured_seconds:.3g}s is {wall_ratio:.2f}x the "
                     f"model's {predicted_seconds:.3g}s (band [{lo:.2f}, "
-                    f"{hi:.2f}]): re-calibrate MEASURED_EFFICIENCY for "
-                    f"engine {engine!r}")
+                    f"{hi:.2f}]): re-calibrate {source} for "
+                    f"engine {engine!r} (analysis --calibrate)")
         if (predicted_collectives is not None
                 and measured_hlo_collectives is not None):
             bound = predicted_collectives * self.collectives_per_event
@@ -136,7 +171,8 @@ class Ledger:
                           predicted_seconds, measured_seconds,
                           predicted_hbm_passes, predicted_collectives,
                           measured_hlo_collectives, wall_ratio, wall_checked,
-                          tuple(findings))
+                          tuple(findings), calibration, (lo, hi),
+                          compile_seconds, hbm_peak_bytes)
         with self._lock:
             self._records.append(rec)
             if len(self._records) > _MAX_RECORDS:

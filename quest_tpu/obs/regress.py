@@ -100,9 +100,17 @@ def _normalize_row(row: dict, round_platform: str) -> dict | None:
             row.get("value"), (int, float)):
         return None
     cfg = row.get("config") or {}
+    compile_s = cfg.get("compile_seconds")
     return {"name": row["name"], "value": float(row["value"]),
             "platform": _row_platform(row, round_platform),
-            "validation_only": bool(cfg.get("validation_only", False))}
+            "validation_only": bool(cfg.get("validation_only", False)),
+            # PR 9 rows stamp compile wall seconds (obs/counters.py);
+            # compare() REPORTS their deltas next to amps/s, never gates —
+            # a compile-time jump is a diagnosis lead, not a throughput
+            # regression (docs/OBSERVABILITY.md)
+            "compile_seconds": (float(compile_s)
+                                if isinstance(compile_s, (int, float))
+                                else None)}
 
 
 def recover_rows(text: str) -> tuple[dict | None, list[dict]]:
@@ -161,11 +169,15 @@ def load_round(path: str) -> dict:
     skipped: list = []
     if headline is not None and isinstance(headline.get("value"),
                                            (int, float)):
+        head_compile = (headline.get("config") or {}).get("compile_seconds")
         rows["headline"] = {
             "name": "headline", "value": float(headline["value"]),
             "platform": (headline.get("config") or {}).get(
                 "platform", round_platform),
-            "validation_only": False}
+            "validation_only": False,
+            "compile_seconds": (float(head_compile)
+                                if isinstance(head_compile, (int, float))
+                                else None)}
     for raw in raw_rows:
         norm = _normalize_row(raw, round_platform)
         if norm is None:
@@ -218,6 +230,7 @@ def compare(current: dict, priors: list[dict], *,
         tolerance = tol_map.get(name, default_tolerance)
         best = None
         best_round = None
+        best_row = None
         for prior in priors:
             cand = prior["rows"].get(name)
             if cand is None or not _comparable(row["platform"],
@@ -225,12 +238,23 @@ def compare(current: dict, priors: list[dict], *,
                 continue
             if best is None or cand["value"] > best:
                 best, best_round = cand["value"], prior["label"]
+                best_row = cand
         gating = include_validation or not row["validation_only"]
         entry = {"name": name, "value": row["value"],
                  "platform": row["platform"],
                  "validation_only": row["validation_only"],
                  "tolerance": tolerance, "gating": gating,
                  "best_prior": best, "best_prior_round": best_round}
+        # compile-time delta next to amps/s — REPORTED, never gated: the
+        # compile wall measures the toolchain, not the kernels, and jumps
+        # with jax/jaxlib upgrades that are not this repo's regression
+        cur_compile = row.get("compile_seconds")
+        prior_compile = (best_row or {}).get("compile_seconds")
+        entry["compile_seconds"] = cur_compile
+        entry["prior_compile_seconds"] = prior_compile
+        entry["compile_delta_frac"] = (
+            cur_compile / prior_compile - 1.0
+            if cur_compile and prior_compile else None)
         if best is None:
             entry["status"] = "new"
             entry["ratio"] = None

@@ -271,16 +271,37 @@ def tracing_enabled() -> bool:
 
 
 def obs_snapshot() -> dict:
-    """Tracing + ledger counters for the shared metrics registry (the
-    serve Prometheus scrape re-exports these as ``obs_*`` gauges)."""
+    """Tracing + ledger + runtime counters + calibration staleness for the
+    shared metrics registry (the serve Prometheus scrape re-exports these
+    as ``obs_*`` gauges — all numeric by contract).
+
+    The calibration gauges are the serve-side half of the calibration
+    loop (obs/calibrate.py): ``calibration_loaded`` says whether the
+    planner is running on fitted constants at all, ``calibration_age_s``
+    / ``calibration_stale`` say whether the operator should re-run
+    ``analysis --calibrate`` (age is -1 with no profile loaded)."""
+    from .calibrate import active_profile
+    from .counters import global_counters
     from .ledger import global_ledger
     snap = _RECORDER.snapshot()
     led = global_ledger().snapshot()
+    run = global_counters().snapshot()
+    prof = active_profile()
     return {"trace_enabled": snap["enabled"],
             "trace_spans": snap["spans"],
             "trace_dropped": snap["dropped"],
             "ledger_records": led["records"],
-            "ledger_drift_total": led["drift_total"]}
+            "ledger_drift_total": led["drift_total"],
+            "compiles_total": run["compiles_total"],
+            "compile_seconds_total": run["compile_seconds_total"],
+            "dispatches_total": run["dispatches_total"],
+            "dispatch_seconds_total": run["dispatch_seconds_total"],
+            "hbm_peak_bytes": run["hbm_peak_bytes"],
+            "calibration_loaded": 0 if prof is None else 1,
+            "calibration_age_s": -1.0 if prof is None
+            else round(prof.age_s(), 3),
+            "calibration_stale": 0 if prof is None or not prof.stale()
+            else 1}
 
 
 @contextlib.contextmanager

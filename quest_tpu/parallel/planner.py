@@ -188,6 +188,9 @@ class ChipSpec:
 V5E = ChipSpec("v5e", 819e9, 4.5e10, 4, 16e9)
 V5P = ChipSpec("v5p", 2765e9, 9e10, 6, 95e9)
 
+#: name -> spec, for resolving a calibration profile's reference chip
+_CHIPS_BY_NAME = {"v5e": V5E, "v5p": V5P}
+
 # smallest per-chunk collective worth issuing: below ~this many seconds on
 # the wire a chunk is latency- not bandwidth-bound, and further splitting
 # stops buying overlap (the per-chunk ramp of GateTime.total_s grows
@@ -214,6 +217,62 @@ MEASURED_EFFICIENCY = {
     # block/fiber kernels the qft_30q rows measured at 0.27-0.31
     "pallas_epoch": 0.29,
 }
+
+
+def efficiency_for(engine_class: str, chip: "ChipSpec | None" = None) -> float:
+    """The live efficiency constant for ``engine_class``: the active
+    calibration profile's fitted value (obs/calibrate.py — measured on the
+    deployment's own backend by ``analysis --calibrate``) when one is
+    loaded, else the hard-coded :data:`MEASURED_EFFICIENCY` default.  This
+    is the ONE read point every model in this module goes through, so
+    loading a profile retunes ``time_model`` / ``engine_time_model`` /
+    ``select_engine`` and the scheduler's placement search together.
+
+    A fitted efficiency is DEFINED relative to the reference chip the
+    profile was built against (``pass_s = 2·bytes / (profile_chip_peak ·
+    eff)``); when the caller scores against a DIFFERENT ``chip`` spec the
+    value is rescaled by the reference-peak ratio so the implied pass
+    seconds — the thing that was actually measured — are preserved
+    exactly (a v5e-referenced profile consumed by a ``--chip v5p`` model
+    must not silently mis-scale by the HBM-peak ratio)."""
+    from ..obs import calibrate as _cal
+    prof = _cal.active_profile()
+    if prof is not None:
+        fitted = prof.efficiencies.get(engine_class)
+        if fitted:
+            fitted = float(fitted)
+            ref = _CHIPS_BY_NAME.get(prof.chip)
+            if chip is not None and ref is not None \
+                    and ref.name != chip.name:
+                fitted *= ref.hbm_bytes_per_sec / chip.hbm_bytes_per_sec
+            return fitted
+    return MEASURED_EFFICIENCY[engine_class]
+
+
+def calibration_provenance() -> dict:
+    """The provenance stamp engine decisions and ledger records carry:
+    the active profile's summary (id, platform, age, band), or the
+    explicit ``{"source": "default"}`` marker so a consumer can always
+    tell WHICH constants produced a decision."""
+    from ..obs import calibrate as _cal
+    summary = _cal.active_summary()
+    if summary is None:
+        return {"source": "default"}
+    return {"source": "profile", **summary}
+
+
+def _collective_bytes_per_sec(chip: "ChipSpec", comm_class: str) -> float | None:
+    """Fitted effective bytes/sec for a comm class from the active
+    calibration profile (the harness's ppermute/bitperm sweep), or None
+    to use the chip-spec formula.  The fitted constant absorbs topology —
+    it was measured on the deployment's own mesh."""
+    from ..obs import calibrate as _cal
+    prof = _cal.active_profile()
+    if prof is None:
+        return None
+    bw = prof.collective_bytes_per_sec.get(
+        "permute" if comm_class in ("permute", "subtile") else "reshard")
+    return float(bw) if bw else None
 
 
 def memory_footprint(num_qubits: int, num_devices: int = 1,
@@ -278,8 +337,11 @@ def time_model(circuit, num_devices: int, chip: ChipSpec = V5E,
     comm    = bytes_moved / ici_link_bw ('permute'/'subtile': the
     reference's pairwise exchange — one partner, one link) or bytes_moved
     x (D-1)/D / (links x ici_link_bw) ('reshard': all-to-all spread over
-    the torus links).  Efficiency defaults to the measured single-chip
-    value for the precision's engine class (MEASURED_EFFICIENCY).
+    the torus links).  Efficiency defaults to the live value for the
+    precision's engine class (:func:`efficiency_for`: the active
+    calibration profile's fitted constant, else MEASURED_EFFICIENCY);
+    with a profile loaded the comm terms likewise use the fitted
+    collective bytes/sec in place of the chip-spec formula.
 
     ``pipeline_chunks > 1`` models the overlapped executor
     (parallel/executor.py): pairwise-exchange events on plain dense
@@ -292,17 +354,22 @@ def time_model(circuit, num_devices: int, chip: ChipSpec = V5E,
     validate_num_ranks(num_devices, "time_model")
     bytes_per_amp = 8 if precision == 1 else 16
     if efficiency is None:
-        efficiency = MEASURED_EFFICIENCY[
-            "f32_gate" if precision == 1 else "f64_gate"]
+        efficiency = efficiency_for(
+            "f32_gate" if precision == 1 else "f64_gate", chip)
     shard_bytes = (1 << circuit.num_qubits) // num_devices * bytes_per_amp
     hbm = chip.hbm_bytes_per_sec * efficiency
+    bw_permute = _collective_bytes_per_sec(chip, "permute")
+    bw_reshard = _collective_bytes_per_sec(chip, "reshard")
     out = []
     for plan in comm_plan(circuit, num_devices, bytes_per_amp):
         compute = 2.0 * shard_bytes / hbm
         if plan.comm == "none":
             comm = 0.0
         elif plan.comm in ("permute", "subtile"):
-            comm = plan.bytes_moved / chip.ici_link_bytes_per_sec
+            comm = (plan.bytes_moved / bw_permute if bw_permute
+                    else plan.bytes_moved / chip.ici_link_bytes_per_sec)
+        elif bw_reshard:    # fitted aggregate reshard bandwidth
+            comm = plan.bytes_moved / bw_reshard
         else:  # reshard: all-to-all over every torus link
             comm = (plan.bytes_moved * (num_devices - 1) / num_devices
                     / (chip.ici_links * chip.ici_link_bytes_per_sec))
@@ -405,11 +472,11 @@ def engine_time_model(circuit, chip: ChipSpec = V5E, precision: int = 1,
     n = circuit.num_qubits
     bytes_per_amp = 8 if precision == 1 else 16
     state_bytes = (1 << n) * bytes_per_amp
-    eff_xla = MEASURED_EFFICIENCY["f32_gate" if precision == 1
-                                  else "f64_gate"]
+    eff_xla = efficiency_for("f32_gate" if precision == 1 else "f64_gate",
+                             chip)
     pass_s_xla = 2.0 * state_bytes / (chip.hbm_bytes_per_sec * eff_xla)
     pass_s_pallas = 2.0 * state_bytes / (
-        chip.hbm_bytes_per_sec * MEASURED_EFFICIENCY["pallas_epoch"])
+        chip.hbm_bytes_per_sec * efficiency_for("pallas_epoch", chip))
     out = {
         "num_qubits": n,
         "ops": len(circuit.ops),
@@ -445,9 +512,14 @@ def select_engine(circuit, num_devices: int | None = None,
                    num_devices=num_devices or 1) as sp:
         choice = _select_engine_impl(circuit, num_devices, chip, precision,
                                      requested, backend)
+        # every engine decision carries calibration provenance: which
+        # constants (fitted profile vs hard-coded defaults) scored it
+        choice["calibration"] = calibration_provenance()
         if sp is not None:
             sp.attrs["engine"] = choice["engine"]
             sp.attrs["reason"] = choice["reason"]
+            sp.attrs["calibration"] = choice["calibration"].get(
+                "profile_id", "default")
         return choice
 
 
@@ -545,7 +617,8 @@ def engine_summary(circuit, num_devices: int | None = None,
         choice = select_engine(circuit, num_devices, chip, precision,
                                requested)
     except QuESTError as e:
-        choice = {"engine": "xla", "reason": str(e), "plan": None}
+        choice = {"engine": "xla", "reason": str(e), "plan": None,
+                  "calibration": calibration_provenance()}
     epochs = []
     if choice["plan"] is not None and choice["engine"] == "pallas":
         for i, seg in enumerate(choice["plan"].segments):
@@ -559,5 +632,7 @@ def engine_summary(circuit, num_devices: int | None = None,
                        "hbm_passes": len(circuit.ops)})
     return {"engine": choice["engine"], "reason": choice["reason"],
             "epochs": epochs,
+            "calibration": choice.get("calibration",
+                                      calibration_provenance()),
             "deferred_perm_ops": (choice["plan"].deferred_ops
                                   if choice["plan"] is not None else 0)}

@@ -707,6 +707,10 @@ def schedule_savings(circuit, num_devices: int, *, bytes_per_amp: int = 8,
         "engine_chosen": eng["engine"],
         "engine_reason": eng["reason"],
         "engine_epochs": eng["epochs"],
+        # which constants scored this schedule (fitted calibration profile
+        # vs hard-coded defaults): every model column above was computed by
+        # time_model/engine_summary through planner.efficiency_for
+        "calibration": eng["calibration"],
         "engine_deferred_perm_ops": eng["deferred_perm_ops"],
         "num_devices": num_devices,
         "ops_before": before["ops"], "ops_after": after["ops"],

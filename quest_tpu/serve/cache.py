@@ -271,6 +271,10 @@ class CompileCache:
                        tag=str(tag[0]), engine=entry.options.engine):
             call = build()
         dt = time.perf_counter() - t0
+        # every compile also folds into the process-wide runtime counters
+        # (obs/counters.py): the one Prometheus scrape reports compile
+        # seconds across ALL caches and ad-hoc jits, not just this one
+        _obs.record_compile(dt)
         nbytes = _compiled_bytes(call)
         with self._lock:
             p = entry.programs.get(tag)

@@ -1,0 +1,430 @@
+"""Calibration loop tests (quest_tpu/obs/calibrate.py + the planner's
+calibration-aware models + the ledger's fitted wall band).
+
+The acceptance spine of PR 9:
+
+- profile save/load round-trip, schema-validated (a corrupted document
+  must refuse to load);
+- planner override monotonicity: raising a fitted efficiency never flips
+  an engine decision TOWARD the slower engine;
+- deterministic decisions: loading the same profile twice reproduces
+  identical ``select_engine``/``schedule`` outputs;
+- the ADVERSARIAL flip: a profile with inverted efficiencies provably
+  flips an engine decision — the proof the planner is reading measured
+  constants, not the hard-coded defaults;
+- the ledger band fix: with a profile loaded the wall band is checked on
+  ANY platform against the profile's fitted residual band, and every
+  record carries calibration provenance;
+- a fast end-to-end harness smoke (reduced repeats, no Pallas/f64) that
+  the fitted profile is schema-valid and activatable.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from quest_tpu import obs, qft_circuit
+from quest_tpu.obs import calibrate as cal
+from quest_tpu.parallel import planner
+
+
+def _profile(effs=None, **kw):
+    base = {"f32_gate": 0.18, "f64_gate": 0.065, "pallas_epoch": 0.29}
+    base.update(effs or {})
+    return cal.make_profile(efficiencies=base, **kw)
+
+
+# ---------------------------------------------------------------------------
+# profile persistence + schema
+# ---------------------------------------------------------------------------
+
+def test_profile_roundtrip_schema_validated(tmp_path):
+    prof = _profile({"f32_gate": 0.042},
+                    fit_residuals={"f32_gate": 2.5, "f64_gate": 1.5,
+                                   "pallas_epoch": 1.1},
+                    collective_bytes_per_sec={"permute": 8e7,
+                                              "reshard": 5e7},
+                    measurements={"harness": {"repeats": 2}})
+    assert cal.validate_profile(prof.as_dict()) == []
+    path = tmp_path / "profile.json"
+    doc = cal.save_profile(prof, str(path))
+    assert doc["profile_id"] == prof.profile_id
+    loaded = cal.load_profile(str(path))
+    assert loaded == prof           # frozen dataclass: exact field equality
+    assert loaded.profile_id == prof.profile_id
+    assert loaded.wall_band == prof.wall_band
+    # the file is plain JSON: an offline consumer reads it without us
+    raw = json.loads(path.read_text())
+    assert raw["format"] == cal.PROFILE_FORMAT
+    assert raw["efficiencies"]["f32_gate"] == pytest.approx(0.042)
+
+
+def test_profile_schema_rejections(tmp_path):
+    prof = _profile()
+    doc = prof.as_dict()
+    # a hand-edited efficiency breaks the content hash: tamper-evident
+    doc["efficiencies"]["f32_gate"] = 0.99
+    assert any("content hash" in p for p in cal.validate_profile(doc))
+    # missing a required engine class
+    doc2 = prof.as_dict()
+    del doc2["efficiencies"]["pallas_epoch"]
+    assert any("pallas_epoch" in p for p in cal.validate_profile(doc2))
+    # bad band ordering
+    doc3 = prof.as_dict()
+    doc3["wall_band"] = [3.0, 0.5]
+    assert any("wall_band" in p for p in cal.validate_profile(doc3))
+    # load_profile refuses an invalid document outright
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(doc))
+    with pytest.raises(ValueError, match="not a valid"):
+        cal.load_profile(str(bad))
+    # wrong format tag
+    assert cal.validate_profile({"format": "something-else"})
+
+
+def test_profile_staleness_clock():
+    import time
+    old = _profile(created_epoch_s=time.time() - 10 * 86400,
+                   stale_after_s=7 * 86400)
+    assert old.stale()
+    assert old.age_s() > 9 * 86400
+    fresh = _profile()
+    assert not fresh.stale()
+    s = old.summary()
+    assert s["stale"] and s["profile_id"] == old.profile_id
+
+
+# ---------------------------------------------------------------------------
+# activation + the planner reading fitted constants
+# ---------------------------------------------------------------------------
+
+def test_activation_scopes_and_restores():
+    assert planner.efficiency_for("f32_gate") == \
+        planner.MEASURED_EFFICIENCY["f32_gate"]
+    prof = _profile({"f32_gate": 0.5})
+    with cal.use_profile(prof):
+        assert planner.efficiency_for("f32_gate") == 0.5
+        assert cal.active_profile() is prof
+        prov = planner.calibration_provenance()
+        assert prov["source"] == "profile"
+        assert prov["profile_id"] == prof.profile_id
+    assert planner.efficiency_for("f32_gate") == \
+        planner.MEASURED_EFFICIENCY["f32_gate"]
+    assert planner.calibration_provenance() == {"source": "default"}
+
+
+def test_time_model_uses_fitted_constants():
+    c = qft_circuit(12)
+    base = sum(t.total_s for t in planner.time_model(c, 1))
+    # doubling the fitted efficiency must exactly halve modeled compute
+    prof = _profile({"f32_gate": planner.MEASURED_EFFICIENCY["f32_gate"]
+                     * 2.0})
+    with cal.use_profile(prof):
+        fitted = sum(t.total_s for t in planner.time_model(c, 1))
+    assert fitted == pytest.approx(base / 2.0, rel=1e-12)
+
+
+def test_time_model_uses_fitted_collective_bandwidth():
+    c = qft_circuit(12)
+    prof = _profile(collective_bytes_per_sec={"permute": 1e6,
+                                              "reshard": 1e6})
+    with cal.use_profile(prof):
+        times = planner.time_model(c, 8)
+        comm = [t for t in times if t.comm != "none"]
+        assert comm, "the 12q QFT over x8 must model comm events"
+        for t in comm:
+            # fitted: comm seconds == bytes / fitted bw, no topology factor
+            plan_bytes = t.comm_s * 1e6
+            assert plan_bytes > 0
+
+
+def test_efficiency_rescales_across_chip_specs():
+    """A fitted efficiency is relative to the profile's reference chip:
+    consumed against a DIFFERENT ChipSpec it must rescale by the
+    reference-peak ratio so the implied (measured) pass seconds are
+    preserved — a v5e profile under --chip v5p must not silently
+    mis-scale predictions."""
+    prof = _profile({"f32_gate": 0.2}, chip="v5e")
+    with cal.use_profile(prof):
+        e_v5e = planner.efficiency_for("f32_gate", planner.V5E)
+        e_v5p = planner.efficiency_for("f32_gate", planner.V5P)
+    assert e_v5e == pytest.approx(0.2)
+    # same implied pass seconds: eff x chip peak is invariant
+    assert e_v5e * planner.V5E.hbm_bytes_per_sec == pytest.approx(
+        e_v5p * planner.V5P.hbm_bytes_per_sec)
+    # chip=None (bare class read) returns the stored value unscaled
+    with cal.use_profile(prof):
+        assert planner.efficiency_for("f32_gate") == pytest.approx(0.2)
+
+
+def test_collective_fit_cancels_latency():
+    """The two-point collective fit recovers the true bandwidth from
+    latency-dominated probes: with t = latency + bytes/bw the plain
+    bytes/seconds ratio undershoots bw badly, the slope is exact."""
+    from quest_tpu.obs.calibrate import _fit_collective_points
+    latency, bw = 1e-3, 1e9
+    pts = [(16_384, latency + 16_384 / bw),
+           (1_048_576, latency + 1_048_576 / bw)]
+    fitted, kind, _, _ = _fit_collective_points(pts)
+    assert kind == "two_point_slope"
+    assert fitted == pytest.approx(bw, rel=1e-9)
+    # the naive ratio would have been ~60x off for the small probe
+    assert pts[0][0] / pts[0][1] < bw / 50
+    # noise case: large probe timed no slower -> conservative ratio
+    fitted, kind, _, _ = _fit_collective_points(
+        [(16_384, 2e-3), (1_048_576, 2e-3)])
+    assert kind == "ratio_fallback"
+    assert fitted == pytest.approx(1_048_576 / 2e-3)
+
+
+def test_select_engine_carries_calibration_provenance():
+    c = qft_circuit(17)
+    choice = planner.select_engine(c, 1, backend="tpu")
+    assert choice["calibration"] == {"source": "default"}
+    prof = _profile()
+    with cal.use_profile(prof):
+        choice = planner.select_engine(c, 1, backend="tpu")
+        assert choice["calibration"]["source"] == "profile"
+        assert choice["calibration"]["profile_id"] == prof.profile_id
+        # engine_summary and schedule_savings surface the same stamp
+        summ = planner.engine_summary(c, 1)
+        assert summ["calibration"]["profile_id"] == prof.profile_id
+        from quest_tpu.parallel.scheduler import schedule_savings
+        report = schedule_savings(qft_circuit(12), 8)
+        assert report["calibration"]["profile_id"] == prof.profile_id
+
+
+def test_compile_circuit_carries_calibration():
+    from quest_tpu.circuit import compile_circuit
+    prof = _profile()
+    with cal.use_profile(prof):
+        run = compile_circuit(qft_circuit(6))
+        assert run.engine_calibration["source"] == "profile"
+        assert run.engine_calibration["profile_id"] == prof.profile_id
+
+
+# ---------------------------------------------------------------------------
+# the adversarial flip + monotonicity + determinism
+# ---------------------------------------------------------------------------
+
+def test_inverted_profile_flips_engine_decision():
+    """The acceptance proof: an adversarial profile whose efficiencies
+    invert the engines' ranking must flip ``select_engine``'s pick — the
+    planner is reading measured constants, not the defaults."""
+    c = qft_circuit(17)
+    default = planner.select_engine(c, 1, backend="tpu")
+    assert default["engine"] == "pallas"    # 1 fused pass vs 153: model win
+    inverted = _profile({"f32_gate": 0.9, "pallas_epoch": 1e-4})
+    with cal.use_profile(inverted):
+        flipped = planner.select_engine(c, 1, backend="tpu")
+    assert flipped["engine"] == "xla"
+    assert "slower" in flipped["reason"]
+    assert flipped["calibration"]["profile_id"] == inverted.profile_id
+
+
+def test_efficiency_monotonicity_never_flips_toward_slower():
+    """Raising the fitted pallas efficiency (everything else pinned) can
+    only move the decision TOWARD the engine that got faster: once pallas
+    is chosen at some efficiency, it stays chosen at every higher one."""
+    c = qft_circuit(17)
+    picks = []
+    for scale in (1e-5, 1e-4, 1e-3, 1e-2, 0.05, 0.29, 0.9):
+        prof = _profile({"f32_gate": 0.18, "pallas_epoch": scale})
+        with cal.use_profile(prof):
+            picks.append(planner.select_engine(c, 1,
+                                               backend="tpu")["engine"])
+    # no pallas -> xla transition anywhere along the rising-efficiency walk
+    seen_pallas = False
+    for engine in picks:
+        if engine == "pallas":
+            seen_pallas = True
+        assert not (seen_pallas and engine == "xla"), picks
+    assert picks[-1] == "pallas", picks
+
+
+def test_same_profile_twice_is_deterministic(tmp_path):
+    """Loading the same profile twice reproduces identical
+    select_engine and schedule outputs — calibration must never make
+    deployments flap."""
+    prof = _profile({"f32_gate": 0.07, "pallas_epoch": 0.2},
+                    collective_bytes_per_sec={"permute": 7.7e7,
+                                              "reshard": 4.2e7})
+    path = tmp_path / "p.json"
+    cal.save_profile(prof, str(path))
+    c_engine = qft_circuit(17)
+    c_sched = qft_circuit(14)
+    outs = []
+    for _ in range(2):
+        loaded = cal.load_profile(str(path))
+        with cal.use_profile(loaded):
+            choice = planner.select_engine(c_engine, 1, backend="tpu")
+            sched = c_sched.schedule(8)
+            outs.append((choice["engine"], choice["reason"],
+                         choice["calibration"]["profile_id"],
+                         tuple(sched.ops)))
+    assert outs[0] == outs[1]
+
+
+# ---------------------------------------------------------------------------
+# the ledger band fix (satellite): fitted band on ANY platform
+# ---------------------------------------------------------------------------
+
+def test_ledger_checks_wall_on_any_platform_with_profile():
+    prof = _profile(fit_residuals={"f32_gate": 2.0, "f64_gate": 2.0,
+                                   "pallas_epoch": 2.0})
+    lo, hi = prof.wall_band
+    led = obs.Ledger()
+    with cal.use_profile(prof):
+        good = led.record("in_band", platform="cpu",
+                          predicted_seconds=1.0,
+                          measured_seconds=(lo + hi) / 2, warn=False)
+        assert good.wall_checked and good.findings == ()
+        assert good.wall_band == (lo, hi)
+        assert good.calibration["profile_id"] == prof.profile_id
+        with pytest.warns(RuntimeWarning, match="O_MODEL_DRIFT"):
+            bad = led.record("out_of_band", platform="cpu",
+                             predicted_seconds=1.0,
+                             measured_seconds=hi * 2.0)
+        assert bad.wall_checked and len(bad.findings) == 1
+        assert prof.profile_id in bad.findings[0]
+        assert "analysis --calibrate" in bad.findings[0]
+    # without the profile the legacy gate stands: CPU walls unjudged
+    ungated = led.record("cpu_default", platform="cpu",
+                         predicted_seconds=1.0, measured_seconds=hi * 2.0,
+                         warn=False)
+    assert not ungated.wall_checked and ungated.findings == ()
+    assert ungated.calibration == {"source": "default"}
+
+
+def test_ledger_record_carries_runtime_counters():
+    led = obs.Ledger()
+    rec = led.record("with_counters", platform="cpu",
+                     compile_seconds=1.25, hbm_peak_bytes=123456,
+                     warn=False)
+    d = rec.as_dict()
+    assert d["compile_seconds"] == 1.25
+    assert d["hbm_peak_bytes"] == 123456
+
+
+# ---------------------------------------------------------------------------
+# runtime counters + the scrape gauges
+# ---------------------------------------------------------------------------
+
+def test_runtime_counters_and_snapshot_gauges():
+    from quest_tpu.obs.counters import RuntimeCounters
+    c = RuntimeCounters()
+    c.record_compile(1.5)
+    c.record_compile(0.5)
+    c.record_dispatch(0.01)
+    c.record_hbm(100, 200)
+    c.record_hbm(50, 150)       # peak is a high-water mark
+    snap = c.snapshot()
+    assert snap["compiles_total"] == 2
+    assert snap["compile_seconds_total"] == pytest.approx(2.0)
+    assert snap["dispatches_total"] == 1
+    assert snap["hbm_peak_bytes"] == 200
+    assert snap["hbm_bytes_in_use"] == 50
+    # the obs snapshot is all-numeric (the Prometheus gauge contract) and
+    # reports calibration staleness
+    prof = _profile()
+    with cal.use_profile(prof):
+        s = obs.obs_snapshot()
+        assert s["calibration_loaded"] == 1
+        assert s["calibration_age_s"] >= 0
+        assert all(isinstance(v, (int, float)) for v in s.values())
+    s = obs.obs_snapshot()
+    assert s["calibration_loaded"] == 0 and s["calibration_age_s"] == -1.0
+
+
+def test_serve_scrape_carries_calibration_gauges():
+    from quest_tpu.serve import QuESTService
+    from quest_tpu.serve.metrics import parse_prometheus
+    prof = _profile()
+    with cal.use_profile(prof):
+        svc = QuESTService(start=False)
+        try:
+            parsed = parse_prometheus(svc.prometheus())
+        finally:
+            svc.shutdown(drain=False)
+    assert parsed["quest_serve_obs_calibration_loaded"][""] == 1.0
+    assert parsed["quest_serve_obs_calibration_stale"][""] == 0.0
+    assert "quest_serve_obs_compile_seconds_total" in parsed
+
+
+# ---------------------------------------------------------------------------
+# the harness end-to-end (fast settings) + env autoload
+# ---------------------------------------------------------------------------
+
+def test_run_calibration_fast_smoke(tmp_path):
+    prof = cal.run_calibration(num_qubits=12, repeats=1, iters=2,
+                               include_f64=False, include_pallas=False,
+                               collectives=False)
+    assert cal.validate_profile(prof.as_dict()) == []
+    for clsname in cal.REQUIRED_CLASSES:
+        assert prof.efficiencies[clsname] > 0
+    assert all(r >= 1.0 for r in prof.fit_residuals.values())
+    lo, hi = prof.wall_band
+    assert 0 < lo < 1 < hi
+    # derived classes are recorded as derived, measured ones are not
+    assert "pallas_epoch" in prof.measurements["derived"]
+    assert "f32_gate" not in prof.measurements["derived"]
+    # fitted constants activate end-to-end
+    path = tmp_path / "fast.json"
+    cal.save_profile(prof, str(path))
+    with cal.use_profile(cal.load_profile(str(path))):
+        assert planner.efficiency_for("f32_gate") == \
+            prof.efficiencies["f32_gate"]
+
+
+def test_env_autoload(tmp_path, monkeypatch):
+    prof = _profile({"f32_gate": 0.123})
+    path = tmp_path / "env.json"
+    cal.save_profile(prof, str(path))
+    monkeypatch.setattr(cal, "_ACTIVE", None)
+    monkeypatch.setattr(cal, "_ENV_CHECKED", False)
+    monkeypatch.setenv("QUEST_TPU_CALIBRATION", str(path))
+    try:
+        loaded = cal.active_profile()
+        assert loaded is not None and loaded.profile_id == prof.profile_id
+        assert planner.efficiency_for("f32_gate") == pytest.approx(0.123)
+    finally:
+        cal.deactivate()
+    # a bad path warns (once) and falls back to defaults, never raises
+    monkeypatch.setattr(cal, "_ACTIVE", None)
+    monkeypatch.setattr(cal, "_ENV_CHECKED", False)
+    monkeypatch.setenv("QUEST_TPU_CALIBRATION", str(tmp_path / "nope.json"))
+    try:
+        with pytest.warns(RuntimeWarning, match="QUEST_TPU_CALIBRATION"):
+            assert cal.active_profile() is None
+        assert planner.efficiency_for("f32_gate") == \
+            planner.MEASURED_EFFICIENCY["f32_gate"]
+    finally:
+        cal.deactivate()
+
+
+def test_merged_trace_report_sections():
+    """obs/export.py trace_report renders a MERGED multi-process document
+    with per-process sections and the clock offset noted (the satellite:
+    no more assuming a single-process recorder)."""
+    import copy
+    rec = obs.TraceRecorder(enabled=True)
+    with rec.span("work", step=1):
+        pass
+    import quest_tpu.obs.aggregate as agg
+    sh0 = agg.process_shard(rec, align_clock=False)
+    sh1 = copy.deepcopy(sh0)
+    sh1["process_index"] = 1
+    sh1["clock_offset_s"] = 0.0035
+    sh1["host"] = "replica-b"
+    merged = obs.merge_shards([sh0, sh1])
+    assert obs.validate_chrome_trace(merged) == []
+    text = obs.trace_report(merged)
+    assert "2 process(es)" in text
+    assert "process 1" in text and "replica-b" in text
+    assert "+0.003500s" in text
+    assert text.count("work") >= 2
+    # the degenerate single-shard merge renders without process sections
+    single = obs.trace_report(obs.merge_shards([sh0]))
+    assert "1 process(es)" in single and "clock offset" not in single
