@@ -1037,10 +1037,21 @@ def bench_random24_auto_engine(n=24, depth=4, iters=2):
 
 
 def bench_vqe16_auto_engine(n=16, layers=2, iters=4):
-    # n=16 sits BELOW the epoch engine's n>=17 block floor: the row
-    # documents the envelope (engine_tpu_spec == "xla", reasoned) next to
-    # the random24 row's pallas pick — both truthfully auto-dispatched
+    # n=16 now runs the DEGENERATE single-block geometry (the whole state
+    # is one VMEM tile): the ansatz must resolve to the Pallas engine on
+    # TPU-class specs as ONE fused pass — the row records pass counts +
+    # model speedup where it used to carry the "n>=17 floor" note.
+    # Registers below the 10-qubit degenerate floor keep the old XLA
+    # behaviour (asserted here so the envelope edge stays truthful).
+    from quest_tpu.parallel import planner
     from quest_tpu.serve.selftest import vqe_ansatz
+    spec = planner.select_engine(vqe_ansatz(n, layers, seed=0), 1,
+                                 backend="tpu")
+    assert spec["engine"] == "pallas", spec["reason"]
+    assert spec["plan"].hbm_passes == 1, spec["plan"].summary()
+    small = planner.select_engine(vqe_ansatz(8, layers, seed=0), 1,
+                                  backend="tpu")
+    assert small["engine"] == "xla", small["reason"]
     return bench_auto_engine(vqe_ansatz(n, layers, seed=0), n, iters)
 
 

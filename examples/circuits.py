@@ -37,3 +37,34 @@ def bernstein_vazirani(num_qubits: int = 16, secret: int = 2 ** 4 + 1) -> Circui
         if bit:
             c.cnot(0, qb)
     return c
+
+
+def mixed_envelope_16q() -> Circuit:
+    """A 16-qubit mixed window exercising the epoch executor's WIDENED
+    envelope (docs/SCHEDULER.md par.6): the degenerate single-block
+    geometry (n < 17: the whole state is one VMEM tile), cross-group 2q
+    dense gates lowered by the odd-bit block decomposition (targets
+    straddling the lane/sublane/fiber axis groups), controlled dense and
+    diagonal ops, and a swap absorbed by the deferred qubit map.  CI's
+    ``--verify-schedule --engine pallas`` step proves the lowering
+    IR-equivalent and probes the actual kernels in interpret mode."""
+    import numpy as np
+    rng = np.random.default_rng(16)
+
+    def haar(k: int) -> np.ndarray:
+        d = 1 << k
+        g = rng.normal(size=(d, d)) + 1j * rng.normal(size=(d, d))
+        u, r = np.linalg.qr(g)
+        return u * (np.diag(r) / np.abs(np.diag(r)))
+
+    c = Circuit(16)
+    c.h(0)
+    c.multi_qubit_unitary((3, 12), haar(2))      # lane x fiber: decomposed
+    c.multi_qubit_unitary((8, 14), haar(2))      # sublane x fiber
+    c.multi_qubit_unitary((5,), haar(1), controls=(11,))
+    c.cz(2, 9)
+    c.multi_rotate_z((0, 4, 8, 12), 0.61)
+    c.swap(1, 13)                                # deferred: zero passes
+    c.unitary(1, haar(1))
+    c.phase_shift(15, 0.37, controls=(6,))
+    return c

@@ -32,10 +32,12 @@ from .diagnostics import (AnalysisCode, Diagnostic, Severity,  # noqa: F401
 from .circuit_ir import analyze_circuit  # noqa: F401
 from .abstract_eval import check_abstract_eval  # noqa: F401
 from .purity import lint_package, lint_paths, lint_source  # noqa: F401
-from .equivalence import (check_equivalence, check_overlap_plan,  # noqa: F401
+from .equivalence import (check_epoch_plan, check_equivalence,  # noqa: F401
+                          check_overlap_plan, probe_epoch_execution,
                           verify_schedule)
-from .jaxpr_audit import (audit_dispatch, audit_overlap,  # noqa: F401
-                          audit_schedule_pair, count_hlo_async_collectives,
+from .jaxpr_audit import (audit_dispatch, audit_epoch_donation,  # noqa: F401
+                          audit_overlap, audit_schedule_pair,
+                          count_hlo_async_collectives,
                           count_hlo_collectives, count_jaxpr_collectives,
                           donation_aliased)
 
@@ -44,7 +46,9 @@ __all__ = [
     "analyze_circuit", "check_abstract_eval",
     "lint_source", "lint_paths", "lint_package",
     "check_equivalence", "check_overlap_plan", "verify_schedule",
-    "audit_dispatch", "audit_overlap", "audit_schedule_pair",
+    "check_epoch_plan", "probe_epoch_execution",
+    "audit_dispatch", "audit_epoch_donation", "audit_overlap",
+    "audit_schedule_pair",
     "count_jaxpr_collectives", "count_hlo_collectives",
     "count_hlo_async_collectives", "donation_aliased",
 ]

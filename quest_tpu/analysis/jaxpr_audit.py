@@ -423,3 +423,53 @@ def audit_overlap(circuit, num_devices: int, pipeline_chunks: int, *,
                     f"{async_counts['starts']} async start(s) with zero "
                     "start/done separation")))
     return report, out
+
+
+def audit_epoch_donation(circuit, *, label: str = "circuit"
+                         ) -> tuple[dict, list[Diagnostic]]:
+    """Audit the epoch executor's donated plane-pair program
+    (ops/epoch_pallas.py ``jit_program_planes``): both plane buffers are
+    donated, so the compiled module MUST carry ``input_output_alias``
+    entries — that aliasing is what makes the fused passes run truly in
+    place (one state copy of peak HBM at the 30q single-chip ceiling).  A
+    missing alias means every call pays two extra plane allocations:
+    ``A_DONATION_UNUSED``, the same contract :func:`audit_dispatch`
+    enforces for the (2, N) donate path.  Returns ``(report,
+    diagnostics)``; the report also counts the custom-call sites of the
+    lowered Pallas kernels so the CLI can show the pass count survived
+    compilation."""
+    import jax
+    import jax.numpy as jnp
+
+    from .. import _compat
+    from ..ops import epoch_pallas as _ep
+    from ..ops.apply import reconcile_perm_planes
+
+    n = circuit.num_qubits
+    ops = circuit.key()
+    plan = _ep.plan_circuit(ops, n)
+
+    def run(re, im):
+        re, im, perm = _ep.run_planes(re, im, ops)
+        return reconcile_perm_planes(re, im, perm)
+
+    spec = jax.ShapeDtypeStruct((1 << n,), jnp.float32)
+    with _compat.enable_x64(False):
+        text = jax.jit(run, donate_argnums=(0, 1)).lower(
+            spec, spec).compile().as_text()
+    report = {
+        "label": label,
+        "num_qubits": n,
+        "donation_aliased": donation_aliased(text),
+        "pallas_passes": plan.pallas_passes,
+        "hbm_passes": plan.hbm_passes,
+    }
+    out: list[Diagnostic] = []
+    if not report["donation_aliased"]:
+        out.append(diag(
+            AnalysisCode.DONATION_UNUSED, Severity.WARNING,
+            detail=(f"{label}: the epoch executor's donated plane-pair "
+                    "program compiled without an input_output_alias — the "
+                    "plane buffers are NOT reused and the in-place "
+                    "aliasing chain is broken")))
+    return report, out

@@ -500,8 +500,13 @@ def compile_circuit(circuit: Circuit, donate: bool = False,
     before sharded collectives — docs/DESIGN.md); forcing it on a mesh
     raises ``E_INVALID_SCHEDULE_OPTION``.  The returned function carries
     the decision as ``run.engine`` / ``run.engine_plan`` (the auditable
-    per-epoch lowering).  A non-f32 state falls back to the XLA program at
-    call time — the epoch engine is f32-only.
+    per-epoch lowering) and, when the epoch engine is resolved, a
+    plane-pair entry ``run.planes(re, im) -> (re, im)`` that applies the
+    same plan to plane storage with no (2, N) stack anywhere — both
+    planes donated when ``donate=True`` (``run.planes`` is None on the
+    XLA engine).  A non-f32
+    state falls back to the XLA program at call time — the epoch engine is
+    f32-only.
 
     ``overlap=True`` (implied by ``pipeline_chunks``) additionally lowers
     the scheduled circuit through the pipelined executor
@@ -593,6 +598,17 @@ def compile_circuit(circuit: Circuit, donate: bool = False,
     traced.engine_reason = choice["reason"]
     traced.engine_plan = choice["plan"]
     traced.engine_calibration = choice.get("calibration")
+    # plane-pair entry (epoch engine only): ``run.planes(re, im)`` applies
+    # the same plan to (re, im) plane storage with the residual qubit map
+    # reconciled per plane and no (2, N) stack anywhere — both planes
+    # donated under donate=True, the truly in-place path plane-storage
+    # registers need at the 30q single-chip ceiling (ops/epoch_pallas.py
+    # jit_program_planes; aliasing audited by analysis.audit_epoch_donation)
+    if resolved == "pallas":
+        from .serve.cache import global_cache
+        traced.planes = global_cache().epoch_plane_runner(ops, donate=donate)
+    else:
+        traced.planes = None
     return traced
 
 

@@ -510,12 +510,22 @@ def _fit_class(values: dict) -> tuple:
     return fit, spread
 
 
+#: the smallest register the degenerate-geometry microbench runs at (the
+#: ``pallas_epoch_small`` class: one single-block VMEM tile per pass)
+_SMALL_CAL_QUBITS = 12
+
+
 def _measure_pallas(n: int, repeats: int, iters: int, rows: dict,
                     chip) -> dict:
-    """Fused block + fiber pack passes through the real epoch executor
+    """Fused passes through the real epoch executor, per PASS KIND
     (interpret mode on CPU — slow but truthful for THAT backend, which is
     the point: a CPU profile must rate the interpret-mode engine as the
-    non-starter it is)."""
+    non-starter it is).  Returns ``{engine_class: {label: efficiency}}``
+    covering the three kinds the planner prices separately: fused block
+    passes (``pallas_epoch``), staged high-qubit pack passes — dense AND
+    controlled-dense, the widened envelope's new lowering —
+    (``pallas_epoch_pack``), and the degenerate single-block geometry of
+    10-16 qubit registers (``pallas_epoch_small``)."""
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -526,20 +536,16 @@ def _measure_pallas(n: int, repeats: int, iters: int, rows: dict,
 
     rng = np.random.default_rng(29)
     values: dict = {}
-    windows = {"block_lane": list(range(0, 7))}
-    if n > _ep.MIN_QUBITS:
-        windows["fiber_pack"] = list(range(_ep.MIN_QUBITS, n))
-    for label, qubits in windows.items():
-        c = Circuit(n)
-        for q in qubits:
-            c.unitary(q, _haar_unitary(rng))
-        ops = c.key()
-        plan = _ep.plan_circuit(ops, n)
+
+    def measure(label: str, engine_class: str, circuit) -> None:
+        nq = circuit.num_qubits
+        ops = circuit.key()
+        plan = _ep.plan_circuit(ops, nq)
         if plan.pallas_passes == 0 or plan.xla_ops:
-            continue
+            return
         t0 = time.perf_counter()
         call = _ep.jit_program(ops)
-        state = jnp.zeros((2, 1 << n), jnp.float32).at[0, 0].set(1.0)
+        state = jnp.zeros((2, 1 << nq), jnp.float32).at[0, 0].set(1.0)
         state = call(state)
         jax.block_until_ready(state)
         _counters.record_compile(time.perf_counter() - t0)
@@ -552,12 +558,35 @@ def _measure_pallas(n: int, repeats: int, iters: int, rows: dict,
             dt = time.perf_counter() - t0
             per = max(dt, 1e-9) / (iters * plan.hbm_passes)
             best = per if best is None else min(best, per)
-        eff = _implied_efficiency(best, n, 1, chip)
-        values[label] = eff
+        eff = _implied_efficiency(best, nq, 1, chip)
+        values.setdefault(engine_class, {})[label] = eff
         rows[f"pallas_{label}"] = {
-            "engine_class": "pallas_epoch", "kind": label,
+            "engine_class": engine_class, "kind": label,
             "seconds_per_pass": best, "implied_efficiency": eff,
-            "hbm_passes": plan.hbm_passes, "ops": len(ops), "precision": 1}
+            "hbm_passes": plan.hbm_passes, "ops": len(ops),
+            "num_qubits": nq, "precision": 1}
+
+    block_cls = ("pallas_epoch" if n >= _ep.HIGH_BASE
+                 else "pallas_epoch_small")
+    c = Circuit(n)
+    for q in range(0, 7):
+        c.unitary(q, _haar_unitary(rng))
+    measure("block_lane", block_cls, c)
+    if n > _ep.HIGH_BASE:
+        c = Circuit(n)
+        for q in range(_ep.HIGH_BASE, n):
+            c.unitary(q, _haar_unitary(rng))
+        measure("pack_high", "pallas_epoch_pack", c)
+        c = Circuit(n)
+        for _ in range(3):
+            c.multi_qubit_unitary((_ep.HIGH_BASE,), _haar_unitary(rng),
+                                  controls=(0,))
+        measure("pack_controlled", "pallas_epoch_pack", c)
+    if n >= _ep.HIGH_BASE and _ep.epoch_supported(_SMALL_CAL_QUBITS):
+        c = Circuit(_SMALL_CAL_QUBITS)
+        for q in range(0, 7):
+            c.unitary(q, _haar_unitary(rng))
+        measure("block_small", "pallas_epoch_small", c)
     return values
 
 
@@ -729,14 +758,27 @@ def run_calibration(chip=None, num_qubits: int | None = None,
         derived.append("f64_gate")
     ratio64 = efficiencies["f64_gate"] / defaults["f64_gate"]
 
-    if pallas_values:
-        fitp, spreadp = _fit_class(pallas_values)
+    if pallas_values.get("pallas_epoch"):
+        fitp, spreadp = _fit_class(pallas_values["pallas_epoch"])
         efficiencies["pallas_epoch"] = fitp
         residuals["pallas_epoch"] = spreadp
     else:
         efficiencies["pallas_epoch"] = defaults["pallas_epoch"] * ratio32
         residuals["pallas_epoch"] = spread32
         derived.append("pallas_epoch")
+    # the widened envelope's pass kinds (staged high-qubit packs, the
+    # degenerate small-register geometry): fitted where the harness
+    # measured them, else the default scaled by the block-pass correction
+    ratio_p = efficiencies["pallas_epoch"] / defaults["pallas_epoch"]
+    for cls in ("pallas_epoch_pack", "pallas_epoch_small"):
+        if pallas_values.get(cls):
+            fitc, spreadc = _fit_class(pallas_values[cls])
+            efficiencies[cls] = fitc
+            residuals[cls] = spreadc
+        else:
+            efficiencies[cls] = defaults[cls] * ratio_p
+            residuals[cls] = residuals["pallas_epoch"]
+            derived.append(cls)
 
     # classes without a dedicated probe: the default scaled by the measured
     # correction of the class they ride on (fused/in-place ride the f32

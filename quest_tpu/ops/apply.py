@@ -977,6 +977,62 @@ def reconcile_perm(state: jax.Array, perm: tuple) -> jax.Array:
     return state
 
 
+def permute_plane_bits(plane: jax.Array, mapping: dict) -> jax.Array:
+    """Apply a content map ``{src_position: dst_position}`` of amplitude-
+    index bits to ONE flat plane: the content of index bit ``src`` moves to
+    position ``dst``.  A bit permutation is real, so the re and im planes
+    transform independently — this is the plane-pair twin of
+    :func:`apply_bit_permutation` the epoch executor's donated plane
+    programs reconcile through (ops/epoch_pallas.py ``jit_program_planes``).
+
+    Lowered as ONE transpose of the minimal factorised view: every involved
+    bit is isolated as its own axis, untouched runs merge (so the rank stays
+    bounded by 2*|support| + 1 rather than n).  Minor-bit cycles pay a
+    relayout on TPU — the same cost the stacked path's pairwise-swap engine
+    pays, without the (2, N) stack."""
+    if not mapping:
+        return plane
+    n = int(plane.shape[0]).bit_length() - 1
+    support = set(mapping)
+    dims: list = []
+    axis_of: dict = {}
+    run = 0
+    q = n - 1
+    while q >= 0:
+        if q in support:
+            if run:
+                dims.append(1 << run)
+                run = 0
+            axis_of[q] = len(dims)
+            dims.append(2)
+        else:
+            run += 1
+        q -= 1
+    if run:
+        dims.append(1 << run)
+    t = plane.reshape(tuple(dims))
+    axes = list(range(t.ndim))
+    for src, dst in mapping.items():
+        # the output axis indexing bit dst carries the input axis of bit src
+        axes[axis_of[dst]] = axis_of[src]
+    return jnp.transpose(t, axes).reshape(-1)
+
+
+def reconcile_perm_planes(re: jax.Array, im: jax.Array, perm: tuple):
+    """Plane-pair twin of :func:`reconcile_perm`: restore logical ==
+    physical bit order on (re, im) storage without ever stacking the
+    planes (which would break the epoch engines' donation/aliasing chain).
+    The planes are permuted strictly one after the other — the
+    optimization barrier pins im's transpose behind re's completion, so at
+    most one transpose temp is in flight (the qft_inplace discipline)."""
+    mapping = {p: q for q, p in enumerate(perm) if p != q}
+    if not mapping:
+        return re, im
+    re = permute_plane_bits(re, mapping)
+    re, im = jax.lax.optimization_barrier((re, im))
+    return re, permute_plane_bits(im, mapping)
+
+
 @partial(jax.jit, static_argnames=("targets", "controls", "control_states"))
 def _apply_matrix_xla(state: jax.Array, u: jax.Array, targets: tuple,
                       controls: tuple = (), control_states: tuple = ()) -> jax.Array:

@@ -12,44 +12,76 @@ implementations behind one dispatch layer; ROADMAP item 2):
 1. **Fused block passes.**  Every op whose dense action is confined to one
    minor axis group of the tile view — lane (qubits 0-6), sublane (7-9) or
    fiber (10-16) — and every diagonal/parity op on ANY wires (their factor
-   is a function of the global amplitude index, which each (F=128, S=8,
-   L=128) block can reconstruct from ``program_id``) is block-local.  A
-   maximal run of such ops becomes ONE aliased Pallas pass applying all of
-   them MXU/VPU-resident in VMEM: k gates for one HBM read+write of the
-   state, the generalization of ``_qft_tail_kernel``'s 33-passes-in-one.
+   is a function of the global amplitude index, which each block can
+   reconstruct from ``program_id``) is block-local.  A maximal run of such
+   ops becomes ONE aliased Pallas pass applying all of them MXU/VPU-resident
+   in VMEM: k gates for one HBM read+write of the state.  Registers of
+   17-30 qubits walk (F=128, S=8, L=128) blocks; registers of 10-16 qubits
+   use the DEGENERATE geometry — the whole state is one (2^(n-10), 8, 128)
+   VMEM tile, one grid step — so small circuits (the 16q VQE ansatz) lower
+   to a handful of fused passes instead of falling outside the envelope.
 
-2. **Fiber passes for high qubits.**  Dense uncontrolled ops on qubits
-   >= 17 run through the aliased fiber engine (``pallas_layer
-   _apply_fiber_p``); consecutive ops in the same 7-qubit fiber group are
-   kron-embedded and composed host-side into one pack — one pass per group
-   per run, the generalization of the per-stage H passes.
+2. **Staged pack passes for high qubits.**  Dense ops on qubits >= 17 —
+   controlled or not: the control predicate is computed from the global
+   amplitude index reconstructed off ``program_id``, exactly like block
+   controls — run through the aliased pack engine: a (left, W, right)
+   factorisation whose blocks hold the FULL high-group axis, applying a
+   static program of dense/diagonal/parity stages per HBM pass.
+   Consecutive uncontrolled (or identically-controlled) dense stages
+   compose host-side; diagonals and mrz ops interleave as elementwise
+   stages, so a QFT stage's H + its whole controlled-phase ladder is one
+   stage run inside one pass.
 
-3. **Deferred qubit map.**  ``swap``/``bitperm`` ops never move data: they
+3. **Cross-group 2q dense windows.**  A 2-target dense gate whose targets
+   straddle two axis groups no longer splits the epoch: it is lowered
+   EXACTLY by a block-matrix (cosine-sine) decomposition over the odd bit
+   — ``U = (V1 (+) V2) . R . (W1 (+) W2)`` with the direct sums
+   block-diagonal over the odd bit (two controlled 1q dense ops on the
+   even bit) and the middle factor a pair of controlled Givens rotations
+   on the odd bit — six single-target controlled dense ops, each confined
+   to one group, each fusing into the surrounding block/pack passes (a
+   minor-minor gate costs ZERO extra passes; a minor-high gate at most a
+   pack stage plus stream boundaries).  The decomposition is verified
+   host-side against the original payload and falls back to the XLA gate
+   engine if reconstruction fails (exotic degenerate payloads).
+
+4. **Deferred qubit map.**  ``swap``/``bitperm`` ops never move data: they
    update a logical->physical wire permutation that later ops absorb into
    their wiring (the residual permutation is carried across epoch
    boundaries and materialized once, by ``reconcile_perm``, at the end of
    the program — or returned to plane-pair callers, the unordered-QFT
    convention).  The QFT's trailing swap network therefore costs ZERO
-   passes, and the whole transform lowers to exactly the hand-written
-   engine's ``2(n-17)+1`` HBM passes (regression-tested).
+   passes.
 
-Ops outside the supported set (cross-group multi-target dense gates,
-controlled dense on high qubits, >5-target general diagonals) split the
-epoch: they execute through the XLA gate engine between Pallas segments,
-with wires translated through the live permutation, so ANY circuit compiles
-— the planner's engine cost model (parallel/planner.py ``select_engine``)
-just rates mostly-unsupported circuits as XLA wins.
+The lowering runs TWO pending streams — a block pass and a pack pass —
+reordering ops between them only when a conservative commutation rule
+(disjoint wires; diagonal pairs; diagonal-vs-control block-diagonality)
+proves the swap sound, so a mixed window's high-qubit pack no longer
+splits the minor-block run: a 28q QFT lowers to 3 fused passes, a 24q
+random circuit layer run to ~2 per layer.  ``check_epoch_plan`` proves
+every reorder and decomposition IR-equivalent (the same Mazurkiewicz-trace
++ window-oracle domains that certify scheduler rewrites) and
+``probe_epoch_execution`` runs the actual kernels against the XLA engine.
 
-Envelope: f32 plane storage, 17 <= n <= 30 (the in-place layer floor; int32
-block indices).  Correctness gate: ``analysis/equivalence.py
-check_epoch_plan`` proves every lowering IR-equivalent to its window and
-``probe_epoch_execution`` runs the actual kernels (``pl.pallas_call``
-interpret mode on CPU) against the XLA engine — both wired into
-``--verify-schedule --engine pallas`` and the tier-1 suite.  The residual
-permutation MUST be materialized before any sharded collective (the map
-renames amplitude-index bits, which a mesh reshards on — docs/DESIGN.md);
-the engine is therefore single-device, and ``select_engine`` pins
-multi-device deployments to XLA.
+Ops outside the supported set (>=3-target dense gates straddling groups,
+>5-target general diagonals) split the epoch: they execute through the XLA
+gate engine between Pallas segments, with wires translated through the
+live permutation, so ANY circuit compiles — the planner's engine cost
+model (parallel/planner.py ``select_engine``) just rates mostly-
+unsupported circuits as XLA wins.
+
+Envelope: f32 plane storage, 10 <= n <= 30 (degenerate single-block
+geometry below 17; int32 block indices above 30 would overflow).  The
+residual permutation MUST be materialized before any sharded collective
+(the map renames amplitude-index bits, which a mesh reshards on —
+docs/DESIGN.md); the engine is therefore single-device, and
+``select_engine`` pins multi-device deployments to XLA.
+
+Plane-pair donation: :func:`run_planes` (returns the residual map),
+:func:`jit_program_planes` (donated, reconciled, truly in place) and the
+``(2, N)`` compat entries :func:`run_ops_planes` / :func:`jit_program`.
+The donated programs' input/output aliasing is machine-audited by
+``analysis/jaxpr_audit.audit_epoch_donation``.
 """
 
 from __future__ import annotations
@@ -66,25 +98,31 @@ from jax.experimental import pallas as pl
 from .. import _compat
 from .. import obs as _obs
 
-from .pallas_layer import (LANE, SUB, _fiber_group, _interpret, _shape3,
-                           _state_spec)
+from .pallas_layer import (LANE, SUB, _FIBER_COLS, _fiber_group, _interpret)
 from .qft_inplace import _block_k
 
 __all__ = ["EnginePlan", "Segment", "plan_circuit", "epoch_supported",
-           "run_ops_planes", "run_planes", "jit_program", "MIN_QUBITS",
-           "MAX_QUBITS"]
+           "run_ops_planes", "run_planes", "jit_program",
+           "jit_program_planes", "MIN_QUBITS", "MAX_QUBITS", "HIGH_BASE"]
 
-MIN_QUBITS = 17   # the (fiber, sublane, lane) block view floor
-MAX_QUBITS = 30   # int32 global amplitude indices in the block kernels
+MIN_QUBITS = 10   # degenerate single-block geometry floor (one (F, 8, 128)
+                  # VMEM tile needs at least the 2^10 sublane x lane plane)
+HIGH_BASE = 17    # qubits >= HIGH_BASE run through pack passes; below, the
+                  # (fiber, sublane, lane) block view covers them
+MAX_QUBITS = 30   # int32 global amplitude indices in the kernels
 
 # widest general diagonal lowered as in-kernel selects (2^5 = 32 entries);
 # wider diagonals fall back to the XLA gather engine
 _DIAG_CAP = 5
 
-# axis groups of the minor 17 qubits in the (F, S, L) tile view
+# axis groups of the minor qubits in the (F, S, L) tile view
 _LANE_Q = (0, 7)
 _SUB_Q = (7, 10)
 _FIBER_Q = (10, 17)
+
+# cross-group decomposition: host-side reconstruction tolerance — a factor
+# set that fails to rebuild the payload falls back to the XLA engine
+_CSD_TOL = 1e-9
 
 _X_PAIR = np.stack([np.array([[0.0, 1.0], [1.0, 0.0]]), np.zeros((2, 2))])
 _Y_PAIR = np.stack([np.zeros((2, 2)), np.array([[0.0, -1.0], [1.0, 0.0]])])
@@ -134,13 +172,27 @@ def _cstates(op) -> tuple:
     return tuple(op.control_states) or (1,) * len(op.controls)
 
 
+def _geometry(n_amps: int) -> tuple:
+    """(grid_size, 3-D view shape, block shape) of the block walk.  At
+    n >= 17 the standard (F=128, S=8, L=128) 2^17-amp blocks; below, the
+    DEGENERATE geometry — the whole state is one (2^(n-10), 8, 128) block,
+    a single grid step, every supported op block-local.  Both views are
+    byte-identical to the flat layout (free bitcasts)."""
+    block_amps = LANE * SUB * LANE
+    if n_amps >= block_amps:
+        top = n_amps // block_amps
+        return top, (top * LANE, SUB, LANE), (LANE, SUB, LANE)
+    f = n_amps // (SUB * LANE)
+    return 1, (f, SUB, LANE), (f, SUB, LANE)
+
+
 @dataclasses.dataclass(frozen=True, eq=False)
 class BlockPass:
     """One fused block-local Pallas pass: ``specs`` is the static kernel
     program (see ``_epoch_block_kernel``), ``mats`` the deduplicated
     embedded axis matrices it matmuls with."""
     specs: tuple
-    mats: tuple          # of np (2, D, D) float32, D in {128, 8}
+    mats: tuple          # of np (2, D, D) float32, D in {128, 8, 2^(n-10)}
 
     @property
     def kind(self) -> str:
@@ -148,24 +200,27 @@ class BlockPass:
 
 
 @dataclasses.dataclass(frozen=True, eq=False)
-class FiberPass:
-    """One aliased fiber pass: the composed kron pack of a run of dense
-    ops on one high-qubit fiber group [base, base+log2(width))."""
+class PackPass:
+    """One aliased staged pack pass over the (left, W, right) view of a
+    high-qubit group [base, base+log2(W)): ``specs`` is the static stage
+    program (dense contractions of the W axis — controlled or not — plus
+    diagonal/mrz elementwise stages), ``mats`` the composed packs."""
     base: int
     width: int
-    pack: np.ndarray     # (2, width, width) float32
+    specs: tuple
+    mats: tuple          # of np (2, W, W) float32
 
     @property
     def kind(self) -> str:
-        return "fiber"
+        return "pack"
 
 
 @dataclasses.dataclass
 class Segment:
     """A maximal single-engine run: ``ops`` are the window's ops with wires
-    already translated to PHYSICAL positions (the audit/reporting view and,
-    for xla segments, the execution list); ``passes`` is the Pallas
-    lowering (pallas segments only)."""
+    already translated to PHYSICAL positions, in EMITTED pass order (the
+    audit/reporting view and, for xla segments, the execution list);
+    ``passes`` is the Pallas lowering (pallas segments only)."""
     engine: str                  # 'pallas' | 'xla'
     ops: list
     passes: list
@@ -183,6 +238,16 @@ class EnginePlan:
     def pallas_passes(self) -> int:
         return sum(len(s.passes) for s in self.segments
                    if s.engine == "pallas")
+
+    @property
+    def block_passes(self) -> int:
+        return sum(1 for s in self.segments if s.engine == "pallas"
+                   for p in s.passes if p.kind == "block")
+
+    @property
+    def pack_passes(self) -> int:
+        return sum(1 for s in self.segments if s.engine == "pallas"
+                   for p in s.passes if p.kind == "pack")
 
     @property
     def pallas_ops(self) -> int:
@@ -209,10 +274,13 @@ class EnginePlan:
                           else len(s.ops)}
                          for s in self.segments],
             "pallas_passes": self.pallas_passes,
+            "block_passes": self.block_passes,
+            "pack_passes": self.pack_passes,
             "pallas_ops": self.pallas_ops,
             "xla_ops": self.xla_ops,
             "deferred_ops": self.deferred_ops,
             "hbm_passes": self.hbm_passes,
+            "degenerate_geometry": self.num_qubits < HIGH_BASE,
             "residual_nontrivial": self.residual_perm
             != tuple(range(self.num_qubits)),
         }
@@ -255,32 +323,187 @@ def _axis_group(targets: tuple) -> tuple | None:
 
 def _classify(op, n: int) -> str:
     """Lowering class of a PHYSICAL op: 'defer' (absorbed into the qubit
-    map), 'block' (fused block-local pass), 'fiber' (high-qubit pack pass),
-    or 'xla' (gate-engine fallback splitting the epoch)."""
+    map), 'block' (fused block-local pass), 'either' (diagonal family —
+    executable in both streams), 'pack' (high-qubit staged pass),
+    'cross2q' (2-target dense straddling groups: decomposed), or 'xla'
+    (gate-engine fallback splitting the epoch)."""
     if op.kind in ("swap", "bitperm"):
         return "defer"
     if op.kind == "mrz":
-        return "block"
+        return "either"
     if op.kind == "diagonal":
-        return "block" if len(op.targets) <= _DIAG_CAP else "xla"
+        return "either" if len(op.targets) <= _DIAG_CAP else "xla"
     if op.kind in ("matrix", "x", "y", "y*"):
         if _axis_group(op.targets) is not None:
             return "block"
-        if not op.controls and min(op.targets) >= MIN_QUBITS:
+        if min(op.targets) >= HIGH_BASE:
             base, hi = _fiber_group(min(op.targets), n)
             if max(op.targets) < hi:
-                return "fiber"
+                return "pack"
+        if len(op.targets) == 2:
+            return "cross2q"
         return "xla"
     return "xla"
 
 
-class _BlockBuilder:
-    """Accumulates consecutive block-class ops into one BlockPass."""
+def _stream_commutes(a, b) -> bool:
+    """Conservative (cheap, exact-rule-only) commutation used to reorder
+    ops between the two pending streams: disjoint wires; two overall-
+    diagonal ops; a diagonal whose shared wires are all the other op's
+    controls (block-diagonality).  A strict subset of the equivalence
+    checker's oracle, so every reorder the plan makes is provable."""
+    wa = set(a.targets) | set(a.controls)
+    wb = set(b.targets) | set(b.controls)
+    shared = wa & wb
+    if not shared:
+        return True
+    da = a.kind in ("diagonal", "mrz")
+    db = b.kind in ("diagonal", "mrz")
+    if da and db:
+        return True
+    if da and shared <= set(b.controls):
+        return True
+    if db and shared <= set(a.controls):
+        return True
+    return False
 
-    def __init__(self):
+
+# ---------------------------------------------------------------------------
+# cross-group 2q dense: the odd-bit block (cosine-sine) decomposition
+# ---------------------------------------------------------------------------
+
+def _complete_column(m: np.ndarray, i: int) -> None:
+    """Replace near-zero column ``i`` of a 2x2 with a unit vector
+    orthogonal to the other column (the degenerate-singular-value fill)."""
+    other = m[:, 1 - i]
+    for k in range(2):
+        cand = np.zeros(2, complex)
+        cand[k] = 1.0
+        cand = cand - other * np.vdot(other, cand)
+        nrm = np.linalg.norm(cand)
+        if nrm > 0.5:
+            m[:, i] = cand / nrm
+            return
+
+
+def _csd2(u: np.ndarray) -> tuple | None:
+    """Cosine-sine decomposition of a 4x4 unitary partitioned over its
+    HIGH index bit: ``u == blkdiag(V1, V2) @ [[C, -S], [S, C]] @
+    blkdiag(W1h, W2h)`` with C, S real non-negative diagonals.  The
+    factors are verified against ``u`` host-side; None when the
+    reconstruction misses ``_CSD_TOL`` (degenerate payloads fall back)."""
+    U00, U01 = u[:2, :2], u[:2, 2:]
+    U10, U11 = u[2:, :2], u[2:, 2:]
+    V1, c, W1h = np.linalg.svd(U00)
+    c = np.clip(c, 0.0, 1.0)
+    s = np.sqrt(np.maximum(0.0, 1.0 - c * c))
+    # U10 W1 = V2 S exactly (X^H X = W1^H (I - U00^H U00) W1 = S^2), so the
+    # normalized columns of X ARE V2 wherever s_i > 0
+    x = U10 @ W1h.conj().T
+    V2 = np.zeros((2, 2), complex)
+    for i in range(2):
+        nrm = np.linalg.norm(x[:, i])
+        if nrm > _CSD_TOL:
+            V2[:, i] = x[:, i] / nrm
+    for i in range(2):
+        if np.linalg.norm(V2[:, i]) < 0.5:
+            _complete_column(V2, i)
+    # W2h rows from whichever relation is well-conditioned per row:
+    # U11 = V2 C W2h and U01 = -V1 S W2h
+    y = V2.conj().T @ U11
+    z = V1.conj().T @ U01
+    W2h = np.zeros((2, 2), complex)
+    for i in range(2):
+        if c[i] >= s[i]:
+            W2h[i] = y[i] / c[i]
+        else:
+            W2h[i] = -z[i] / s[i]
+    zero = np.zeros((2, 2))
+    rec = (np.block([[V1, zero], [zero, V2]])
+           @ np.block([[np.diag(c), -np.diag(s)], [np.diag(s), np.diag(c)]])
+           @ np.block([[W1h, zero], [zero, W2h]]))
+    if np.max(np.abs(rec - u)) > _CSD_TOL:
+        return None
+    return V1, V2, c, s, W1h, W2h
+
+
+_BIT_SWAP_P = np.array([[1, 0, 0, 0], [0, 0, 1, 0],
+                        [0, 1, 0, 0], [0, 0, 0, 1]], float)
+
+
+def _cross2q_factors(op) -> list | None:
+    """Exact lowering of a 2-target dense op whose targets straddle two
+    axis groups into single-target controlled dense factors (application
+    order), each confined to one group.  Generic payloads take the
+    cosine-sine route (six factors); block-diagonal and anti-diagonal
+    payloads take exact two/three-factor shortcuts.  None when the
+    decomposition cannot be verified — the caller falls back to the XLA
+    gate engine for that op."""
+    from ..circuit import GateOp
+    up = _dense_pair(op)
+    u = (up[0] + 1j * up[1]).astype(complex)
+    t0, t1 = op.targets
+    # the odd (decomposition) bit is the higher physical position: the
+    # middle rotations land in ITS group's stream, the block-diagonal
+    # factors on the lower target's
+    if t1 >= t0:
+        a_t, b_t = t0, t1
+        jb = 1
+    else:
+        a_t, b_t = t1, t0
+        jb = 0
+    if jb == 0:  # payload index bit 0 is the odd bit: reorder to (b, a)
+        u = _BIT_SWAP_P @ u @ _BIT_SWAP_P
+    ctl = tuple(op.controls)
+    cst = tuple(op.control_states) or (1,) * len(ctl)
+
+    def gate(m, target, cbit=None, cval=1):
+        if np.max(np.abs(m - np.eye(2))) < 1e-12:
+            return None  # identity factor: skip
+        controls = ctl + (() if cbit is None else (cbit,))
+        states = cst + (() if cbit is None else (cval,))
+        mp = np.stack([np.asarray(m).real, np.asarray(m).imag])
+        return GateOp("matrix", (target,), controls, states,
+                      tuple(mp.ravel()), (2, 2, 2))
+
+    U00, U01 = u[:2, :2], u[:2, 2:]
+    U10, U11 = u[2:, :2], u[2:, 2:]
+    off = max(np.max(np.abs(U01)), np.max(np.abs(U10)))
+    dia = max(np.max(np.abs(U00)), np.max(np.abs(U11)))
+    if off < _CSD_TOL:   # block diagonal over the odd bit: two factors
+        factors = [gate(U00, a_t, b_t, 0), gate(U11, a_t, b_t, 1)]
+    elif dia < _CSD_TOL:  # anti-diagonal: X on the odd bit after the blocks
+        factors = [gate(U10, a_t, b_t, 0), gate(U01, a_t, b_t, 1),
+                   gate(_X_PAIR[0], b_t)]
+    else:
+        res = _csd2(u)
+        if res is None:
+            return None
+        V1, V2, c, s, W1h, W2h = res
+        r0 = np.array([[c[0], -s[0]], [s[0], c[0]]])
+        r1 = np.array([[c[1], -s[1]], [s[1], c[1]]])
+        factors = [gate(W1h, a_t, b_t, 0), gate(W2h, a_t, b_t, 1),
+                   gate(r0, b_t, a_t, 0), gate(r1, b_t, a_t, 1),
+                   gate(V1, a_t, b_t, 0), gate(V2, a_t, b_t, 1)]
+    return [f for f in factors if f is not None]
+
+
+# ---------------------------------------------------------------------------
+# stream builders
+# ---------------------------------------------------------------------------
+
+class _BlockBuilder:
+    """Accumulates block-class ops into one BlockPass.  ``ops`` carries the
+    pending physical ops in program order (the plan's audit record and the
+    cross-stream commutation witness list)."""
+
+    def __init__(self, n: int):
+        # degenerate geometry: the fiber axis is only n-10 bits wide
+        self._fiber_width = min(n, HIGH_BASE) - _FIBER_Q[0]
         self.specs: list = []
         self.mats: list = []
         self._mat_idx: dict = {}
+        self.ops: list = []
 
     def _intern(self, m: np.ndarray) -> int:
         key = m.tobytes()
@@ -291,6 +514,7 @@ class _BlockBuilder:
         return i
 
     def add(self, op) -> None:
+        self.ops.append(op)
         if op.kind == "mrz":
             half = float(op.matrix[0]) / 2.0
             self.specs.append(("mrz", op.targets,
@@ -305,22 +529,79 @@ class _BlockBuilder:
         group = _axis_group(op.targets)
         lo, hi = group
         axis = {0: "lane", 7: "sub", 10: "fiber"}[lo]
+        width = self._fiber_width if axis == "fiber" else hi - lo
         m = _embed_axis(_dense_pair(op), tuple(t - lo for t in op.targets),
-                        hi - lo).astype(np.float32)
+                        width).astype(np.float32)
         self.specs.append(("dense", axis, self._intern(m), op.controls,
                            _cstates(op)))
 
-    def flush(self):
+    def flush(self) -> tuple:
         if not self.specs:
-            return None
+            return None, []
         out = BlockPass(tuple(self.specs), tuple(self.mats))
-        self.specs, self.mats, self._mat_idx = [], [], {}
-        return out
+        ops = self.ops
+        self.specs, self.mats, self._mat_idx, self.ops = [], [], {}, []
+        return out, ops
+
+
+class _PackBuilder:
+    """Accumulates high-qubit pack-class ops (and diagonal-family ops
+    routed to the pack stream) into one staged PackPass on the
+    [base, hi) group.  Adjacent dense stages with identical control
+    predicates compose host-side into one pack."""
+
+    def __init__(self, base: int, hi: int):
+        self.base = base
+        self.hi = hi
+        self.width = 1 << (hi - base)
+        self.specs: list = []
+        self.mats: list = []     # f64 until flush
+        self.ops: list = []
+
+    def add(self, op) -> None:
+        self.ops.append(op)
+        if op.kind == "mrz":
+            half = float(op.matrix[0]) / 2.0
+            self.specs.append(("mrz", op.targets,
+                               float(np.cos(half)), float(np.sin(half))))
+            return
+        if op.kind == "diagonal":
+            d = op.payload()
+            self.specs.append(("diag", op.targets, op.controls, _cstates(op),
+                               tuple(np.float32(x) for x in d[0]),
+                               tuple(np.float32(x) for x in d[1])))
+            return
+        m = _embed_axis(_dense_pair(op),
+                        tuple(t - self.base for t in op.targets),
+                        self.hi - self.base)
+        key = (op.controls, _cstates(op))
+        last = self.specs[-1] if self.specs else None
+        if (last is not None and last[0] == "dense"
+                and (last[2], last[3]) == key):
+            self.mats[last[1]] = _pair_compose(m, self.mats[last[1]])
+            return
+        self.mats.append(m)
+        self.specs.append(("dense", len(self.mats) - 1, op.controls,
+                           _cstates(op)))
+
+    def flush(self) -> tuple:
+        if not self.specs:
+            return None, []
+        out = PackPass(self.base, self.width, tuple(self.specs),
+                       tuple(m.astype(np.float32) for m in self.mats))
+        ops = self.ops
+        self.specs, self.mats, self.ops = [], [], []
+        return out, ops
 
 
 def epoch_supported(num_qubits: int, precision: int = 1) -> bool:
     """Whether the epoch engine's envelope admits this register at all
-    (individual ops may still fall back per-window)."""
+    (individual ops may still fall back per-window).  The remaining
+    out-of-envelope cases: f64 states (the kernels are f32 plane engines),
+    registers below the 10-qubit degenerate-block floor or above the
+    30-qubit int32-index ceiling — and multi-device meshes, which
+    ``select_engine`` pins to XLA (the deferred qubit map must materialize
+    before sharded collectives)."""
     return precision == 1 and MIN_QUBITS <= num_qubits <= MAX_QUBITS
 
 
@@ -347,8 +628,8 @@ def _plan_circuit_impl(ops: tuple, num_qubits: int) -> EnginePlan:
             f"epoch executor needs {MIN_QUBITS} <= n <= {MAX_QUBITS}, got {n}")
     perm = list(range(n))
     segments: list = []
-    builder = _BlockBuilder()
-    fiber_run: list | None = None   # [base, width, pack]
+    block = _BlockBuilder(n)
+    pack: _PackBuilder | None = None
     deferred = 0
 
     def seg(engine: str) -> Segment:
@@ -356,18 +637,50 @@ def _plan_circuit_impl(ops: tuple, num_qubits: int) -> EnginePlan:
             segments.append(Segment(engine, [], []))
         return segments[-1]
 
-    def flush_block():
-        bp = builder.flush()
+    def flush_streams() -> None:
+        # emission order: block pass FIRST, then pack pass — ops were only
+        # reordered between the streams where _stream_commutes proved it
+        nonlocal pack
+        bp, bops = block.flush()
+        pp, pops = pack.flush() if pack is not None else (None, [])
+        pack = None
+        if bp is None and pp is None:
+            return
+        s = seg("pallas")
         if bp is not None:
-            seg("pallas").passes.append(bp)
+            s.passes.append(bp)
+            s.ops.extend(bops)
+        if pp is not None:
+            s.passes.append(pp)
+            s.ops.extend(pops)
 
-    def flush_fiber():
-        nonlocal fiber_run
-        if fiber_run is not None:
-            seg("pallas").passes.append(
-                FiberPass(fiber_run[0], fiber_run[1],
-                          fiber_run[2].astype(np.float32)))
-            fiber_run = None
+    def commutes_with_pack(op) -> bool:
+        return pack is None or all(_stream_commutes(op, q)
+                                   for q in pack.ops)
+
+    def route(pop, cls: str) -> None:
+        nonlocal pack
+        if cls == "block":
+            # a block op executes BEFORE the pending pack pass: sound only
+            # when it commutes with everything already in the pack stream
+            if not commutes_with_pack(pop):
+                flush_streams()
+            block.add(pop)
+            return
+        if cls == "either":
+            # diagonal family: block-executable in both streams — prefer
+            # the block stream, fall to the pack stream when order pins it
+            if commutes_with_pack(pop):
+                block.add(pop)
+            else:
+                pack.add(pop)
+            return
+        base, hi = _fiber_group(min(pop.targets), n)
+        if pack is not None and pack.base != base:
+            flush_streams()
+        if pack is None:
+            pack = _PackBuilder(base, hi)
+        pack.add(pop)
 
     for op in ops:
         pop = _phys_op(op, perm)
@@ -376,38 +689,72 @@ def _plan_circuit_impl(ops: tuple, num_qubits: int) -> EnginePlan:
             _absorb_perm(perm, op)
             deferred += 1
             continue
-        if cls == "block":
-            flush_fiber()
-            builder.add(pop)
-            seg("pallas").ops.append(pop)
-            continue
-        if cls == "fiber":
-            flush_block()
-            base, hi = _fiber_group(min(pop.targets), n)
-            width = 1 << (hi - base)
-            pack = _embed_axis(_dense_pair(pop),
-                               tuple(t - base for t in pop.targets),
-                               hi - base)
-            if fiber_run is not None and fiber_run[0] == base:
-                fiber_run[2] = _pair_compose(pack, fiber_run[2])
+        if cls == "cross2q":
+            factors = _cross2q_factors(pop)
+            if factors is None:
+                cls = "xla"
             else:
-                flush_fiber()
-                fiber_run = [base, width, pack]
-            seg("pallas").ops.append(pop)
+                for f in factors:
+                    route(f, _classify(f, n))
+                continue
+        if cls == "xla":
+            flush_streams()
+            seg("xla").ops.append(pop)
             continue
-        flush_block()
-        flush_fiber()
-        seg("xla").ops.append(pop)
-    flush_block()
-    flush_fiber()
+        route(pop, cls)
+    flush_streams()
     return EnginePlan(n, segments, tuple(perm), deferred)
+
+
+# ---------------------------------------------------------------------------
+# shared spec appliers (traced inside both kernels)
+# ---------------------------------------------------------------------------
+
+def _ctrl_mask(k, controls: tuple, cstates: tuple):
+    m = None
+    for c, st in zip(controls, cstates):
+        t = ((k >> c) & 1) == st
+        m = t if m is None else (m & t)
+    return m
+
+
+def _apply_diag_spec(spec, k, xr, xi):
+    _, targets, controls, cstates, dr, di = spec
+    idx = None
+    for j, t in enumerate(targets):
+        b = ((k >> t) & 1) << j if j else (k >> t) & 1
+        idx = b if idx is None else idx | b
+    vr = jnp.full_like(xr, 1.0)
+    vi = jnp.zeros_like(xr)
+    for b in range(len(dr)):
+        if dr[b] == np.float32(1.0) and di[b] == np.float32(0.0):
+            continue  # entries equal to 1 are never written
+        eq = idx == b
+        vr = jnp.where(eq, jnp.float32(dr[b]), vr)
+        vi = jnp.where(eq, jnp.float32(di[b]), vi)
+    if controls:
+        m = _ctrl_mask(k, controls, cstates)
+        vr = jnp.where(m, vr, jnp.float32(1.0))
+        vi = jnp.where(m, vi, jnp.float32(0.0))
+    return xr * vr - xi * vi, xr * vi + xi * vr
+
+
+def _apply_mrz_spec(spec, k, xr, xi):
+    _, targets, c_, s_ = spec
+    par = None
+    for t in targets:
+        b = (k >> t) & 1
+        par = b if par is None else par ^ b
+    cc = jnp.float32(c_)
+    sn = jnp.where(par == 1, jnp.float32(s_), jnp.float32(-s_))
+    return xr * cc - xi * sn, xr * sn + xi * cc
 
 
 # ---------------------------------------------------------------------------
 # the fused block kernel
 # ---------------------------------------------------------------------------
 
-def _epoch_block_kernel(specs: tuple, *refs):
+def _epoch_block_kernel(specs: tuple, block_amps: int, *refs):
     """Apply a static program of block-local ops to one (F, S, L) block.
 
     ``specs`` entries (everything host-constant; the only kernel INPUTS are
@@ -431,17 +778,7 @@ def _epoch_block_kernel(specs: tuple, *refs):
     xr = re_ref[...]
     xi = im_ref[...]
     f, s, l = xr.shape
-    k = _block_k(xr.shape, pl.program_id(0) * jnp.int32(LANE * SUB * LANE))
-
-    def bit(q):
-        return (k >> q) & 1
-
-    def ctrl(controls, cstates):
-        m = None
-        for c, st in zip(controls, cstates):
-            t = bit(c) == st
-            m = t if m is None else (m & t)
-        return m
+    k = _block_k(xr.shape, pl.program_id(0) * jnp.int32(block_amps))
 
     def rdot(x, m):     # minor axis: out[., j] = sum_l x[., l] m[j, l]
         return jax.lax.dot_general(x, m, (((1,), (1,)), ((), ())),
@@ -478,55 +815,33 @@ def _epoch_block_kernel(specs: tuple, *refs):
                 nr = (ldot(mr, ar) - ldot(mim, ai)).reshape(f, s, l)
                 ni = (ldot(mim, ar) + ldot(mr, ai)).reshape(f, s, l)
             if controls:
-                m = ctrl(controls, cstates)
+                m = _ctrl_mask(k, controls, cstates)
                 nr = jnp.where(m, nr, xr)
                 ni = jnp.where(m, ni, xi)
             xr, xi = nr, ni
         elif tag == "diag":
-            _, targets, controls, cstates, dr, di = spec
-            idx = None
-            for j, t in enumerate(targets):
-                b = bit(t) << j if j else bit(t)
-                idx = b if idx is None else idx | b
-            vr = jnp.full_like(xr, 1.0)
-            vi = jnp.zeros_like(xr)
-            for b in range(len(dr)):
-                if dr[b] == np.float32(1.0) and di[b] == np.float32(0.0):
-                    continue
-                eq = idx == b
-                vr = jnp.where(eq, jnp.float32(dr[b]), vr)
-                vi = jnp.where(eq, jnp.float32(di[b]), vi)
-            if controls:
-                m = ctrl(controls, cstates)
-                vr = jnp.where(m, vr, jnp.float32(1.0))
-                vi = jnp.where(m, vi, jnp.float32(0.0))
-            xr, xi = xr * vr - xi * vi, xr * vi + xi * vr
+            xr, xi = _apply_diag_spec(spec, k, xr, xi)
         else:
-            _, targets, c_, s_ = spec
-            par = None
-            for t in targets:
-                par = bit(t) if par is None else par ^ bit(t)
-            cc = jnp.float32(c_)
-            sn = jnp.where(par == 1, jnp.float32(s_), jnp.float32(-s_))
-            xr, xi = xr * cc - xi * sn, xr * sn + xi * cc
+            xr, xi = _apply_mrz_spec(spec, k, xr, xi)
     ore_ref[...] = xr
     oim_ref[...] = xi
 
 
 def _run_block_pass(re, im, bp: BlockPass):
-    top, shape3 = _shape3(re.shape[0])
+    top, shape3, blk = _geometry(re.shape[0])
     ins = []
     in_specs = []
     for m in bp.mats:
         d = m.shape[1]
         ins += [jnp.asarray(m[0]), jnp.asarray(m[1])]
         in_specs += [pl.BlockSpec((d, d), lambda i: (0, 0))] * 2
+    state_spec = pl.BlockSpec(blk, lambda i: (i, 0, 0))
     run = pl.pallas_call(
-        partial(_epoch_block_kernel, bp.specs),
+        partial(_epoch_block_kernel, bp.specs, blk[0] * blk[1] * blk[2]),
         interpret=_interpret(),
         grid=(top,),
-        in_specs=in_specs + [_state_spec(), _state_spec()],
-        out_specs=[_state_spec(), _state_spec()],
+        in_specs=in_specs + [state_spec, state_spec],
+        out_specs=[state_spec, state_spec],
         out_shape=[
             jax.ShapeDtypeStruct(shape3, re.dtype),
             jax.ShapeDtypeStruct(shape3, re.dtype),
@@ -539,9 +854,86 @@ def _run_block_pass(re, im, bp: BlockPass):
     return out_re.reshape(-1), out_im.reshape(-1)
 
 
-def _run_fiber_pass(re, im, fp: FiberPass):
-    from .pallas_layer import _apply_fiber_p
-    return _apply_fiber_p(re, im, jnp.asarray(fp.pack), fp.base, fp.width)
+# ---------------------------------------------------------------------------
+# the staged pack kernel (high-qubit groups)
+# ---------------------------------------------------------------------------
+
+def _epoch_pack_kernel(specs: tuple, w: int, right: int, cols: int, *refs):
+    """Apply a static stage program to one (W, cols) block of the
+    (left, W, right) high-group view.  The global amplitude index of
+    element (f, c) of grid block (i, j) is
+    ``k = (i*W + f) * right + j*cols + c`` (int32: n <= 30), off which
+    control predicates, diagonal factors and mrz parities are computed —
+    so controlled dense ops on high qubits no longer force an XLA segment.
+
+    Stages: ``('dense', mat_idx, controls, cstates)`` contracts the W axis
+    with the composed pack; ``('diag', ...)``/``('mrz', ...)`` are the
+    same elementwise stages as the block kernel."""
+    nmats = (len(refs) - 4) // 2
+    mats = refs[:2 * nmats]
+    re_ref, im_ref, ore_ref, oim_ref = refs[2 * nmats:]
+    hp = jax.lax.Precision.HIGHEST
+    xr = re_ref[...]
+    xi = im_ref[...]
+    f = jax.lax.broadcasted_iota(jnp.int32, xr.shape, 0)
+    cix = jax.lax.broadcasted_iota(jnp.int32, xr.shape, 1)
+    k = ((pl.program_id(0) * jnp.int32(w) + f) * jnp.int32(right)
+         + pl.program_id(1) * jnp.int32(cols) + cix)
+
+    def ldot(m, x):
+        return jax.lax.dot_general(m, x, (((1,), (0,)), ((), ())),
+                                   precision=hp,
+                                   preferred_element_type=x.dtype)
+
+    for spec in specs:
+        tag = spec[0]
+        if tag == "dense":
+            _, mi, controls, cstates = spec
+            mr = mats[2 * mi][...]
+            mim = mats[2 * mi + 1][...]
+            nr = ldot(mr, xr) - ldot(mim, xi)
+            ni = ldot(mim, xr) + ldot(mr, xi)
+            if controls:
+                m = _ctrl_mask(k, controls, cstates)
+                nr = jnp.where(m, nr, xr)
+                ni = jnp.where(m, ni, xi)
+            xr, xi = nr, ni
+        elif tag == "diag":
+            xr, xi = _apply_diag_spec(spec, k, xr, xi)
+        else:
+            xr, xi = _apply_mrz_spec(spec, k, xr, xi)
+    ore_ref[...] = xr
+    oim_ref[...] = xi
+
+
+def _run_pack_pass(re, im, pp: PackPass):
+    n_amps = re.shape[0]
+    right = 1 << pp.base
+    w = pp.width
+    left = n_amps // (right * w)
+    cols = min(_FIBER_COLS, right)
+    shape = (left * w, right)  # rank-2: rows a*w+f, block rows = one group
+    ins = []
+    in_specs = []
+    for m in pp.mats:
+        ins += [jnp.asarray(m[0]), jnp.asarray(m[1])]
+        in_specs += [pl.BlockSpec((w, w), lambda i, j: (0, 0))] * 2
+    state_spec = pl.BlockSpec((w, cols), lambda i, j: (i, j))
+    run = pl.pallas_call(
+        partial(_epoch_pack_kernel, pp.specs, w, right, cols),
+        interpret=_interpret(),
+        grid=(left, right // cols),
+        in_specs=in_specs + [state_spec, state_spec],
+        out_specs=[state_spec, state_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct(shape, re.dtype),
+            jax.ShapeDtypeStruct(shape, re.dtype),
+        ],
+        # in-place: out block (i, j) reads only in block (i, j)
+        input_output_aliases={len(ins): 0, len(ins) + 1: 1},
+    )
+    out_re, out_im = run(*ins, re.reshape(shape), im.reshape(shape))
+    return out_re.reshape(-1), out_im.reshape(-1)
 
 
 # ---------------------------------------------------------------------------
@@ -562,7 +954,7 @@ def run_planes(re: jax.Array, im: jax.Array, ops: tuple):
                 if p.kind == "block":
                     re, im = _run_block_pass(re, im, p)
                 else:
-                    re, im = _run_fiber_pass(re, im, p)
+                    re, im = _run_pack_pass(re, im, p)
         else:
             from ..circuit import _apply_one
             state = jnp.stack([re, im])
@@ -574,10 +966,12 @@ def run_planes(re: jax.Array, im: jax.Array, ops: tuple):
 
 def run_ops_planes(state: jax.Array, ops: tuple) -> jax.Array:
     """(2, N) compatibility entry: plane split, epoch chain, residual
-    permutation reconciled (``reconcile_perm`` — fused prefix transposes).
-    The plane slice/stack at the boundaries costs a state copy next to the
-    truly in-place :func:`run_planes`; fine through 29 qubits."""
-    from .apply import num_qubits_of, reconcile_perm
+    permutation reconciled PER PLANE (``reconcile_perm_planes`` — the
+    aliasing chain is never broken by a premature stack), one stack at the
+    boundary.  Under a donating jit (:func:`jit_program`) XLA aliases that
+    stack into the donated input buffer; plane-pair callers use
+    :func:`jit_program_planes` and never stack at all."""
+    from .apply import num_qubits_of, reconcile_perm_planes
     n = num_qubits_of(state)
     if state.dtype != jnp.float32:
         raise ValueError(f"epoch executor is f32-only, got {state.dtype}")
@@ -585,7 +979,8 @@ def run_ops_planes(state: jax.Array, ops: tuple) -> jax.Array:
         raise ValueError(
             f"epoch executor needs {MIN_QUBITS} <= n <= {MAX_QUBITS}, got {n}")
     re, im, perm = run_planes(state[0], state[1], tuple(ops))
-    return reconcile_perm(jnp.stack([re, im]), perm)
+    re, im = reconcile_perm_planes(re, im, perm)
+    return jnp.stack([re, im])
 
 
 def jit_program(ops, donate: bool = False):
@@ -602,5 +997,30 @@ def jit_program(ops, donate: bool = False):
     def call(state):
         with _compat.enable_x64(False):
             return run(state)
+
+    return call
+
+
+def jit_program_planes(ops, donate: bool = True):
+    """The plane-pair twin of :func:`jit_program`: one jitted
+    ``(re, im) -> (re, im)`` program with BOTH planes donated, the residual
+    qubit map reconciled per plane, and no (2, N) stack anywhere — the
+    truly in-place program plane-storage registers need at the 30-qubit
+    single-chip ceiling.  Input/output aliasing is machine-audited by
+    ``analysis.audit_epoch_donation``."""
+    ops = tuple(ops)
+
+    @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
+    def run(re, im):
+        from .apply import reconcile_perm_planes
+        re, im, perm = run_planes(re, im, ops)
+        return reconcile_perm_planes(re, im, perm)
+
+    def call(re, im):
+        if re.dtype != jnp.float32 or im.dtype != jnp.float32:
+            raise ValueError("epoch executor is f32-only, got "
+                             f"({re.dtype}, {im.dtype}) planes")
+        with _compat.enable_x64(False):
+            return run(re, im)
 
     return call

@@ -214,8 +214,15 @@ MEASURED_EFFICIENCY = {
     "f64_best": 0.21,     # best measured f64 flip-kernel window (r05)
     # the general epoch executor (ops/epoch_pallas.py) inherits the in-place
     # engine class it generalizes: its passes are the same aliased
-    # block/fiber kernels the qft_30q rows measured at 0.27-0.31
-    "pallas_epoch": 0.29,
+    # block/fiber kernels the qft_30q rows measured at 0.27-0.31.  The
+    # three pass kinds get their own classes so a calibration profile can
+    # fit them separately (obs/calibrate.py measures each):
+    "pallas_epoch": 0.29,        # fused block passes, full (128,8,128) walk
+    "pallas_epoch_pack": 0.29,   # staged high-qubit pack passes
+    # the degenerate single-block geometry (10 <= n <= 16): the whole state
+    # is one VMEM tile, so passes are launch-latency- not bandwidth-bound;
+    # the default is deliberately conservative until a profile fits it
+    "pallas_epoch_small": 0.20,
 }
 
 
@@ -464,9 +471,12 @@ def engine_time_model(circuit, chip: ChipSpec = V5E, precision: int = 1,
     backends for ``circuit``: the per-gate XLA engine (one HBM pass per op,
     ``f32_gate``/``f64_gate`` efficiency — the deliberately conservative
     convention of :func:`time_model`) vs the Pallas epoch executor's fused
-    lowering (``plan.hbm_passes`` aliased passes; Pallas segments at the
-    measured ``pallas_epoch`` efficiency, fallback XLA segments at the gate
-    efficiency).  Returns the auditable breakdown ``select_engine`` scores;
+    lowering (``plan.hbm_passes`` aliased passes; block passes at the
+    measured ``pallas_epoch`` efficiency — or ``pallas_epoch_small`` below
+    the full block-walk floor, where the whole state is one VMEM tile and
+    passes are latency- not bandwidth-bound — staged pack passes at
+    ``pallas_epoch_pack``, fallback XLA segments at the gate efficiency).
+    Returns the auditable breakdown ``select_engine`` scores;
     ``pallas_seconds`` is None outside the epoch engine's envelope."""
     from ..ops import epoch_pallas as _ep
     n = circuit.num_qubits
@@ -475,8 +485,12 @@ def engine_time_model(circuit, chip: ChipSpec = V5E, precision: int = 1,
     eff_xla = efficiency_for("f32_gate" if precision == 1 else "f64_gate",
                              chip)
     pass_s_xla = 2.0 * state_bytes / (chip.hbm_bytes_per_sec * eff_xla)
-    pass_s_pallas = 2.0 * state_bytes / (
-        chip.hbm_bytes_per_sec * efficiency_for("pallas_epoch", chip))
+    block_class = ("pallas_epoch_small" if n < _ep.HIGH_BASE
+                   else "pallas_epoch")
+    pass_s_block = 2.0 * state_bytes / (
+        chip.hbm_bytes_per_sec * efficiency_for(block_class, chip))
+    pass_s_pack = 2.0 * state_bytes / (
+        chip.hbm_bytes_per_sec * efficiency_for("pallas_epoch_pack", chip))
     out = {
         "num_qubits": n,
         "ops": len(circuit.ops),
@@ -491,10 +505,14 @@ def engine_time_model(circuit, chip: ChipSpec = V5E, precision: int = 1,
     if plan is None:
         plan = _ep.plan_circuit(circuit.key(), n)
     out["pallas_hbm_passes"] = plan.hbm_passes
-    out["pallas_seconds"] = (plan.pallas_passes * pass_s_pallas
+    out["pallas_seconds"] = (plan.block_passes * pass_s_block
+                             + plan.pack_passes * pass_s_pack
                              + plan.xla_ops * pass_s_xla)
     out["pallas_pass_breakdown"] = {
         "pallas_passes": plan.pallas_passes,
+        "block_passes": plan.block_passes,
+        "pack_passes": plan.pack_passes,
+        "block_efficiency_class": block_class,
         "xla_fallback_ops": plan.xla_ops,
         "deferred_perm_ops": plan.deferred_ops,
     }
@@ -560,10 +578,23 @@ def _select_engine_impl(circuit, num_devices: int | None = None,
     if requested == "xla":
         return xla("requested")
     if multi or not supported:
-        reason = ("multi-device mesh: the deferred qubit map must "
-                  "materialize before sharded collectives" if multi else
-                  f"outside the in-place envelope (f32, "
-                  f"{_ep.MIN_QUBITS} <= n <= {_ep.MAX_QUBITS})")
+        # name the REMAINING out-of-envelope case precisely: meshes, f64,
+        # and the n range are all that is left — cross-group 2q windows,
+        # controlled dense on high qubits and small registers are now
+        # in-envelope, and >= 3-target cross-group dense gates / wide
+        # diagonals fall back PER OP inside the plan, never rejecting the
+        # circuit
+        if multi:
+            reason = ("multi-device mesh: the deferred qubit map must "
+                      "materialize before sharded collectives")
+        elif precision != 1:
+            reason = ("f64 state: the epoch engines are f32 plane kernels "
+                      "(use engine='xla' for f64)")
+        else:
+            reason = (f"register outside {_ep.MIN_QUBITS} <= n <= "
+                      f"{_ep.MAX_QUBITS}: no degenerate block geometry "
+                      f"below {_ep.MIN_QUBITS} qubits, int32 amplitude "
+                      f"indices overflow above {_ep.MAX_QUBITS}")
         if requested == "pallas":
             from ..validation import MESSAGES, ErrorCode, QuESTError
             raise QuESTError(ErrorCode.INVALID_SCHEDULE_OPTION,

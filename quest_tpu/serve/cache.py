@@ -386,6 +386,40 @@ class CompileCache:
 
         return self._get_program(entry, tag, build)
 
+    def epoch_plane_program(self, entry: CacheEntry, ops: tuple, *,
+                            donate: bool = True) -> _Program:
+        """The plane-pair twin of :meth:`epoch_program`: a donated
+        ``(re, im) -> (re, im)`` executable (ops/epoch_pallas.py
+        ``jit_program_planes``) so plane-storage callers never stack the
+        (2, N) pair — the entry ``compile_circuit`` threads through as
+        ``run.planes``.  Cached under the class entry like every other
+        signature, so the byte budget governs it too."""
+        tag = ("epoch_planes", bool(donate), ops)
+
+        def build():
+            from ..ops import epoch_pallas as _ep
+            return _ep.jit_program_planes(ops, donate=donate)
+
+        return self._get_program(entry, tag, build)
+
+    def epoch_plane_runner(self, ops, donate: bool = True):
+        """``(re, im) -> (re, im)`` adapter over the pallas class's cached
+        plane program (the ``compile_circuit`` hook; see
+        :meth:`epoch_plane_program`)."""
+        ops = tuple(ops)
+        options = CacheOptions(engine="pallas")
+        resolved: dict = {}
+
+        def run(re, im):
+            hit = resolved.get("p")
+            if hit is None or not hit[0].alive:
+                entry = self.entry_for(ops, options=options)
+                prog = self.epoch_plane_program(entry, ops, donate=donate)
+                resolved["p"] = hit = (entry, prog.call)
+            return hit[1](re, im)
+
+        return run
+
     # -- execution front-ends -----------------------------------------------
     def execute(self, ops, state, params=None, *, num_qubits=None,
                 options: CacheOptions = CacheOptions(),

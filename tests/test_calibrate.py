@@ -364,6 +364,11 @@ def test_run_calibration_fast_smoke(tmp_path):
     assert cal.validate_profile(prof.as_dict()) == []
     for clsname in cal.REQUIRED_CLASSES:
         assert prof.efficiencies[clsname] > 0
+    # the widened envelope's pass kinds are ALWAYS priced in the profile —
+    # fitted where measured, else derived off the block-pass correction
+    for clsname in ("pallas_epoch_pack", "pallas_epoch_small"):
+        assert prof.efficiencies[clsname] > 0
+        assert clsname in prof.measurements["derived"]
     assert all(r >= 1.0 for r in prof.fit_residuals.values())
     lo, hi = prof.wall_band
     assert 0 < lo < 1 < hi
@@ -376,6 +381,23 @@ def test_run_calibration_fast_smoke(tmp_path):
     with cal.use_profile(cal.load_profile(str(path))):
         assert planner.efficiency_for("f32_gate") == \
             prof.efficiencies["f32_gate"]
+
+
+def test_run_calibration_measures_small_geometry():
+    """include_pallas at n=12 runs the degenerate single-block microbench:
+    the pallas_epoch_small class is FITTED from a real interpret-mode row,
+    not derived, and the row carries the new pass-kind metadata."""
+    prof = cal.run_calibration(num_qubits=12, repeats=1, iters=1,
+                               include_f64=False, include_pallas=True,
+                               collectives=False)
+    assert cal.validate_profile(prof.as_dict()) == []
+    assert "pallas_epoch_small" not in prof.measurements["derived"]
+    assert prof.efficiencies["pallas_epoch_small"] > 0
+    row = prof.measurements["pallas_block_lane"]
+    assert row["engine_class"] == "pallas_epoch_small"
+    assert row["num_qubits"] == 12
+    # no high qubits at n=12: the pack class stays derived
+    assert "pallas_epoch_pack" in prof.measurements["derived"]
 
 
 def test_env_autoload(tmp_path, monkeypatch):

@@ -2,11 +2,17 @@
 same kernel code runs Mosaic-compiled on a chip (the pallas_layer /
 qft_inplace engines it generalizes are chip-validated at n=20..30).
 
-Covers: random 1q/2q/diagonal windows vs the XLA gate engine, the deferred
-qubit map carried across 2+ epoch segments, degenerate single-op windows
-(bit-exact f32 for diagonal kinds), the QFT HBM-pass-count regression
-(engine="auto" must NOT silently fall back to the per-gate XLA path), the
-planner's engine selection, and the engine-tagged compile-cache keys.
+Covers: random 1q/2q/diagonal windows vs the XLA gate engine, the WIDENED
+envelope's four lowerings (cross-group 2q dense via the odd-bit block
+decomposition, controlled dense on high qubits through the staged pack
+predicate, the 10-16 qubit degenerate single-block geometry, and plane-pair
+donation end-to-end), the deferred qubit map carried across 2+ epoch
+segments, degenerate single-op windows (bit-exact f32 for diagonal kinds),
+the QFT HBM-pass-count regression (engine="auto" must NOT silently fall
+back to the per-gate XLA path), the planner's engine selection with the
+remaining-cases-only rejection messages, an adversarial corrupted
+cross-group decomposition caught by check_epoch_plan, and the
+engine-tagged compile-cache keys.
 """
 
 from __future__ import annotations
@@ -22,7 +28,7 @@ from quest_tpu.ops import epoch_pallas as ep
 from quest_tpu.parallel import planner
 from quest_tpu.validation import QuESTError
 
-N = 17  # the engine floor: one (128, 8, 128) block
+N = 17  # the full block-walk floor: one (128, 8, 128) block per grid step
 
 
 def _haar(rng, k=1):
@@ -136,20 +142,377 @@ def test_control_across_block_boundary():
 
 
 # ---------------------------------------------------------------------------
+# widened envelope 1: cross-group 2q dense (odd-bit block decomposition)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("pair", [(3, 8), (2, 14), (8, 12)])
+def test_cross_group_2q_dense_minor_minor(pair):
+    """A 2q dense gate straddling two MINOR axis groups (lane-sub,
+    lane-fiber, sub-fiber) decomposes into single-target controlled dense
+    factors that fuse into the SAME block pass: zero XLA fallback, zero
+    extra passes."""
+    rng = np.random.default_rng(sum(pair))
+    c = Circuit(N)
+    c.h(0)
+    c.multi_qubit_unitary(pair, _haar(rng, 2))
+    c.cz(1, 2)
+    plan = ep.plan_circuit(c.key(), N)
+    assert plan.xla_ops == 0
+    assert plan.hbm_passes == 1      # the whole window is one block pass
+    _assert_engines_agree(c, atol=5e-6)
+
+
+def test_cross_group_2q_dense_minor_high():
+    """Targets straddling a minor group and the high range: the
+    block-diagonal factors land in the minor stream, the middle Givens
+    rotations in the pack stream — still zero XLA fallback."""
+    n = 19
+    rng = np.random.default_rng(21)
+    c = Circuit(n)
+    c.multi_qubit_unitary((5, 18), _haar(rng, 2))
+    plan = ep.plan_circuit(c.key(), n)
+    assert plan.xla_ops == 0
+    assert plan.pack_passes >= 1
+    _assert_engines_agree(c, atol=5e-6)
+
+
+def test_cross_group_2q_dense_reversed_target_order():
+    """targets=(hi, lo): payload index bit 0 is the odd bit — the
+    decomposition must reorder through the bit-swap conjugation."""
+    rng = np.random.default_rng(31)
+    c = Circuit(N)
+    c.multi_qubit_unitary((14, 3), _haar(rng, 2))
+    plan = ep.plan_circuit(c.key(), N)
+    assert plan.xla_ops == 0
+    _assert_engines_agree(c, atol=5e-6)
+
+
+def test_cross_group_2q_dense_controlled():
+    """A CONTROLLED cross-group 2q dense: the original controls ride on
+    every factor alongside the decomposition's own odd-bit control."""
+    rng = np.random.default_rng(41)
+    c = Circuit(N)
+    c.multi_qubit_unitary((4, 12), _haar(rng, 2), controls=(9,),
+                          control_states=(0,))
+    plan = ep.plan_circuit(c.key(), N)
+    assert plan.xla_ops == 0
+    _assert_engines_agree(c, atol=5e-6)
+
+
+def test_cross_group_2q_degenerate_payloads():
+    """Block-diagonal, anti-diagonal and singular-CS payloads (a dense
+    SWAP matrix has c = (1, 0): the degenerate-column completion path):
+    the shortcut and fill-in routes must all reconstruct exactly."""
+    swap_mat = np.array([[1, 0, 0, 0], [0, 0, 1, 0],
+                         [0, 1, 0, 0], [0, 0, 0, 1]], complex)
+    rng = np.random.default_rng(51)
+    u1, u2 = _haar(rng), _haar(rng)
+    zero = np.zeros((2, 2))
+    blockdiag = np.block([[u1, zero], [zero, u2]])
+    antidiag = np.block([[zero, u1], [u2, zero]])
+    for mat in (swap_mat, blockdiag, antidiag):
+        c = Circuit(N)
+        c.multi_qubit_unitary((5, 14), mat)
+        plan = ep.plan_circuit(c.key(), N)
+        assert plan.xla_ops == 0, mat
+        _assert_engines_agree(c, atol=5e-6)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_cross_group_random_mixed_window(seed):
+    """Randomized mixed windows with cross-group 2q dense gates
+    interleaved among every other supported class (the satellite coverage
+    case): zero XLA fallback, engines agree."""
+    rng = np.random.default_rng(1000 + seed)
+    c = _random_window(N, seed, length=10)
+    groups = [(0, 7), (7, 10), (10, 17)]
+    for _ in range(3):
+        ga, gb = rng.choice(3, size=2, replace=False)
+        a = int(rng.integers(*groups[ga]))
+        b = int(rng.integers(*groups[gb]))
+        c.multi_qubit_unitary((a, b), _haar(rng, 2))
+    plan = ep.plan_circuit(c.key(), N)
+    assert plan.xla_ops == 0
+    _assert_engines_agree(c, seed, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# widened envelope 2: controlled dense on high qubits (pack predicate)
+# ---------------------------------------------------------------------------
+
+def test_controlled_dense_high_qubits():
+    """Controlled dense ops with targets >= 17 run through the staged pack
+    engine — the control predicate computed off the reconstructed global
+    amplitude index — instead of forcing an XLA segment."""
+    n = 19
+    rng = np.random.default_rng(61)
+    c = Circuit(n)
+    c.multi_qubit_unitary((18,), _haar(rng), controls=(2,))
+    c.multi_qubit_unitary((17,), _haar(rng), controls=(18,),
+                          control_states=(0,))
+    c.multi_qubit_unitary((18,), _haar(rng), controls=(3, 17))
+    plan = ep.plan_circuit(c.key(), n)
+    assert plan.xla_ops == 0
+    assert plan.pack_passes >= 1
+    _assert_engines_agree(c, atol=5e-6)
+
+
+def test_controlled_dense_high_identical_controls_compose():
+    """Adjacent dense stages with IDENTICAL control predicates compose
+    host-side into one pack; differing predicates stay separate stages in
+    the same pass."""
+    n = 18
+    rng = np.random.default_rng(71)
+    c = Circuit(n)
+    c.multi_qubit_unitary((17,), _haar(rng), controls=(4,))
+    c.multi_qubit_unitary((17,), _haar(rng), controls=(4,))
+    c.multi_qubit_unitary((17,), _haar(rng), controls=(5,))
+    plan = ep.plan_circuit(c.key(), n)
+    assert plan.pack_passes == 1
+    assert plan.xla_ops == 0
+    [seg] = plan.segments
+    [pp] = seg.passes
+    dense_stages = [s for s in pp.specs if s[0] == "dense"]
+    assert len(dense_stages) == 2    # first two composed, third separate
+    _assert_engines_agree(c, atol=5e-6)
+
+
+# ---------------------------------------------------------------------------
+# widened envelope 3: 10-16 qubit degenerate single-block geometry
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n", [10, 12, 16])
+def test_small_n_random_window_matches_xla(n):
+    """Registers below the full block-walk floor run the degenerate
+    geometry: the whole state is one VMEM tile, every supported op is
+    block-local, and mixed windows lower to ONE fused pass."""
+    rng = np.random.default_rng(n)
+    c = Circuit(n)
+    for _ in range(10):
+        kind = rng.integers(0, 5)
+        if kind == 0:
+            c.unitary(int(rng.integers(0, n)), _haar(rng))
+        elif kind == 1:
+            t = int(rng.integers(0, n))
+            ctl = int(rng.choice([q for q in range(n) if q != t]))
+            c.multi_qubit_unitary((t,), _haar(rng), controls=(ctl,))
+        elif kind == 2:
+            a, b = rng.choice(n, size=2, replace=False)
+            c.cz(int(a), int(b))
+        elif kind == 3:
+            c.rz(int(rng.integers(0, n)), float(rng.uniform(-np.pi, np.pi)))
+        else:
+            a, b = rng.choice(n, size=2, replace=False)
+            c.swap(int(a), int(b))
+    plan = ep.plan_circuit(c.key(), n)
+    assert plan.xla_ops == 0
+    assert plan.hbm_passes <= 1
+    assert plan.summary()["degenerate_geometry"]
+    _assert_engines_agree(c, seed=n, atol=5e-6)
+
+
+def test_small_n_cross_group_window():
+    """Cross-group 2q dense in the degenerate geometry (the axis groups
+    still partition the minor bits; the fiber axis is just narrower)."""
+    rng = np.random.default_rng(81)
+    c = Circuit(12)
+    c.h(0)
+    c.multi_qubit_unitary((3, 11), _haar(rng, 2))
+    c.cz(2, 8)
+    plan = ep.plan_circuit(c.key(), 12)
+    assert plan.xla_ops == 0
+    assert plan.hbm_passes == 1
+    _assert_engines_agree(c, atol=5e-6)
+
+
+def test_small_n_diagonal_bit_exact():
+    """Diagonal windows stay BIT-exact in the degenerate geometry too."""
+    c = Circuit(12)
+    c.cz(2, 11)
+    c.s(9)
+    c.rz(0, 0.37)
+    got, want = _assert_engines_agree(c, atol=0.0)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_small_n_qft_one_pass():
+    for n in (10, 16):
+        plan = ep.plan_circuit(qft_circuit(n).key(), n)
+        assert plan.xla_ops == 0
+        assert plan.hbm_passes == 1
+        assert plan.deferred_ops == n // 2
+
+
+def test_vqe16_resolves_to_pallas_on_tpu_spec():
+    """The 16q VQE ansatz — the circuit the old envelope rejected with the
+    'n >= 17 floor' note — must now resolve to the Pallas engine on
+    TPU-class specs as ONE fused pass; registers below the 10-qubit floor
+    keep the old XLA behaviour."""
+    from quest_tpu.serve.selftest import vqe_ansatz
+    c = vqe_ansatz(16, 2, seed=0)
+    choice = planner.select_engine(c, 1, backend="tpu")
+    assert choice["engine"] == "pallas"
+    assert choice["plan"].hbm_passes == 1
+    assert choice["plan"].summary()["degenerate_geometry"]
+    small = vqe_ansatz(8, 2, seed=0)
+    assert planner.select_engine(small, 1, backend="tpu")["engine"] == "xla"
+    assert not ep.epoch_supported(8)
+
+
+def test_random24_plan_beats_committed_r05_pass_count():
+    """Acceptance: the random24 auto-engine row's plan pass count must
+    strictly decrease vs the committed r05 figure (9 passes, PR 6's
+    narrow-envelope lowering — cross-group 2q gates split epochs then)."""
+    plan = ep.plan_circuit(random_circuit(24, 4, seed=11).key(), 24)
+    assert plan.xla_ops == 0
+    assert plan.hbm_passes < 9
+    assert plan.hbm_passes == 6
+
+
+# ---------------------------------------------------------------------------
+# widened envelope 4: plane-pair donation end-to-end
+# ---------------------------------------------------------------------------
+
+def test_plane_pair_program_matches_stacked():
+    """jit_program_planes (the donated (re, im) -> (re, im) program) must
+    agree with the (2, N) compat entry on every lowering, including a
+    nontrivial residual permutation reconciled PER PLANE."""
+    rng = np.random.default_rng(91)
+    c = _random_window(N, 3, length=8)
+    c.multi_qubit_unitary((5, 14), _haar(rng, 2))
+    c.swap(0, 16)
+    st = _rand_state(N, 5)
+    want = np.asarray(ep.jit_program(c.key())(st))
+    re, im = jnp.array(st[0]), jnp.array(st[1])
+    out_re, out_im = ep.jit_program_planes(c.key(), donate=True)(re, im)
+    np.testing.assert_allclose(np.asarray(out_re), want[0], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out_im), want[1], atol=1e-6)
+
+
+def test_plane_pair_program_rejects_non_f32():
+    """The planes entry has no XLA fallback (plane callers are f32 by
+    construction) — non-f32 planes must get the clean envelope error, not
+    an internal Pallas dtype failure."""
+    c = Circuit(12)
+    c.h(0)
+    call = ep.jit_program_planes(c.key(), donate=False)
+    re = jnp.zeros(1 << 12, jnp.float64)
+    with pytest.raises(ValueError, match="f32-only"):
+        call(re, re)
+
+
+def test_reconcile_perm_planes_matches_stacked():
+    """The plane-pair residual reconciliation is the same bit permutation
+    as the stacked reconcile_perm: EXACT equality on both planes."""
+    from quest_tpu.ops.apply import reconcile_perm, reconcile_perm_planes
+    rng = np.random.default_rng(13)
+    n = 12
+    perm = tuple(rng.permutation(n).tolist())
+    st = _rand_state(n, 7)
+    want = np.asarray(reconcile_perm(st, perm))
+    re, im = reconcile_perm_planes(st[0], st[1], perm)
+    np.testing.assert_array_equal(np.asarray(re), want[0])
+    np.testing.assert_array_equal(np.asarray(im), want[1])
+
+
+def test_compile_circuit_exposes_plane_runner(monkeypatch):
+    """compile_circuit on the epoch engine carries run.planes — the
+    donated plane-pair entry — and run.planes is None on the XLA engine."""
+    c = qft_circuit(N)
+    run_x = compile_circuit(c, engine="xla")
+    assert run_x.planes is None
+    run_p = compile_circuit(c, engine="pallas")
+    assert run_p.planes is not None
+    st = _rand_state(N, 9)
+    want = np.asarray(run_p(st))
+    re, im = run_p.planes(jnp.array(st[0]), jnp.array(st[1]))
+    np.testing.assert_allclose(np.asarray(re), want[0], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(im), want[1], atol=1e-6)
+
+
+def test_audit_epoch_donation_aliases_planes():
+    """The donated plane-pair program must compile with input_output_alias
+    entries on THIS backend (the machine check behind the 'truly in place'
+    claim) and the audit must report the plan's pass counts."""
+    from quest_tpu.analysis.jaxpr_audit import audit_epoch_donation
+    c = qft_circuit(N)
+    report, diags = audit_epoch_donation(c, label="qft17")
+    assert report["donation_aliased"], diags
+    assert report["pallas_passes"] == 1
+    assert diags == []
+
+
+def test_compat_entry_stack_aliases_under_donation():
+    """The (2, N) compat entry reconciles the residual map PER PLANE and
+    stacks once at the boundary: under a donating jit that stack must
+    alias into the donated input buffer (no extra state copy)."""
+    import jax
+    from functools import partial
+    from quest_tpu import _compat
+    ops = qft_circuit(N).key()
+    spec = jax.ShapeDtypeStruct((2, 1 << N), jnp.float32)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def run(state):
+        return ep.run_ops_planes(state, ops)
+
+    with _compat.enable_x64(False):
+        text = run.lower(spec).compile().as_text()
+    assert "input_output_alias" in text
+
+
+# ---------------------------------------------------------------------------
+# adversarial: corrupted cross-group decomposition caught by the IR proof
+# ---------------------------------------------------------------------------
+
+def test_check_epoch_plan_catches_corrupted_decomposition():
+    """Tamper with the cross-group decomposition's middle factor (the
+    controlled Givens rotation — the 'diagonal correction' of the odd-bit
+    block form) inside an otherwise-valid plan: check_epoch_plan must
+    refuse to certify it (V_SEMANTICS_CHANGED)."""
+    from quest_tpu.analysis.equivalence import check_epoch_plan
+    from quest_tpu.circuit import GateOp
+    rng = np.random.default_rng(17)
+    c = Circuit(12)
+    c.multi_qubit_unitary((3, 11), _haar(rng, 2))
+    plan = ep.plan_circuit(c.key(), 12)
+    assert check_epoch_plan(c, plan=plan) == []   # the honest plan proves
+    [seg] = plan.segments
+    # the middle rotations are the factors TARGETING the odd (higher) bit
+    idx = next(i for i, o in enumerate(seg.ops) if o.targets == (11,))
+    victim = seg.ops[idx]
+    theta = 0.31
+    bad_mat = np.stack([np.array([[np.cos(theta), -np.sin(theta)],
+                                  [np.sin(theta), np.cos(theta)]]),
+                        np.zeros((2, 2))])
+    bad = GateOp(victim.kind, victim.targets, victim.controls,
+                 victim.control_states, tuple(bad_mat.ravel()), (2, 2, 2))
+    tampered_ops = list(seg.ops)
+    tampered_ops[idx] = bad
+    tampered = ep.EnginePlan(
+        12, [ep.Segment(seg.engine, tampered_ops, seg.passes)],
+        plan.residual_perm, plan.deferred_ops)
+    diags = check_epoch_plan(c, plan=tampered)
+    assert any(d.code == "V_SEMANTICS_CHANGED" for d in diags), diags
+
+
+# ---------------------------------------------------------------------------
 # deferred qubit map across 2+ epochs
 # ---------------------------------------------------------------------------
 
 def test_deferred_map_carries_across_epochs():
     """Swaps before, between and after two Pallas segments split by an
-    unsupported op (cross-group 2q dense -> XLA fallback window): the
-    residual permutation must be carried through ALL of it and reconciled
-    once at the end."""
+    unsupported op (a >= 3-target dense gate straddling axis groups — the
+    only dense shape still outside the widened envelope -> XLA fallback
+    window): the residual permutation must be carried through ALL of it
+    and reconciled once at the end.  A cross-group 2q dense no longer
+    splits (it decomposes — test_cross_group_* below)."""
     rng = np.random.default_rng(11)
     c = Circuit(N)
     c.swap(0, 12)
     c.unitary(0, _haar(rng))          # physically lands on wire 12
     c.cz(0, 5)
-    c.multi_qubit_unitary((5, 14), _haar(rng, 2))   # cross-group: XLA
+    c.multi_qubit_unitary((5, 8, 14), _haar(rng, 3))   # 3q cross-group: XLA
     c.swap(3, 16)
     c.unitary(3, _haar(rng))
     c.t(16)
@@ -213,15 +576,21 @@ def test_single_dense_op(q):
 # ---------------------------------------------------------------------------
 
 def test_qft_plan_reproduces_inplace_pass_count():
-    """The general epoch lowering of the QFT must match (here: beat by one,
-    the q=17 ladder fusing into the tail pass) the hand-written
+    """The general epoch lowering of the QFT must beat the hand-written
     qft_inplace engine's ~2(n-17)+1 HBM passes, with the trailing swap
-    network absorbed into the deferred map at zero passes."""
-    for n in (22, 28):
+    network absorbed into the deferred map at zero passes.  Since the
+    two-stream lowering (controlled dense on high qubits rides the staged
+    pack predicate; diagonals interleave as elementwise stages) the whole
+    high ladder fuses into ONE pack pass per 7-qubit fiber group: one
+    block pass + ceil((n-17)/7) packs — 2 passes at 22q, 3 at 28q, down
+    from the per-stage 10/22 of the narrow envelope."""
+    for n, want in ((22, 2), (28, 3)):
         plan = ep.plan_circuit(qft_circuit(n).key(), n)
         assert plan.xla_ops == 0, "silent per-gate fallback"
-        assert plan.hbm_passes <= 2 * (n - 17) + 1
-        assert plan.hbm_passes == 2 * (n - 17)
+        assert plan.hbm_passes <= 2 * (n - 17) + 1  # the historical bound
+        assert plan.hbm_passes == want
+        assert plan.block_passes == 1
+        assert plan.pack_passes == want - 1
         assert plan.deferred_ops == n // 2          # the swap network
         assert plan.residual_perm != tuple(range(n))
 
@@ -250,30 +619,72 @@ def test_select_engine_rules():
     assert planner.select_engine(qft, 1, backend="tpu")["engine"] == "pallas"
     assert planner.select_engine(random_circuit(24, 4), 1,
                                  backend="tpu")["engine"] == "pallas"
+    # 10-16 qubit registers are now IN-envelope (degenerate single-block
+    # geometry): the 12q QFT is one fused pass, a clear pallas win
+    assert planner.select_engine(qft_circuit(12), 1,
+                                 backend="tpu")["engine"] == "pallas"
     # off-TPU, auto stays on the XLA engine (interpret mode is not a perf
     # engine); forcing still works
     assert planner.select_engine(qft, 1, backend="cpu")["engine"] == "xla"
     assert planner.select_engine(qft, 1, backend="cpu",
                                  requested="pallas")["engine"] == "pallas"
-    # outside the envelope: f64, small registers, meshes
+    # the REMAINING out-of-envelope cases: f64, n < 10, meshes
     assert planner.select_engine(qft, 1, precision=2,
                                  backend="tpu")["engine"] == "xla"
-    assert planner.select_engine(qft_circuit(12), 1,
+    assert planner.select_engine(qft_circuit(8), 1,
                                  backend="tpu")["engine"] == "xla"
     assert planner.select_engine(qft, 8, backend="tpu")["engine"] == "xla"
     with pytest.raises(QuESTError):
         planner.select_engine(qft, 8, requested="pallas")
     with pytest.raises(QuESTError):
-        planner.select_engine(qft_circuit(12), 1, requested="pallas")
+        planner.select_engine(qft_circuit(8), 1, requested="pallas")
     with pytest.raises(ValueError):
         planner.select_engine(qft, 1, requested="mosaic")
+
+
+def test_envelope_rejection_messages_name_remaining_cases():
+    """Forcing engine='pallas' outside the envelope raises
+    E_INVALID_SCHEDULE_OPTION whose message names the SPECIFIC remaining
+    unsupported case — meshes, f64 states, the n range — not the
+    pre-widening blanket '17 <= n' envelope (cross-group 2q windows,
+    controlled dense on high qubits and 10-16 qubit registers are now
+    in-envelope; >= 3-target cross-group dense gates fall back PER OP
+    inside the plan and never reject the circuit)."""
+    qft = qft_circuit(22)
+    with pytest.raises(QuESTError) as err:
+        planner.select_engine(qft, 8, requested="pallas")
+    assert err.value.code == "E_INVALID_SCHEDULE_OPTION"
+    assert "multi-device mesh" in str(err.value)
+    with pytest.raises(QuESTError) as err:
+        planner.select_engine(qft, 1, precision=2, requested="pallas")
+    assert err.value.code == "E_INVALID_SCHEDULE_OPTION"
+    assert "f64" in str(err.value)
+    with pytest.raises(QuESTError) as err:
+        planner.select_engine(qft_circuit(8), 1, requested="pallas")
+    assert err.value.code == "E_INVALID_SCHEDULE_OPTION"
+    assert f"{ep.MIN_QUBITS} <= n <= {ep.MAX_QUBITS}" in str(err.value)
+    # compile_circuit(engine="pallas") surfaces the same contract
+    with pytest.raises(QuESTError) as err:
+        compile_circuit(qft_circuit(8), engine="pallas")
+    assert err.value.code == "E_INVALID_SCHEDULE_OPTION"
+    # a >= 3-target cross-group dense op does NOT reject: it is planned as
+    # a per-op XLA fallback window inside an accepted pallas program
+    rng = np.random.default_rng(0)
+    c = Circuit(N)
+    c.h(0)
+    c.multi_qubit_unitary((2, 8, 14), _haar(rng, 3))
+    choice = planner.select_engine(c, 1, requested="pallas")
+    assert choice["engine"] == "pallas"
+    assert choice["plan"].xla_ops == 1
 
 
 def test_engine_summary_per_epoch():
     c = Circuit(N)
     rng = np.random.default_rng(5)
     c.h(0)
-    c.multi_qubit_unitary((3, 12), _haar(rng, 2))   # cross-group: XLA epoch
+    # a 3-target cross-group dense still splits the epoch (a 2-target one
+    # now decomposes — see test_cross_group_2q_dense_minor_minor)
+    c.multi_qubit_unitary((3, 8, 12), _haar(rng, 3))
     c.cz(1, 2)
     s = planner.engine_summary(c, 1, requested="pallas")
     assert s["engine"] == "pallas"
@@ -345,12 +756,16 @@ def test_donating_runner_engine_dimension():
 # ---------------------------------------------------------------------------
 
 def test_envelope_rejections():
+    """The remaining out-of-envelope registers: below the 10-qubit
+    degenerate-geometry floor, above the 30-qubit int32-index ceiling,
+    f64.  10-16 qubit registers are IN (the widened envelope)."""
     with pytest.raises(ValueError):
-        ep.plan_circuit(qft_circuit(12).key(), 12)
-    st = jnp.zeros((2, 1 << 12), jnp.float32)
+        ep.plan_circuit(qft_circuit(8).key(), 8)
+    st = jnp.zeros((2, 1 << 8), jnp.float32)
     with pytest.raises(ValueError):
-        ep.run_ops_planes(st, qft_circuit(12).key())
-    assert not ep.epoch_supported(12)
+        ep.run_ops_planes(st, qft_circuit(8).key())
+    assert not ep.epoch_supported(9)
     assert not ep.epoch_supported(31)
     assert not ep.epoch_supported(20, precision=2)
-    assert ep.epoch_supported(20)
+    for n in (10, 12, 16, 17, 20, 30):
+        assert ep.epoch_supported(n), n

@@ -411,7 +411,9 @@ def test_circuit_stats_fused_qft28_matches_epoch_plan():
     st = circuit_stats(c)
     plan = plan_circuit(c.key(), 28)
     assert st.engine == "pallas"
-    assert st.hbm_passes == plan.hbm_passes == 22
+    # the widened two-stream lowering: 1 block pass + 2 fiber-group packs
+    # (was 22 under the narrow per-stage envelope, 420 per-op)
+    assert st.hbm_passes == plan.hbm_passes == 3
     assert st.deferred_perm_ops == plan.deferred_ops == 14
     # the historical per-op model survives as the explicit fused=False mode
     old = circuit_stats(c, fused=False)
@@ -423,9 +425,48 @@ def test_circuit_stats_fused_qft28_matches_epoch_plan():
         assert stats.diagonal_ops == 378
 
 
+def test_circuit_stats_widened_envelope_16q():
+    """Satellite regression: a 16-qubit circuit must report the degenerate
+    single-block geometry's fused count through the widened plan_circuit —
+    ONE pass for the whole VQE ansatz — not the per-op model the old
+    'n >= 17 floor' forced."""
+    from quest_tpu.ops.epoch_pallas import plan_circuit
+    from quest_tpu.serve.selftest import vqe_ansatz
+    from quest_tpu.utils.profiling import circuit_stats
+    c = vqe_ansatz(16, 2, seed=0)
+    st = circuit_stats(c)
+    plan = plan_circuit(c.key(), 16)
+    assert st.engine == "pallas"
+    assert st.hbm_passes == plan.hbm_passes == 1
+    assert st.num_ops == len(c.ops) > 1
+
+
+def test_circuit_stats_cross_group_mixed_window():
+    """Satellite regression: cross-group 2q dense ops no longer inflate
+    the stats with per-op XLA windows — the mixed window's fused count
+    flows through the widened plan."""
+    import numpy as np
+    from quest_tpu.ops.epoch_pallas import plan_circuit
+    from quest_tpu.utils.profiling import circuit_stats
+    rng = np.random.default_rng(3)
+    g = rng.normal(size=(4, 4)) + 1j * rng.normal(size=(4, 4))
+    u, r = np.linalg.qr(g)
+    u = u * (np.diag(r) / np.abs(np.diag(r)))
+    c = qt.Circuit(20)
+    c.h(0)
+    c.multi_qubit_unitary((3, 12), u)    # straddles lane/fiber: decomposed
+    c.cz(2, 8)
+    c.h(18)                              # high qubit: pack stream
+    st = circuit_stats(c)
+    plan = plan_circuit(c.key(), 20)
+    assert st.engine == "pallas"
+    assert plan.xla_ops == 0
+    assert st.hbm_passes == plan.hbm_passes < len(c.ops)
+
+
 def test_circuit_stats_outside_envelope_and_mesh():
     from quest_tpu.utils.profiling import circuit_stats
-    small = qt.qft_circuit(8)        # below the epoch engine's n >= 17
+    small = qt.qft_circuit(8)        # below the 10-qubit degenerate floor
     st = circuit_stats(small)
     assert st.engine == "xla" and st.hbm_passes == len(small.ops)
     sharded = circuit_stats(qt.qft_circuit(12), num_ranks=8)
