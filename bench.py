@@ -788,6 +788,121 @@ def bench_serve_vqe16_batch64(requests=64, n=16, layers=1):
     return value, cfg
 
 
+def bench_serve_deploy_rps(requests_per_class=16, n=12, replicas=2):
+    """Aggregate requests/sec of a 2-replica deployment (quest_tpu/deploy:
+    affinity router + per-replica services) vs ONE QuESTService on the
+    SAME workload — the scale-out row of docs/DEPLOY.md.
+
+    Three structural classes (VQE ansatz depths 1-3) x
+    ``requests_per_class`` tenants each; the router's rendezvous affinity
+    spreads classes across replica caches, and replica workers overlap
+    (JAX releases the GIL during device execution).  Value = deployment
+    requests/s; the config records both sides, the speedup, the per-replica
+    routed counts and the bit-identity spot check."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from quest_tpu.deploy import ReplicaPool
+    from quest_tpu.serve import CompileCache, QuESTService
+    from quest_tpu.serve.selftest import vqe_ansatz
+
+    platform = jax.devices()[0].platform
+    dtype = jnp.float64 if platform == "cpu" else jnp.float32
+    classes = [[vqe_ansatz(n, layers, seed=100 * layers + s)
+                for s in range(requests_per_class)]
+               for layers in (1, 2, 3)]
+    total = sum(len(cs) for cs in classes)
+
+    def storm(submit, start, drain):
+        futs = []
+        longest = max(len(cs) for cs in classes)
+        t0 = time.perf_counter()
+        for i in range(longest):
+            for cs in classes:
+                if i < len(cs):
+                    futs.append((cs[i], submit(cs[i])))
+        start()
+        if not drain(600):
+            raise RuntimeError("deploy bench drain timed out")
+        dt = time.perf_counter() - t0
+        return futs, dt
+
+    svc = QuESTService(max_batch=16, max_delay_ms=5.0, dtype=dtype,
+                      cache=CompileCache(), start=False)
+    _futs, single_seconds = storm(svc.submit, svc.start,
+                                  lambda t: svc.drain(timeout=t))
+    svc.shutdown()
+
+    pool = ReplicaPool(replicas, max_batch=16, max_delay_ms=5.0,
+                       dtype=dtype, start=False)
+    futs, pool_seconds = storm(pool.submit, pool.start,
+                               lambda t: pool.drain(timeout=t))
+    # bit-identity spot check: one result per class vs a serial oracle
+    oracle = CompileCache()
+    for cs in classes:
+        circ = cs[0]
+        res = next(f for c, f in futs if c is circ).result(timeout=60)
+        st = jnp.zeros((2, 1 << n), dtype).at[0, 0].set(1.0)
+        want = np.asarray(oracle.execute(circ.key(), st, num_qubits=n))
+        assert np.array_equal(res.state, want), "deployment drifted"
+    routed = {str(r.index):
+              int(pool.metrics.counter("routed_total",
+                                       labels={"replica": str(r.index)}))
+              for r in pool.replicas}
+    pool.shutdown()
+    value = total / max(pool_seconds, 1e-9)
+    cfg = {"qubits": n, "replicas": replicas, "requests": total,
+           "classes": len(classes), "platform": platform,
+           "precision": 2 if dtype == jnp.float64 else 1,
+           "pool_seconds": pool_seconds,
+           "single_replica_seconds": single_seconds,
+           "single_replica_rps": total / max(single_seconds, 1e-9),
+           "deploy_rps": value,
+           "speedup_vs_single": single_seconds / max(pool_seconds, 1e-9),
+           "routed_per_replica": routed,
+           "seconds": pool_seconds}
+    _stamp_counters(cfg)
+    return value, cfg
+
+
+def bench_serve_coldstart(n_classes=3):
+    """Warm-loaded vs cold-compiled replica cold start (deploy/persist.py:
+    the persistent executable store) on the serve selftest's class mix.
+    Value = cold/warm speedup; the config carries both cold-start walls and
+    the compile evidence (warm side must report ZERO compiles — asserted,
+    not just recorded)."""
+    import shutil
+    import tempfile
+    import jax
+    import jax.numpy as jnp
+    from quest_tpu.deploy.selftest import coldstart_compare
+    from quest_tpu.serve.selftest import workload_classes
+
+    platform = jax.devices()[0].platform
+    # f64 probe states fail to compile on the TPU backend (same split as
+    # bench_serve_deploy_rps)
+    dtype = jnp.float64 if platform == "cpu" else jnp.float32
+    reps = [(label, cs[0])
+            for label, cs, _ in workload_classes(1)][:n_classes]
+    store_dir = tempfile.mkdtemp(prefix="quest_bench_store_")
+    try:
+        rep = coldstart_compare(store_dir, reps, dtype=dtype)
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
+    assert rep["warm"]["compiles"] == 0, rep["warm"]
+    assert rep["warm"]["coldstart_seconds"] < rep["cold"]["coldstart_seconds"], rep
+    value = rep["speedup"]
+    cfg = {"classes": [label for label, _ in reps], "platform": platform,
+           "warm_coldstart_seconds": rep["warm"]["coldstart_seconds"],
+           "cold_coldstart_seconds": rep["cold"]["coldstart_seconds"],
+           "warm_compiles": rep["warm"]["compiles"],
+           "cold_compiles": rep["cold"]["compiles"],
+           "warm_persist_hits": rep["warm"]["persist_hits"],
+           "seconds": rep["warm"]["coldstart_seconds"]}
+    _stamp_counters(cfg)
+    return value, cfg
+
+
 _SCHED_PAIR_CHUNKS = 4  # pipeline depth of the overlapped bench variant
 
 
@@ -1198,15 +1313,17 @@ def main() -> None:
 
     matrix = []
 
-    def add(name, fn, *args, **kw):
+    def add(name, fn, *args, unit="amps/s", **kw):
         value, cfg, errors = _run_config(fn, *args, **kw)
         if value is None:  # a failing config must not kill the headline
             matrix.append({"name": name, "error": "; then ".join(errors)})
         else:
             cfg["provenance"] = _provenance()
-            matrix.append({"name": name, "value": value, "unit": "amps/s",
-                           "vs_baseline": value / BASELINE_AMPS_PER_SEC,
-                           "config": cfg})
+            row = {"name": name, "value": value, "unit": unit,
+                   "config": cfg}
+            if unit == "amps/s":
+                row["vs_baseline"] = value / BASELINE_AMPS_PER_SEC
+            matrix.append(row)
 
     if with_matrix:
         if platform != "cpu":
@@ -1228,6 +1345,12 @@ def main() -> None:
         add("densmatr_14q_damping_depol_f64", bench_density, 14, 3, 2)
         # serving subsystem (quest_tpu/serve): 64 tenants, one compile
         add("serve_vqe_16q_batch64", bench_serve_vqe16_batch64)
+        # deployment layer (quest_tpu/deploy): 2-replica aggregate
+        # throughput vs one service, and the persistent-store cold start
+        add("serve_deploy_2replica_rps", bench_serve_deploy_rps,
+            unit="req/s")
+        add("serve_coldstart_seconds", bench_serve_coldstart,
+            unit="x_cold_over_warm")
         # engine dispatch (ops/epoch_pallas.py): default auto engine vs
         # forced XLA, with the planner's spec-level decision recorded
         add("random24_f32_auto_engine", bench_random24_auto_engine)

@@ -72,6 +72,10 @@ GROUPS = [
                                "trajectory_expectation_fn"]),
     ("Serving (quest_tpu.serve)", ["QuESTService", "ServeResult",
                                    "CompileCache", "CacheOptions"]),
+    ("Deployment (quest_tpu.deploy)", ["ReplicaPool", "Replica", "Router",
+                                       "RouterConfig", "ExecutableStore",
+                                       "process_replica",
+                                       "broadcast_hot_keys"]),
     ("Observability (quest_tpu.obs)", ["TraceRecorder", "FlightRecorder",
                                        "Ledger", "enable_tracing",
                                        "disable_tracing", "tracing_enabled",

@@ -12,6 +12,21 @@ controlledNot, mixDamping, calcExpecPauliHamil, ...) plus TPU-native
 extensions (precision control, mesh control, circuit compilation).
 """
 
+import os as _os
+
+if _os.environ.get("QUEST_TPU_DISTRIBUTED"):
+    # "HOST:PORT,NUM_PROCESSES,PROCESS_ID": join a jax.distributed
+    # coordinator BEFORE anything below runs a JAX computation — the
+    # runtime refuses to initialize afterwards, and `python -m
+    # quest_tpu.deploy` imports this package before its own main() can
+    # act.  SPMD launchers (the CI deploy-selftest job, SLURM scripts)
+    # set the variable; everyone else never enters this branch.
+    import jax as _jax
+    _addr, _n, _i = _os.environ["QUEST_TPU_DISTRIBUTED"].rsplit(",", 2)
+    _jax.distributed.initialize(coordinator_address=_addr,
+                                num_processes=int(_n),
+                                process_id=int(_i))
+
 from .precision import set_precision, get_precision, real_eps  # noqa: F401  (configures x64)
 from .api import *  # noqa: F401,F403
 from .api import __all__ as _api_all
@@ -26,6 +41,8 @@ from .trajectories import (trajectory_expectation_fn,  # noqa: F401
                            trajectory_state_fn)
 from .serve import (CacheOptions, CompileCache, QuESTService,  # noqa: F401
                     ServeResult)
+from .deploy import (ExecutableStore, Replica, ReplicaPool, Router,  # noqa: F401
+                     RouterConfig, broadcast_hot_keys, process_replica)
 from .obs import (TraceRecorder, FlightRecorder, Ledger,  # noqa: F401
                   enable_tracing, disable_tracing, tracing_enabled,
                   chrome_trace, trace_report, global_ledger,
@@ -46,6 +63,8 @@ __all__ = list(_api_all) + [
     "state_fn", "adjoint_gradient_fn",
     "trajectory_state_fn", "trajectory_expectation_fn",
     "QuESTService", "ServeResult", "CompileCache", "CacheOptions",
+    "ReplicaPool", "Replica", "Router", "RouterConfig", "ExecutableStore",
+    "process_replica", "broadcast_hot_keys",
     "TraceRecorder", "FlightRecorder", "Ledger", "enable_tracing",
     "disable_tracing", "tracing_enabled", "chrome_trace", "trace_report",
     "global_ledger",

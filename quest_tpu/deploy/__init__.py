@@ -1,0 +1,44 @@
+"""quest_tpu.deploy — pod-scale serving: replica pool, SLO-aware router,
+persistent AOT compile cache.
+
+The serve layer (quest_tpu/serve) is one ``QuESTService`` on one process
+group; the reference picks its backend at build time and runs one process
+group forever (PAPER.md layer map).  This package is the jax_graft answer
+at the other end of the scale axis — the deployment that multiplies the
+single-replica service:
+
+- ``pool.py``: N data-parallel **replicas** (thread-backed for CPU/CI and
+  single-host; one-per-process under a ``jax.distributed`` coordinator for
+  real pods), each wrapping one ``QuESTService`` with its own compile
+  cache/SLO monitor/flight recorder, all sharing ONE labeled metrics
+  registry (``{replica="i"}`` Prometheus labels, serve/metrics.py).
+- ``router.py``: the front door — structural-class **affinity** placement
+  (rendezvous hashing keeps each class's one-executable-per-class cache
+  hot on one replica) that yields to the LIVE SLO monitor: a saturated or
+  budget-burning replica sheds to the next-best affinity candidate, and an
+  eviction-induced cache miss re-places the class instead of re-warming
+  the evicting replica by stale habit.
+- ``persist.py``: the **persistent compile cache** — serialized XLA
+  executables on disk keyed by structural class + program tag, with a
+  tamper-evident provenance header (jaxlib/platform/calibration) that
+  REFUSES stale entries; cold replicas warm by loading the store, guided
+  by a ``multihost_utils``-style broadcast of a warm peer's hot class
+  keys.  A warmed replica serves its first request per class with ZERO
+  compiles.
+
+``python -m quest_tpu.deploy --selftest`` is the gate; docs/DEPLOY.md the
+architecture note.
+"""
+
+from .persist import (ExecutableStore, STORE_FORMAT, entry_key,  # noqa: F401
+                      live_provenance, validate_entry_header)
+from .pool import (Replica, ReplicaPool, broadcast_hot_keys,  # noqa: F401
+                   process_replica)
+from .router import Router, RouterConfig  # noqa: F401
+
+__all__ = [
+    "ExecutableStore", "STORE_FORMAT", "entry_key", "live_provenance",
+    "validate_entry_header",
+    "Replica", "ReplicaPool", "process_replica", "broadcast_hot_keys",
+    "Router", "RouterConfig",
+]

@@ -1,0 +1,99 @@
+"""``python -m quest_tpu.deploy`` — the deployment-layer CLI.
+
+``--selftest`` runs the multi-replica storm (selftest.py): >= 2 replicas
+behind the SLO-aware affinity router over one shared persistent executable
+store, gating bit-identity against single-replica serial execution, an
+aggregate cache hit rate >= 0.9, a strictly-faster warm-loaded cold start
+with ZERO compiles, the router shed path against a saturated-replica
+baseline, and the labeled one-scrape Prometheus contract.  ``--json``
+emits ONE machine-readable document for the CI gate.
+
+Multi-process (the CI ``deploy-selftest`` job): launch one invocation per
+process with ``--processes N --process-id I --coordinator HOST:PORT
+--store DIR --sync-dir DIR``; every process initializes the
+``jax.distributed`` coordinator, runs the storm against the SHARED store,
+and writes its trace shard + document into the sync directory; process 0
+merges the shards into one validated multi-track Chrome trace and
+aggregates every process's verdict.  Exit status 0 iff every check (and,
+on process 0, every peer) passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m quest_tpu.deploy",
+        description="Pod-scale serving: replica pool, SLO-aware router, "
+                    "persistent compile cache (docs/DEPLOY.md).")
+    parser.add_argument("--selftest", action="store_true",
+                        help="run the multi-replica deployment storm")
+    parser.add_argument("--replicas", type=int, default=2,
+                        help="replica count for the selftest pool "
+                             "(default 2)")
+    parser.add_argument("--scale", type=int, default=1,
+                        help="workload multiplier (default 1: 64 requests)")
+    parser.add_argument("--store", default=None,
+                        help="persistent executable store directory "
+                             "(default: a fresh temp dir; share one across "
+                             "processes in multi-process mode)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit ONE machine-readable JSON document")
+    parser.add_argument("--trace", action="store_true",
+                        help="record through the span recorder and "
+                             "export/validate the Chrome trace (forced on "
+                             "in multi-process mode)")
+    parser.add_argument("--processes", type=int, default=1,
+                        help="total process count under one "
+                             "jax.distributed coordinator")
+    parser.add_argument("--process-id", type=int, default=0,
+                        help="this process's index (multi-process mode)")
+    parser.add_argument("--coordinator", default=None,
+                        help="HOST:PORT of the jax.distributed "
+                             "coordinator (multi-process mode)")
+    parser.add_argument("--sync-dir", default=None,
+                        help="shared directory for shard/document "
+                             "rendezvous (multi-process mode)")
+    args = parser.parse_args(argv)
+    if not args.selftest:
+        parser.print_usage()
+        return 2
+    if args.processes > 1:
+        if not args.sync_dir or not args.store:
+            parser.error("multi-process mode needs --sync-dir and --store")
+        import jax
+        if jax.process_count() != args.processes:
+            # joining a coordinator must happen BEFORE any JAX computation,
+            # and importing quest_tpu already runs some — so the join
+            # happens at package-import time, driven by the env var the
+            # launcher sets (quest_tpu/__init__.py).  A late --coordinator
+            # attempt is made for computation-free stacks, with the env-var
+            # recipe in the failure message.
+            try:
+                if not args.coordinator:
+                    raise RuntimeError("no coordinator joined")
+                jax.distributed.initialize(
+                    coordinator_address=args.coordinator,
+                    num_processes=args.processes,
+                    process_id=args.process_id)
+            except RuntimeError as exc:
+                parser.error(
+                    f"process {args.process_id} is not part of a "
+                    f"{args.processes}-process jax.distributed group "
+                    f"({exc}); launch with QUEST_TPU_DISTRIBUTED="
+                    f"HOST:PORT,{args.processes},{args.process_id} in the "
+                    "environment so the coordinator joins at import time")
+    from .selftest import run_selftest
+    return run_selftest(as_json=args.as_json, scale=max(1, args.scale),
+                        replicas=max(1, args.replicas), store_dir=args.store,
+                        trace=True if args.trace else None,
+                        sync_dir=args.sync_dir,
+                        process_index=args.process_id,
+                        process_count=args.processes)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

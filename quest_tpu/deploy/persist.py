@@ -1,0 +1,350 @@
+"""Persistent AOT compile cache: serialized XLA executables on disk.
+
+The serve-layer compile cache (serve/cache.py) holds exactly ONE
+ahead-of-time-compiled executable per structural class — in process memory.
+Every new process (a scaled-out replica, a restarted pod) pays the full XLA
+compile for every class from scratch, which is exactly the cost that makes
+scale-out expensive at pod scale.  This module makes the cache durable:
+
+- Each compiled program is serialized through the XLA executable
+  serialization path (``jax.experimental.serialize_executable``: the PJRT
+  executable blob plus its arg/result trees) — NOT through ``jax.export``,
+  whose deserialized StableHLO still pays the backend compile on load; the
+  whole point here is that a warm replica compiles NOTHING.
+- Entries are keyed by the cache's own identity — the structural class key
+  (``Circuit.key(structural=True)`` + :class:`~quest_tpu.serve.cache.CacheOptions`)
+  and the program tag (signature / batch shape / donation) — hashed into a
+  filename, with the class's skeleton/operand-offset metadata carried
+  alongside so a cold cache can re-materialize the full
+  :class:`~quest_tpu.serve.cache.CacheEntry` without re-running the
+  scheduler's search.
+- Every file carries a PROVENANCE HEADER (jax/jaxlib versions, backend
+  platform, device kind and count, the active calibration ``profile_id``
+  from obs/calibrate.py) plus a SHA-256 of the payload.  Loading validates
+  the header FIRST, against the live process (:func:`validate_entry_header`,
+  mirroring ``calibrate.validate_profile``'s contract shape): any
+  provenance mismatch or payload-digest mismatch REFUSES the entry — the
+  consumer recompiles and counts a ``persist_stale`` miss.  An executable
+  compiled under a different jaxlib is undefined behaviour at run time;
+  refusing at load time is the bugfix-by-construction.  The payload is
+  unpickled only AFTER the digest check passes, so a tampered file is
+  rejected before any byte of it reaches the deserializer.
+
+File layout (one file per program, atomic tmp+rename writes so concurrent
+replicas can share one store directory):
+
+    8-byte magic  | 4-byte big-endian header length | header JSON | payload
+
+The payload is ``pickle((skey, tag, entry_meta, exe_bytes, in_tree,
+out_tree))``.  Only ``jax.stages.Compiled`` programs persist; opaque
+callables (overlap / Pallas-epoch classes, whose payloads are compiled in
+host-side) are skipped and recorded as ``save_skipped`` — they recompile on
+each process like before, documented in docs/DEPLOY.md.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import struct
+import tempfile
+import threading
+import time
+
+__all__ = ["STORE_FORMAT", "ExecutableStore", "entry_key",
+           "live_provenance", "validate_entry_header"]
+
+#: the store schema tag (bumped on incompatible changes)
+STORE_FORMAT = "quest-tpu-executable-v1"
+
+_MAGIC = b"QXCSTOR1"
+_SUFFIX = ".qxc"
+
+#: provenance fields that must match the live process EXACTLY for an entry
+#: to load — a serialized executable is only defined for the stack that
+#: produced it, and a calibration change re-decides engines per class
+STRICT_PROVENANCE = ("jax", "jaxlib", "platform", "device_kind",
+                     "device_count", "calibration")
+
+
+def live_provenance() -> dict:
+    """The provenance stamp of THIS process: the fields a persisted
+    executable must match to be loadable here."""
+    import jax
+    import jaxlib
+    try:
+        devs = jax.devices()
+        platform = devs[0].platform
+        device_kind = getattr(devs[0], "device_kind", "")
+        device_count = len(devs)
+    except Exception:
+        platform, device_kind, device_count = "unknown", "", 0
+    from ..obs.calibrate import active_profile
+    prof = active_profile()
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "platform": platform,
+        "device_kind": device_kind,
+        "device_count": device_count,
+        "calibration": prof.profile_id if prof is not None else "",
+    }
+
+
+def entry_key(skey, tag) -> str:
+    """Stable filename hash of one (structural class, program tag) pair.
+    ``repr`` of the key material is deterministic: both are nested tuples
+    of primitives, frozen dataclasses (GateOp, CacheOptions) and dtype/
+    sharding strings."""
+    return hashlib.sha256(repr((skey, tag)).encode()).hexdigest()[:24]
+
+
+def validate_entry_header(header: dict, live: dict | None = None) -> list:
+    """Schema + provenance check; returns the problem list (empty = valid),
+    the same contract shape as ``calibrate.validate_profile`` and
+    ``export.validate_chrome_trace``.  ``live=None`` checks schema only
+    (offline tooling); pass :func:`live_provenance` to gate loading."""
+    problems: list = []
+    if not isinstance(header, dict):
+        return ["header is not a JSON object"]
+    if header.get("format") != STORE_FORMAT:
+        problems.append(f"format is {header.get('format')!r}, "
+                        f"not {STORE_FORMAT!r}")
+    for field in ("key", "payload_sha256", "payload_bytes", "provenance",
+                  "created_epoch_s"):
+        if field not in header:
+            problems.append(f"missing field {field!r}")
+    prov = header.get("provenance")
+    if prov is not None and not isinstance(prov, dict):
+        problems.append("provenance is not an object")
+        prov = None
+    if live is not None and isinstance(prov, dict):
+        for field in STRICT_PROVENANCE:
+            have, want = prov.get(field), live.get(field)
+            if have != want:
+                problems.append(
+                    f"provenance mismatch on {field!r}: entry was built "
+                    f"under {have!r}, this process runs {want!r}")
+    return problems
+
+
+def _is_serializable_program(call) -> bool:
+    import jax
+    return isinstance(call, jax.stages.Compiled)
+
+
+class ExecutableStore:
+    """One directory of persisted executables shared by any number of
+    replica processes.  Thread-safe; writes are atomic (tmp + rename), so
+    concurrent replicas racing to persist the same class converge on one
+    valid file.
+
+    ``stats``: saves / save_skipped (non-serializable programs) /
+    hits / stale (provenance or digest refusals) / absent / errors
+    (deserialization failures — counted, never raised: persistence must
+    never be the thing that kills a serving process)."""
+
+    def __init__(self, root: str, *, readonly: bool = False):
+        self.root = str(root)
+        self.readonly = bool(readonly)
+        self._lock = threading.Lock()
+        self.stats = {"saves": 0, "save_skipped": 0, "hits": 0,
+                      "stale": 0, "absent": 0, "errors": 0}
+        if not readonly:
+            os.makedirs(self.root, exist_ok=True)
+
+    # -- paths --------------------------------------------------------------
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, key + _SUFFIX)
+
+    def keys(self) -> list:
+        """Hashed entry keys present on disk (the broadcastable hot list)."""
+        try:
+            return sorted(f[:-len(_SUFFIX)] for f in os.listdir(self.root)
+                          if f.endswith(_SUFFIX))
+        except OSError:
+            return []
+
+    # -- writing ------------------------------------------------------------
+    def put(self, skey, tag, call, nbytes: int, entry_meta: dict) -> bool:
+        """Persist one compiled program (write-through from the cache's
+        compile path, or an explicit export).  Returns True iff a file was
+        written.  Non-``jax.stages.Compiled`` programs are skipped —
+        opaque overlap/epoch callables have no serializable executable."""
+        if self.readonly:
+            return False
+        if not _is_serializable_program(call):
+            with self._lock:
+                self.stats["save_skipped"] += 1
+            return False
+        try:
+            from jax.experimental import serialize_executable as _se
+            exe_bytes, in_tree, out_tree = _se.serialize(call)
+            payload = pickle.dumps(
+                (skey, tag, entry_meta, exe_bytes, in_tree, out_tree))
+        except Exception:
+            with self._lock:
+                self.stats["save_skipped"] += 1
+            return False
+        key = entry_key(skey, tag)
+        header = {
+            "format": STORE_FORMAT,
+            "created_epoch_s": time.time(),
+            "key": key,
+            "tag_kind": str(tag[0]) if isinstance(tag, tuple) and tag else "",
+            "num_qubits": entry_meta.get("num_qubits"),
+            "nbytes": int(nbytes),
+            "payload_sha256": hashlib.sha256(payload).hexdigest(),
+            "payload_bytes": len(payload),
+            "provenance": live_provenance(),
+        }
+        hjson = json.dumps(header, sort_keys=True).encode()
+        blob = _MAGIC + struct.pack(">I", len(hjson)) + hjson + payload
+        tmp = None
+        try:
+            fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".tmp")
+            with os.fdopen(fd, "wb") as fh:
+                fh.write(blob)
+            os.replace(tmp, self._path(key))
+        except OSError:
+            if tmp is not None:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+            with self._lock:
+                self.stats["errors"] += 1
+            return False
+        with self._lock:
+            self.stats["saves"] += 1
+        return True
+
+    # -- reading ------------------------------------------------------------
+    def read_header(self, key: str) -> dict | None:
+        """The provenance header of one entry (no payload touched)."""
+        try:
+            with open(self._path(key), "rb") as fh:
+                if fh.read(len(_MAGIC)) != _MAGIC:
+                    return None
+                (hlen,) = struct.unpack(">I", fh.read(4))
+                return json.loads(fh.read(hlen).decode())
+        except (OSError, ValueError, struct.error):
+            return None
+
+    def _read(self, key: str):
+        """(header, payload) of one entry, digest-checked; ``"absent"``
+        when the file does not exist, None on any malformation (the caller
+        counts the refusal)."""
+        try:
+            with open(self._path(key), "rb") as fh:
+                if fh.read(len(_MAGIC)) != _MAGIC:
+                    return None
+                (hlen,) = struct.unpack(">I", fh.read(4))
+                header = json.loads(fh.read(hlen).decode())
+                payload = fh.read()
+        except FileNotFoundError:
+            return "absent"
+        except (OSError, ValueError, struct.error):
+            return None
+        if not isinstance(header, dict):
+            return None
+        if header.get("payload_bytes") != len(payload):
+            return None
+        if hashlib.sha256(payload).hexdigest() != header.get("payload_sha256"):
+            return None
+        return header, payload
+
+    def fetch(self, skey, tag):
+        """One program by live cache identity.  Returns
+        ``(status, call, nbytes)`` with status ``"hit"`` (call is the
+        loaded executable), ``"stale"`` (present but refused: provenance or
+        digest mismatch — the caller must recompile and count the miss) or
+        ``"absent"``.  A deserialization failure reports ``"absent"`` to
+        the caller (recompile, no ``persist_stale``) — it is counted
+        store-side as ``errors``, not a provenance refusal."""
+        status, loaded = self._load(entry_key(skey, tag))
+        if status != "hit":
+            return ("stale" if status == "stale" else "absent"), None, 0
+        _key2, _tag2, meta, call, nbytes = loaded
+        return "hit", call, nbytes
+
+    def _load(self, key: str):
+        """Validate + deserialize one entry.  Returns ``(status, result)``
+        — status ``"hit"`` with ``(skey, tag, entry_meta, call, nbytes)``,
+        else ``"absent"`` / ``"stale"`` / ``"error"`` with None, each
+        counted in its OWN stat: ``stale`` means provenance/digest refusal
+        and nothing else.  The payload is unpickled only after the
+        header's digest and provenance checks both pass."""
+        read = self._read(key)
+        if read == "absent":
+            # a broadcast hot key the local store never held (or a file
+            # deleted under us) is NOT provenance drift — keep the
+            # ``stale`` gauge meaning what it says
+            with self._lock:
+                self.stats["absent"] += 1
+            return "absent", None
+        if read is None:
+            with self._lock:
+                self.stats["stale"] += 1
+            return "stale", None
+        header, payload = read
+        if validate_entry_header(header, live_provenance()):
+            with self._lock:
+                self.stats["stale"] += 1
+            return "stale", None
+        try:
+            skey, tag, meta, exe_bytes, in_tree, out_tree = \
+                pickle.loads(payload)
+            from jax.experimental import serialize_executable as _se
+            call = _se.deserialize_and_load(exe_bytes, in_tree, out_tree)
+        except Exception:
+            with self._lock:
+                self.stats["errors"] += 1
+            return "error", None
+        with self._lock:
+            self.stats["hits"] += 1
+        return "hit", (skey, tag, meta, call,
+                       int(header.get("nbytes", 1 << 20)))
+
+    # -- warm-up ------------------------------------------------------------
+    def warm(self, cache, keys: list | None = None) -> dict:
+        """Load persisted executables into ``cache`` (a
+        ``serve.cache.CompileCache``): re-materialize each entry's class
+        metadata (skeleton, operand offsets — so warmed mesh classes skip
+        the schedule search too) and install the executable WITHOUT
+        touching the compile counters — a warmed replica's first request
+        per class is a cache hit that compiled nothing.
+
+        ``keys=None`` loads everything on disk; pass the hot-key list a
+        warm peer broadcast (deploy/pool.py) to warm selectively.  Returns
+        ``{"loaded", "refused", "requested"}``."""
+        want = self.keys() if keys is None else [k for k in keys]
+        loaded = refused = 0
+        for key in want:
+            status, got = self._load(key)
+            if status != "hit":
+                refused += 1
+                continue
+            skey, tag, meta, call, nbytes = got
+            try:
+                entry = cache.install_entry(
+                    skey, meta["num_qubits"], meta["options"],
+                    meta["skeleton"], meta["offsets"], meta["num_params"])
+                cache.install_program(entry, tag, call, nbytes)
+            except Exception:
+                with self._lock:
+                    self.stats["errors"] += 1
+                refused += 1
+                continue
+            loaded += 1
+        return {"loaded": loaded, "refused": refused,
+                "requested": len(want)}
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            d = dict(self.stats)
+        d["entries"] = len(self.keys())
+        d["root"] = self.root
+        return d

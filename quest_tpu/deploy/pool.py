@@ -1,0 +1,254 @@
+"""Replica pool: N data-parallel QuESTServices behind one router.
+
+The deployment unit the north star asks for: each **replica** wraps one
+:class:`~quest_tpu.serve.service.QuESTService` with its OWN compile cache,
+SLO monitor and flight recorder — replicas are fully data-parallel (a
+request executes on exactly one), so nothing here needs a cross-process
+collective and the pool scales to however many process groups the launcher
+brings up.  Two deployment shapes, one code path:
+
+- **Thread-backed** (:class:`ReplicaPool`): N replicas in one process,
+  each service's worker thread its own lane.  This is the CPU test/CI
+  path, the bench substrate, and an honest single-host deployment (JAX
+  releases the GIL during device execution, so replica workers overlap).
+- **Process-backed** (:func:`process_replica`): one replica per process
+  under a ``jax.distributed`` coordinator — ``jax.process_index()`` names
+  the replica, every process runs the same code, and the observability
+  exports (trace shards, labeled scrapes, selftest documents) merge
+  offline exactly like obs/aggregate.py trace shards do.
+
+All replicas share ONE metrics registry through per-replica labeled views
+(serve/metrics.py ``Metrics.labeled``), so :meth:`ReplicaPool.prometheus`
+is a single scrape where every per-replica series carries a
+``{replica="i"}`` label — one TYPE line per family, N samples under it.
+
+Warm-up: with a persistent executable store attached
+(deploy/persist.py), every replica's cache loads instead of compiling.
+:meth:`ReplicaPool.warm` additionally front-loads the store BEFORE traffic
+arrives, optionally restricted to the hot-key list a warm peer published —
+:func:`broadcast_hot_keys` carries that list over the same
+``multihost_utils`` broadcast primitive as ``broadcast_host_epoch``
+(degrading to the local list where the backend cannot collective, e.g.
+the pinned CPU jaxlib)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from .. import obs as _obs
+from ..serve.cache import CompileCache
+from ..serve.metrics import Metrics
+from ..serve.service import QuESTService
+from .persist import ExecutableStore, entry_key
+from .router import Router, RouterConfig
+
+__all__ = ["Replica", "ReplicaPool", "process_replica",
+           "broadcast_hot_keys"]
+
+
+class Replica:
+    """One serving lane: index + service + its own compile cache (the
+    affinity contract NEEDS per-replica caches — a shared cache would make
+    placement irrelevant and the byte budget a single point of pressure).
+
+    ``seed`` should differ per replica (the pool passes ``seed + index``)
+    so two requests that happen to get the same request id on different
+    replicas still draw distinct sample streams."""
+
+    def __init__(self, index: int, *, store: ExecutableStore | None = None,
+                 cache: CompileCache | None = None,
+                 cache_max_bytes: int | None = None, metrics=None,
+                 seed: int = 0, start: bool = True, **service_kwargs):
+        self.index = int(index)
+        self.cache = cache if cache is not None \
+            else CompileCache(max_bytes=cache_max_bytes)
+        if store is not None:
+            self.cache.attach_store(store)
+        self.store = store
+        self.metrics = metrics if metrics is not None else Metrics()
+        self.created_monotonic = time.monotonic()
+        self.service = QuESTService(cache=self.cache, metrics=self.metrics,
+                                    seed=seed, start=start,
+                                    **service_kwargs)
+
+    def health(self) -> dict:
+        """The router's per-decision read: the service's lock-free SLO
+        health snapshot (obs/slo.py)."""
+        return self.service.slo.health()
+
+    def hot_keys(self) -> list:
+        """Store keys of every program THIS replica holds compiled — what
+        a warm peer publishes for broadcast warm-up."""
+        return sorted(entry_key(skey, tag)
+                      for skey, tag in self.cache.program_keys())
+
+    def warm(self, keys: list | None = None) -> dict:
+        """Load persisted executables into this replica's cache (all of
+        the store, or just a peer's hot-key list).  Returns the store's
+        ``{"loaded", "refused", "requested"}`` summary."""
+        if self.store is None:
+            return {"loaded": 0, "refused": 0, "requested": 0}
+        return self.store.warm(self.cache, keys)
+
+    def snapshot(self) -> dict:
+        return {
+            "replica": self.index,
+            "cache": self.cache.snapshot(),
+            "slo": self.service.slo.snapshot(),
+            "health": self.health(),
+            "queue_saturation": self.service.queue_saturation(),
+        }
+
+    def shutdown(self, drain: bool = True,
+                 timeout: float | None = None) -> None:
+        self.service.shutdown(drain=drain, timeout=timeout)
+
+
+def broadcast_hot_keys(local_keys: list, max_bytes: int = 1 << 16) -> list:
+    """Publish process 0's hot-key list to every process (the
+    ``multihost_utils`` broadcast of ROADMAP item 1, carrying executable
+    identities instead of timestamps).  Keys beyond the buffer are
+    truncated deterministically (sorted order) — warm-up hints are
+    best-effort.  Where the backend cannot collective this degrades to the
+    LOCAL list (parallel/mesh.py ``broadcast_payload``)."""
+    from ..parallel.mesh import broadcast_payload
+    keys = sorted(str(k) for k in local_keys)
+    data = json.dumps(keys).encode()
+    while keys and len(data) > max_bytes - 4:
+        # always strictly shrink: at len 1 this empties the list, so an
+        # oversized single key degrades to no hints instead of spinning
+        keys = keys[:len(keys) - max(1, len(keys) // 4)]
+        data = json.dumps(keys).encode()
+    out = broadcast_payload(data, max_bytes)
+    try:
+        got = json.loads(out.decode())
+        return [str(k) for k in got] if isinstance(got, list) else keys
+    except ValueError:
+        return keys
+
+
+def process_replica(*, store_dir: str | None = None, seed: int = 0,
+                    metrics=None, **service_kwargs) -> Replica:
+    """THIS process's replica in a process-backed deployment: the caller
+    has already run ``jax.distributed.initialize`` (the launcher's job, as
+    with any SPMD program); ``jax.process_index()`` names the replica and
+    labels its metrics.  All processes may share one ``store_dir`` — store
+    writes are atomic, and racing replicas converge on one valid file."""
+    from ..parallel.mesh import process_info
+    index = process_info()["process_index"]
+    store = ExecutableStore(store_dir) if store_dir else None
+    m = metrics if metrics is not None else Metrics()
+    return Replica(index, store=store, seed=seed + index,
+                   metrics=m.labeled(replica=str(index)), **service_kwargs)
+
+
+class ReplicaPool:
+    """N thread-backed replicas + the SLO-aware affinity router, presented
+    as one service: ``submit`` routes, ``prometheus()`` is the one labeled
+    scrape, ``drain``/``shutdown`` fan out."""
+
+    def __init__(self, num_replicas: int = 2, *,
+                 store_dir: str | None = None,
+                 cache_max_bytes: int | None = None,
+                 router_config: RouterConfig | None = None,
+                 metrics: Metrics | None = None, seed: int = 0,
+                 start: bool = True, **service_kwargs):
+        if num_replicas < 1:
+            raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
+        self.metrics = metrics if metrics is not None else Metrics()
+        self.store = ExecutableStore(store_dir) if store_dir else None
+        self.replicas = [
+            Replica(i, store=self.store, seed=seed + i,
+                    cache_max_bytes=cache_max_bytes,
+                    metrics=self.metrics.labeled(replica=str(i)),
+                    start=start, **service_kwargs)
+            for i in range(int(num_replicas))
+        ]
+        self.router = Router(self.replicas, config=router_config,
+                             metrics=self.metrics.labeled())
+
+    # -- serving ------------------------------------------------------------
+    def submit(self, circuit, params=None, shots: int = 0,
+               deadline_ms: float | None = None, initial_state=None):
+        return self.router.submit(circuit, params=params, shots=shots,
+                                  deadline_ms=deadline_ms,
+                                  initial_state=initial_state)
+
+    def start(self) -> "ReplicaPool":
+        for r in self.replicas:
+            r.service.start()
+        return self
+
+    def drain(self, timeout: float | None = None) -> bool:
+        end = None if timeout is None else time.monotonic() + timeout
+        ok = True
+        for r in self.replicas:
+            left = None if end is None else max(0.0, end - time.monotonic())
+            ok &= r.service.drain(timeout=left)
+        return ok
+
+    def shutdown(self, drain: bool = True,
+                 timeout: float | None = None) -> None:
+        # parallel shutdown: one slow replica must not serialize the rest
+        threads = [threading.Thread(target=r.shutdown,
+                                    kwargs={"drain": drain,
+                                            "timeout": timeout})
+                   for r in self.replicas]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    def __enter__(self) -> "ReplicaPool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown(drain=exc == (None, None, None))
+
+    # -- warm-up ------------------------------------------------------------
+    def warm(self, keys: list | None = None) -> list:
+        """Warm every replica from the attached store (optionally only the
+        given hot keys); returns the per-replica summaries."""
+        return [r.warm(keys) for r in self.replicas]
+
+    def hot_keys(self) -> list:
+        keys: set = set()
+        for r in self.replicas:
+            keys.update(r.hot_keys())
+        return sorted(keys)
+
+    # -- observability ------------------------------------------------------
+    def metrics_dict(self) -> dict:
+        return {
+            "replicas": [r.snapshot() for r in self.replicas],
+            "router": self.router.snapshot(),
+            "store": self.store.snapshot() if self.store else None,
+            "registry": self.metrics.as_dict(),
+        }
+
+    def prometheus(self) -> str:
+        """ONE scrape for the whole deployment: the shared registry (every
+        per-replica counter/gauge a labeled sample under one family) plus
+        per-replica cache/SLO splices labeled ``{replica="i"}``, the
+        process-wide obs counters, and the store/router gauges — all
+        splices point-in-time (the labeled ``extra_gauges`` groups), never
+        written into the registry where they would go stale or outlive a
+        retired replica."""
+        groups: list = []
+        for r in self.replicas:
+            splice = {f"cache_{k}": v for k, v in r.cache.snapshot().items()
+                      if isinstance(v, (int, float))}
+            splice.update({f"slo_{k}": v
+                           for k, v in r.service.slo.gauges().items()})
+            splice["queue_saturation_live"] = r.service.queue_saturation()
+            groups.append((splice, {"replica": str(r.index)}))
+        extra = {f"obs_{k}": v for k, v in _obs.obs_snapshot().items()}
+        extra["replicas"] = len(self.replicas)
+        if self.store is not None:
+            extra.update({f"store_{k}": v
+                          for k, v in self.store.snapshot().items()
+                          if isinstance(v, (int, float))})
+        groups.append((extra, None))
+        return self.metrics.to_prometheus(extra_gauges=groups)

@@ -1,0 +1,255 @@
+"""SLO-aware class-affinity router: the deployment's front door.
+
+Placement problem: the serve cache compiles ONE executable per structural
+class, so spraying a class's traffic across N replicas multiplies its
+compile cost (and its cache bytes) by N.  The router therefore places by
+**class affinity**: `Circuit.key(structural=True)` hashes to a rendezvous
+(highest-random-weight) order over the replicas, and a class's traffic
+sticks to its first-choice replica — the per-replica compile cache stays
+hot, and adding or removing a replica re-places only ~1/N of the classes
+(the rendezvous property; a modulo hash would reshuffle everything).
+
+Stickiness yields to LIVE health, read per decision from the two cheap
+surfaces built for exactly this:
+
+- ``service.queue_saturation()`` — the live queue fraction.  A replica at
+  or past ``shed_saturation`` sheds EVERY request (admission there risks
+  ``E_QUEUE_FULL`` bounces).
+- ``slo.health()`` — the lock-free windowed snapshot (obs/slo.py).  A
+  replica whose short-window burn rate is at or past ``shed_burn`` sheds
+  requests that CARRY a deadline (they would land in a queue already
+  eating its error budget); deadline-free requests still stick (they
+  consume no budget, and keeping them local preserves cache heat).
+
+A shed request moves to the next-best candidate in ITS OWN affinity order
+— so a class's overflow lands on a deterministic second replica and warms
+exactly one extra cache, not a random one per request.
+
+Affinity can also go stale from the OTHER side: a replica that evicts a
+class under cache byte pressure keeps its affinity but no longer holds the
+executable.  The router learns this from the cache-outcome feedback on
+every completed request (``ServeResult.cache_outcome``): a **miss reported
+for a class the router had previously confirmed hot on that replica** means
+the class was evicted there — the sticky placement is dropped, the
+(class, replica) pair enters a cooldown, and the next request re-places
+onto the next-best candidate instead of re-warming the evicting replica by
+stale habit (tests/test_deploy.py pins the interplay).
+
+Every decision is a traced span (``deploy.route``: class key, chosen
+replica, sticky/shed/cooldown disposition) and a labeled counter in the
+deployment's one registry (``quest_serve_routed_total{replica="i"}``,
+``..._shed_total``, ``..._replaced_total``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import threading
+import time
+
+from .. import obs as _obs
+from ..validation import ErrorCode, QuESTError
+
+__all__ = ["RouterConfig", "Router"]
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    """Shed policy knobs.  ``shed_saturation`` is the live queue fraction
+    at which a replica sheds all traffic; ``shed_burn`` the short-window
+    burn rate at which it sheds deadline-carrying traffic;
+    ``cooldown_s`` how long an evicted (class, replica) pair is avoided
+    before affinity may return."""
+    shed_saturation: float = 0.8
+    shed_burn: float = 1.0
+    cooldown_s: float = 30.0
+
+
+class Router:
+    """Places requests over a list of replicas (``deploy.pool.Replica``
+    duck-type: ``.index``, ``.service``, ``.health()``)."""
+
+    def __init__(self, replicas, config: RouterConfig | None = None,
+                 metrics=None):
+        self.replicas = list(replicas)
+        self.config = config if config is not None else RouterConfig()
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._placement: dict = {}          # class_key -> replica index
+        self._confirmed: set = set()        # (class_key, index): seen a hit
+        self._cooldown: dict = {}           # (class_key, index) -> t_until
+
+    # -- affinity -----------------------------------------------------------
+    def class_key(self, circuit) -> str:
+        return _obs.key_hash((circuit.num_qubits,
+                              circuit.key(structural=True)))
+
+    def candidates(self, class_key: str) -> list:
+        """Replica indices in rendezvous (HRW) order for this class:
+        deterministic, uniform over classes, and stable under replica
+        count changes except for the classes whose winner left."""
+        return sorted(
+            (r.index for r in self.replicas),
+            key=lambda i: hashlib.sha256(
+                f"{class_key}|{i}".encode()).hexdigest(),
+            reverse=True)
+
+    # -- the decision -------------------------------------------------------
+    def _shed_reason(self, replica, has_deadline: bool) -> str | None:
+        cfg = self.config
+        if replica.service.queue_saturation() >= cfg.shed_saturation:
+            return "saturation"
+        if has_deadline and replica.health()["burn_rate"] >= cfg.shed_burn:
+            return "burn"
+        return None
+
+    def route(self, circuit, deadline_ms: float | None = None,
+              class_key: str | None = None):
+        """Pick the replica for one request; returns ``(replica,
+        decision)`` where ``decision`` is the JSON-ready record the span
+        and the selftest document carry.  ``class_key`` lets a caller that
+        already derived the key (submit()) skip the second structural
+        walk."""
+        t0 = time.perf_counter()
+        ck = class_key if class_key is not None else self.class_key(circuit)
+        order = self.candidates(ck)
+        hrw_first = order[0]       # before the sticky/cooldown reorders
+        now = time.monotonic()
+        with self._lock:
+            sticky = self._placement.get(ck)
+            # prune on the way through: without this the dict grows one
+            # entry per eviction for the process lifetime
+            for pair in [p for p, t in self._cooldown.items() if t <= now]:
+                del self._cooldown[pair]
+            cooled = {i for i in order if (ck, i) in self._cooldown}
+        if sticky is not None and sticky in order:
+            order = [sticky] + [i for i in order if i != sticky]
+        if len(cooled) < len(order):
+            # skip cooled replicas only while an alternative exists: a
+            # fully-cooled class still gets served somewhere
+            order = ([i for i in order if i not in cooled]
+                     + [i for i in order if i in cooled])
+        by_index = {r.index: r for r in self.replicas}
+        chosen = None
+        shed_from: list = []
+        for i in order:
+            reason = self._shed_reason(by_index[i], deadline_ms is not None)
+            if reason is None:
+                chosen = i
+                break
+            shed_from.append({"replica": i, "reason": reason})
+        if chosen is None:
+            # every replica is shedding: least-loaded wins — degraded, but
+            # a router must always route
+            chosen = min(order,
+                         key=lambda i: by_index[i].service.queue_saturation())
+        if not shed_from:
+            # a SHED decision must not rewrite the sticky placement: a
+            # transient saturation spike would otherwise migrate the class
+            # permanently onto the survivor (its affinity replica's warm
+            # executable orphaned) — overflow serves elsewhere, affinity
+            # returns the moment the replica stops shedding
+            with self._lock:
+                self._placement[ck] = chosen
+        decision = {"class_key": ck, "replica": chosen,
+                    "affinity": hrw_first if sticky is None else sticky,
+                    "sticky": sticky is not None,
+                    "shed_from": shed_from,
+                    "cooldown_skipped": sorted(cooled)}
+        if self.metrics is not None and shed_from:
+            self.metrics.inc("shed_total",
+                             labels={"replica": str(shed_from[0]["replica"]),
+                                     "reason": shed_from[0]["reason"]})
+        _obs.emit_span("deploy.route", t0=t0,
+                       dur=time.perf_counter() - t0, class_key=ck,
+                       replica=chosen, sticky=decision["sticky"],
+                       shed=len(shed_from))
+        return by_index[chosen], decision
+
+    # -- submission ---------------------------------------------------------
+    def submit(self, circuit, params=None, shots: int = 0,
+               deadline_ms: float | None = None, initial_state=None):
+        """Route + submit; the returned Future resolves exactly like
+        ``QuESTService.submit``'s.  A replica whose queue bounces the
+        request (``E_QUEUE_FULL`` raced past the saturation read) is
+        retried at the remaining candidates before the bounce propagates."""
+        ck = self.class_key(circuit)
+        replica, _decision = self.route(circuit, deadline_ms, class_key=ck)
+        by_index = {r.index: r for r in self.replicas}
+        tried = set()
+        while True:
+            try:
+                fut = replica.service.submit(
+                    circuit, params=params, shots=shots,
+                    deadline_ms=deadline_ms, initial_state=initial_state)
+                break
+            except QuESTError as exc:
+                if exc.code != ErrorCode.QUEUE_FULL:
+                    raise
+                tried.add(replica.index)
+                fallback = [i for i in self.candidates(ck)
+                            if i not in tried]
+                if not fallback:
+                    raise
+                # a bounce retry must still honour the shed policy: raw
+                # affinity order would send the request straight back into
+                # the saturated replica route() just steered around
+                healthy = [i for i in fallback
+                           if self._shed_reason(by_index[i],
+                                                deadline_ms is not None)
+                           is None]
+                if self.metrics is not None:
+                    self.metrics.inc("bounce_retries_total",
+                                     labels={"replica": str(replica.index)})
+                replica = by_index[(healthy or fallback)[0]]
+        idx = replica.index
+        if self.metrics is not None:
+            # counted at ADMISSION, not at route(): a bounced request is
+            # attributed to the replica that actually accepted it
+            self.metrics.inc("routed_total", labels={"replica": str(idx)})
+        fut.add_done_callback(
+            lambda f, ck=ck, idx=idx: self._on_done(ck, idx, f))
+        return fut
+
+    def _on_done(self, class_key: str, index: int, fut) -> None:
+        if fut.cancelled() or fut.exception() is not None:
+            return
+        outcome = getattr(fut.result(), "cache_outcome", None)
+        self.report(class_key, index, outcome)
+
+    def report(self, class_key: str, index: int,
+               outcome: str | None) -> None:
+        """Cache-outcome feedback (also callable directly by out-of-band
+        monitors).  hit => the class is confirmed resident on ``index``;
+        miss AFTER a confirmed hit => the replica evicted it — drop the
+        sticky placement and cool the pair so the next request re-places."""
+        if outcome == "hit":
+            with self._lock:
+                self._confirmed.add((class_key, index))
+            return
+        if outcome != "miss":
+            return
+        with self._lock:
+            if (class_key, index) not in self._confirmed:
+                return                 # first-contact miss: normal cold start
+            self._confirmed.discard((class_key, index))
+            if self._placement.get(class_key) == index:
+                del self._placement[class_key]
+            self._cooldown[(class_key, index)] = (
+                time.monotonic() + self.config.cooldown_s)
+        if self.metrics is not None:
+            self.metrics.inc("replaced_total",
+                             labels={"replica": str(index)})
+
+    # -- introspection ------------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "placements": dict(self._placement),
+                "confirmed": sorted(f"{ck}@{i}"
+                                    for ck, i in self._confirmed),
+                "cooling": sorted(f"{ck}@{i}"
+                                  for (ck, i), t in self._cooldown.items()
+                                  if t > time.monotonic()),
+            }

@@ -1,0 +1,476 @@
+"""The deploy subsystem's selftest: a multi-replica storm with teeth.
+
+Drives a :class:`~quest_tpu.deploy.pool.ReplicaPool` (>= 2 replicas, one
+shared persistent executable store) with the serve selftest's synthetic
+tenant mix and gates the properties that make a deployment a deployment:
+
+- **Bit-identity.**  Every routed request's state equals the single-replica
+  serial execution of the same circuit — replication must never change a
+  tenant's answer, whichever replica served it.
+- **Cache economics.**  Aggregate hit rate >= 0.9 after warm-up: affinity
+  placement is keeping each class's one-executable-per-class cache hot on
+  one replica (a spraying router would pay one miss per class PER replica).
+- **Cold start.**  A fresh replica warmed from the persistent store must
+  reach first-result-per-class STRICTLY faster than a cold-compiled one,
+  with ZERO compiles (obs/counters.py compile counters + the cache's own
+  ``compiles`` stat — persisted executables really are executables, not
+  recompile hints).
+- **Shed path.**  With one replica's queue artificially saturated,
+  deadline-carrying requests route to the next-best affinity candidate and
+  the deployment's deadline hit rate stays ABOVE the single-saturated-
+  replica baseline measured in the same run.
+- **One scrape.**  The merged Prometheus document parses and carries
+  per-replica labeled series (``{replica="i"}``).
+- **Traceability** (``--trace``).  The run exports through the
+  cross-process merge path with zero schema problems and a ``deploy.route``
+  span per routed submit.
+
+Multi-process mode (the CI ``deploy-selftest`` job): N processes under one
+``jax.distributed`` coordinator each run the full local selftest against
+ONE shared store, save their trace shard and per-process document to a
+sync directory, and process 0 merges the shards into one validated trace
+and aggregates every process's verdict into the final JSON.  The worker
+processes exercise ``broadcast_hot_keys`` (degrading gracefully where the
+backend cannot collective — the pinned CPU jaxlib) and the shared-store
+write races (atomic renames converge).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+__all__ = ["run_selftest", "coldstart_compare", "shed_gate"]
+
+_SEED = 11
+
+
+def _check(checks: dict, name: str, ok: bool, detail: str = "") -> bool:
+    checks[name] = {"ok": bool(ok), "detail": detail}
+    return bool(ok)
+
+
+def coldstart_compare(store_dir: str, classes: list,
+                      dtype=None) -> dict:
+    """Warm-vs-cold replica cold start over ``classes`` (a list of
+    ``(label, circuit)`` representatives): seconds from cache construction
+    to one completed request per class, plus the compile evidence.
+
+    The WARM side attaches the shared store and bulk-loads it before
+    serving (load time is charged to its cold-start — that is the honest
+    deployment cost); the COLD side compiles every class from scratch.
+    Process-wide compile counters (obs/counters.py) are sampled around
+    each side so "warm skipped the compiles" is asserted against the same
+    instrument bench rows use, not just this cache's own bookkeeping.
+
+    A PRODUCER cache first serves the probe shapes once with the store
+    attached — the warm peer whose traffic persisted these executables
+    (a storm that only ever batched would persist only batch-shaped
+    programs, and a warm-up can only skip compiles whose shapes a peer
+    actually served)."""
+    import jax.numpy as jnp
+
+    from .. import obs as _obs
+    from ..serve.cache import CompileCache
+    from .persist import ExecutableStore
+
+    def first_results(cache) -> float:
+        t0 = time.perf_counter()
+        for _label, circ in classes:
+            st = jnp.zeros((2, 1 << circ.num_qubits),
+                           jnp.float64 if dtype is None else dtype
+                           ).at[0, 0].set(1.0)
+            out = cache.execute(circ.key(), st, num_qubits=circ.num_qubits)
+            out.block_until_ready()
+        return time.perf_counter() - t0
+
+    producer = CompileCache().attach_store(ExecutableStore(store_dir))
+    first_results(producer)
+    report: dict = {}
+    for mode in ("cold", "warm"):
+        cache = CompileCache()
+        before = _obs.global_counters().snapshot()["compiles_total"]
+        t0 = time.perf_counter()
+        if mode == "warm":
+            store = ExecutableStore(store_dir, readonly=True)
+            warmed = store.warm(cache)
+        else:
+            warmed = None
+        serve_s = first_results(cache)
+        total_s = time.perf_counter() - t0
+        after = _obs.global_counters().snapshot()["compiles_total"]
+        report[mode] = {
+            "coldstart_seconds": total_s,
+            "first_results_seconds": serve_s,
+            "compiles": cache.stats["compiles"],
+            "global_compiles_delta": after - before,
+            "persist_hits": cache.stats["persist_hits"],
+            "persist_stale": cache.stats["persist_stale"],
+            "warmed": warmed,
+        }
+    report["speedup"] = (report["cold"]["coldstart_seconds"]
+                         / max(report["warm"]["coldstart_seconds"], 1e-9))
+    return report
+
+
+def shed_gate(probe_circuit, *, num_replicas: int = 2,
+              deadline_ms: float = 60_000.0, probes: int = 8,
+              fillers: int = 29, max_queue: int = 32) -> dict:
+    """The router-shed proof, baseline included.
+
+    **Baseline**: ``probes`` deadline-carrying requests queued into ONE
+    saturated, paused service whose deadlines expire before the worker
+    starts — the hit rate a deployment would see if it kept routing into
+    the saturated replica.  **Deployment**: a paused pool where the probe
+    class's affinity replica is prefilled past the shed threshold; the
+    router must place every deadline'd probe on another replica, and once
+    the pool runs, every probe completes in budget."""
+    from ..circuit import random_circuit
+    from ..serve.service import QuESTService
+    from ..validation import QuESTError
+    from .pool import ReplicaPool
+
+    # baseline: the single saturated replica
+    svc = QuESTService(max_batch=4, max_queue=max_queue, seed=_SEED,
+                      start=False)
+    base_futs = []
+    for _ in range(probes):
+        base_futs.append(svc.submit(probe_circuit, deadline_ms=40.0))
+    time.sleep(0.25)                      # every deadline expires queued
+    svc.start()
+    svc.drain(timeout=120)
+    base_hits = sum(1 for f in base_futs
+                    if f.exception() is None)
+    baseline_rate = base_hits / probes
+    svc.shutdown()
+
+    pool = ReplicaPool(num_replicas, max_batch=4, max_queue=max_queue,
+                       seed=_SEED, start=False)
+    try:
+        ck = pool.router.class_key(probe_circuit)
+        affinity = pool.router.candidates(ck)[0]
+        sat_replica = next(r for r in pool.replicas if r.index == affinity)
+        filler = random_circuit(4, depth=1, seed=1)
+        for _ in range(fillers):
+            try:
+                sat_replica.service.submit(filler)
+            except QuESTError:
+                break
+        saturation = sat_replica.service.queue_saturation()
+        # the decision itself, not the placement table: a shed deliberately
+        # leaves stickiness untouched so affinity returns after recovery
+        _r, decision = pool.router.route(probe_circuit,
+                                         deadline_ms=deadline_ms)
+        routed_away = (decision["replica"] != affinity
+                       and bool(decision["shed_from"]))
+        probe_futs = [pool.submit(probe_circuit, deadline_ms=deadline_ms)
+                      for _ in range(probes)]
+        pool.start()
+        pool.drain(timeout=240)
+        hits = sum(1 for f in probe_futs if f.exception() is None
+                   and f.result().state is not None)
+        shed_count = pool.metrics.counter_total("shed_total")
+        return {
+            "baseline_hit_rate": baseline_rate,
+            "deployment_hit_rate": hits / probes,
+            "affinity_replica": affinity,
+            "affinity_saturation": saturation,
+            "routed_away": bool(routed_away),
+            "shed_decisions": shed_count,
+            "probes": probes,
+        }
+    finally:
+        pool.shutdown(drain=True, timeout=120)
+
+
+def run_selftest(as_json: bool = False, scale: int = 1,
+                 replicas: int = 2, store_dir: str | None = None,
+                 trace: bool | None = None,
+                 sync_dir: str | None = None,
+                 process_index: int = 0, process_count: int = 1) -> int:
+    """Run the deployment storm; print the verdict (human text, or ONE
+    JSON document with ``--json``).  Returns the process exit status:
+    0 iff every check passed (in multi-process mode, on process 0: iff
+    every PROCESS passed and the shards merged into a valid trace)."""
+    import shutil
+    import tempfile
+
+    own_store = store_dir is None
+    if own_store:
+        store_dir = tempfile.mkdtemp(prefix="quest_deploy_store_")
+    try:
+        return _run_selftest(as_json=as_json, scale=scale,
+                             replicas=replicas, store_dir=store_dir,
+                             trace=trace, sync_dir=sync_dir,
+                             process_index=process_index,
+                             process_count=process_count)
+    finally:
+        if own_store:
+            shutil.rmtree(store_dir, ignore_errors=True)
+
+
+def _write_json_atomic(path: str, obj) -> None:
+    """A rendezvous file must never be readable half-written: a peer
+    treats its existence as 'ready'."""
+    import tempfile
+
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                               suffix=".tmp")
+    with os.fdopen(fd, "w", encoding="utf-8") as fh:
+        json.dump(obj, fh, default=float)
+    os.replace(tmp, path)
+
+
+def _run_selftest(as_json: bool, scale: int, replicas: int, store_dir: str,
+                  trace: bool | None, sync_dir: str | None,
+                  process_index: int, process_count: int) -> int:
+    import jax.numpy as jnp
+
+    from .. import obs as _obs
+    from ..serve.cache import CompileCache
+    from ..serve.metrics import parse_prometheus
+    from ..serve.selftest import workload_classes
+    from .pool import ReplicaPool, broadcast_hot_keys
+
+    def echo(line: str) -> None:
+        if not as_json:
+            print(line)
+
+    multiproc = process_count > 1
+    if trace is None:
+        trace = os.environ.get("QUEST_TPU_TRACE") == "1" or multiproc
+    if trace:
+        _obs.enable_tracing()
+        _obs.reset_tracing()
+
+    checks: dict = {}
+    ok = True
+
+    # --- the storm through the pool ---------------------------------------
+    from ..obs.slo import SLOConfig
+    pool = ReplicaPool(replicas, store_dir=store_dir, max_batch=16,
+                       max_delay_ms=10, seed=_SEED, start=False,
+                       slo=SLOConfig(window_s=3600.0))
+    ok &= _check(checks, "replicas", len(pool.replicas) >= 2,
+                 f"{len(pool.replicas)} replicas (need >= 2)")
+    classes = workload_classes(scale)
+    submitted = []
+    longest = max(len(cs) for _, cs, _ in classes)
+    for i in range(longest):
+        for label, circuits, shots in classes:
+            if i < len(circuits):
+                deadline = 600_000.0 if label == "qft8" else None
+                submitted.append(
+                    (label, circuits[i],
+                     pool.submit(circuits[i], shots=shots,
+                                 deadline_ms=deadline)))
+    pool.start()
+    ok &= _check(checks, "drain", pool.drain(timeout=600),
+                 f"{len(submitted)} routed requests drained")
+
+    # bit-identity vs the single-replica serial execution (one fresh cache
+    # outside the pool = exactly what one QuESTService would compute)
+    oracle = CompileCache()
+    seen: set = set()
+    exact = True
+    for label, circ, fut in submitted:
+        try:
+            res = fut.result(timeout=60)
+        except Exception:
+            continue               # counted by the no_failures check below
+        if label in seen:
+            continue
+        seen.add(label)
+        st = jnp.zeros((2, 1 << circ.num_qubits),
+                       jnp.float64).at[0, 0].set(1.0)
+        want = np.asarray(oracle.execute(circ.key(), st,
+                                         num_qubits=circ.num_qubits))
+        if not np.array_equal(res.state, want):
+            exact = False
+            echo(f"FAIL {label}: routed state != single-replica serial "
+                 f"(max |diff| {np.abs(res.state - want).max():.3g})")
+    failed = 0
+    for _, _, f in submitted:
+        try:
+            failed += f.exception(timeout=60) is not None
+        except Exception:          # not done / cancelled: also a failure
+            failed += 1
+    ok &= _check(checks, "results_bit_identical_to_single_replica", exact,
+                 f"{len(seen)} classes checked against the serial oracle")
+    ok &= _check(checks, "no_failures", failed == 0,
+                 f"{failed} failed futures of {len(submitted)}")
+
+    # aggregate cache economics across the pool
+    hits = sum(r.cache.stats["hits"] for r in pool.replicas)
+    misses = sum(r.cache.stats["misses"] for r in pool.replicas)
+    rate = hits / (hits + misses) if hits + misses else 0.0
+    ok &= _check(checks, "cache_hit_rate", rate >= 0.9,
+                 f"aggregate hit rate {rate:.3f} over {hits + misses} "
+                 f"lookups across {len(pool.replicas)} replica caches")
+
+    # the labeled one-scrape contract
+    prom = pool.prometheus()
+    try:
+        parsed = parse_prometheus(prom)
+        routed = parsed.get("quest_serve_routed_total", {})
+        labeled = [ls for ls in routed if "replica=" in ls]
+        per_replica = parsed.get("quest_serve_cache_hit_rate", {})
+        ok &= _check(checks, "prometheus_labeled",
+                     bool(labeled) and len(per_replica) >= len(pool.replicas),
+                     f"{len(parsed)} families; routed_total labels "
+                     f"{sorted(routed)}; {len(per_replica)} per-replica "
+                     "cache_hit_rate series")
+    except ValueError as exc:
+        ok &= _check(checks, "prometheus_labeled", False, str(exc))
+
+    # persistence happened and nothing was refused mid-run
+    store_snap = pool.store.snapshot()
+    stale = sum(r.cache.stats["persist_stale"] for r in pool.replicas)
+    ok &= _check(checks, "store_populated",
+                 store_snap["entries"] > 0 and stale == 0,
+                 f"{store_snap['entries']} persisted executables, "
+                 f"{stale} stale refusals")
+
+    # hot-key broadcast (collective where the backend can, local echo
+    # where it cannot — both prove the plumbing end-to-end)
+    hot = broadcast_hot_keys(pool.hot_keys())
+    ok &= _check(checks, "hot_keys_broadcast", len(hot) > 0,
+                 f"{len(hot)} hot keys published")
+
+    metrics = pool.metrics_dict()
+    router_snap = pool.router.snapshot()
+    pool.shutdown()
+
+    # --- cold start: warm-loaded vs cold-compiled replica ------------------
+    reps = [(label, cs[0]) for label, cs, _ in classes]
+    cold = coldstart_compare(store_dir, reps)
+    ok &= _check(
+        checks, "coldstart_warm_beats_cold",
+        cold["warm"]["coldstart_seconds"] < cold["cold"]["coldstart_seconds"]
+        and cold["warm"]["compiles"] == 0
+        and cold["warm"]["global_compiles_delta"] == 0
+        and cold["warm"]["persist_hits"] > 0
+        and cold["cold"]["compiles"] >= len(reps),
+        f"warm {cold['warm']['coldstart_seconds']:.3f}s "
+        f"({cold['warm']['compiles']} compiles, "
+        f"{cold['warm']['persist_hits']} persisted loads) vs cold "
+        f"{cold['cold']['coldstart_seconds']:.3f}s "
+        f"({cold['cold']['compiles']} compiles): {cold['speedup']:.1f}x")
+
+    # --- the shed path -----------------------------------------------------
+    from ..circuit import qft_circuit
+    shed = shed_gate(qft_circuit(8), num_replicas=max(2, replicas))
+    ok &= _check(
+        checks, "shed_path",
+        shed["routed_away"] and shed["shed_decisions"] > 0
+        and shed["deployment_hit_rate"] > shed["baseline_hit_rate"],
+        f"saturated replica {shed['affinity_replica']} "
+        f"(saturation {shed['affinity_saturation']:.2f}) shed "
+        f"{shed['shed_decisions']:.0f} decision(s); deployment hit rate "
+        f"{shed['deployment_hit_rate']:.2f} > saturated baseline "
+        f"{shed['baseline_hit_rate']:.2f}")
+
+    # --- trace export ------------------------------------------------------
+    trace_doc = None
+    shard = None
+    if trace:
+        shard = _obs.process_shard()
+        trace_doc = _obs.merge_shards([shard])
+        problems = _obs.validate_chrome_trace(trace_doc)
+        route_spans = [e for e in trace_doc["traceEvents"]
+                       if e.get("name") == "deploy.route"]
+        ok &= _check(checks, "trace_valid",
+                     not problems and len(route_spans) >= len(submitted),
+                     f"{len(route_spans)} deploy.route span(s) (need >= "
+                     f"{len(submitted)}), {len(problems)} schema problem(s)"
+                     + (f"; first: {problems[0]}" if problems else ""))
+
+    doc = {
+        "ok": bool(ok),
+        "process_index": process_index,
+        "process_count": process_count,
+        "checks": checks,
+        "replicas": metrics["replicas"],
+        "router": router_snap,
+        "store": store_snap,
+        "coldstart": cold,
+        "shed": shed,
+        "prometheus": prom,
+        "hot_keys": hot,
+    }
+    if trace_doc is not None and not multiproc:
+        doc["trace"] = trace_doc
+
+    # --- multi-process rendezvous ------------------------------------------
+    if multiproc:
+        assert sync_dir, "multi-process mode needs --sync-dir"
+        os.makedirs(sync_dir, exist_ok=True)
+        _write_json_atomic(
+            os.path.join(sync_dir, f"shard_p{process_index}.json"), shard)
+        _write_json_atomic(
+            os.path.join(sync_dir, f"selftest_p{process_index}.json"), doc)
+        if process_index != 0:
+            # worker verdict travels through its file; print it too
+            print(json.dumps({"ok": doc["ok"], "process_index":
+                              process_index}, default=float)
+                  if as_json else f"process {process_index}: "
+                  f"{'ok' if doc['ok'] else 'FAIL'}")
+            return 0 if ok else 1
+        # process 0: wait for every peer, merge, aggregate
+        peers = {}
+        shards = [shard]
+        deadline = time.monotonic() + 300.0
+        for p in range(1, process_count):
+            spath = os.path.join(sync_dir, f"shard_p{p}.json")
+            jpath = os.path.join(sync_dir, f"selftest_p{p}.json")
+            # writes are atomic (tmp + rename), so a readable file is a
+            # complete file — retry until both artifacts land or time out
+            peer = peer_shard = last_exc = None
+            while time.monotonic() < deadline:
+                try:
+                    with open(jpath, encoding="utf-8") as fh:
+                        peer = json.load(fh)
+                    peer_shard = _obs.load_shard(spath)
+                    break
+                except (OSError, ValueError) as exc:
+                    last_exc = exc
+                    time.sleep(0.2)
+            if peer is None or peer_shard is None:
+                ok &= _check(checks, f"peer_{p}", False,
+                             f"peer artifacts unreadable: {last_exc}")
+                continue
+            peers[p] = peer
+            shards.append(peer_shard)
+            ok &= _check(checks, f"peer_{p}", bool(peers[p].get("ok")),
+                         "peer selftest "
+                         + ("passed" if peers[p].get("ok") else
+                            json.dumps(peers[p].get("checks"))[:400]))
+        merged = _obs.merge_shards(shards)
+        problems = _obs.validate_chrome_trace(merged)
+        pids = {e.get("pid") for e in merged["traceEvents"]}
+        ok &= _check(checks, "merged_trace_valid",
+                     not problems and len(shards) == process_count
+                     and len(pids) >= process_count,
+                     f"{len(shards)}/{process_count} shards merged into "
+                     f"{len(pids)} process track(s), "
+                     f"{len(problems)} schema problem(s)"
+                     + (f"; first: {problems[0]}" if problems else ""))
+        doc["ok"] = bool(ok)
+        doc["peers"] = peers
+        doc["trace"] = merged
+
+    if as_json:
+        print(json.dumps(doc, default=float))
+    else:
+        for name, r in checks.items():
+            echo(f"[{'ok' if r['ok'] else 'FAIL'}] {name}: {r['detail']}")
+        echo("--- coldstart ---")
+        echo(json.dumps(cold, indent=1, default=float))
+        echo("--- shed ---")
+        echo(json.dumps(shed, indent=1, default=float))
+        echo("--- prometheus (head) ---")
+        echo("\n".join(prom.splitlines()[:40]))
+    return 0 if ok else 1

@@ -56,6 +56,17 @@ SLO_BURN = "O_SLO_BURN"
 #: way the flight ring and the metrics reservoir do
 _MAX_SAMPLES = 16384
 
+#: coarse latency edges for the :meth:`SLOMonitor.health` ring (mirrors
+#: serve/metrics.py LATENCY_BUCKETS — duplicated, not imported, so obs
+#: stays import-light; the p99 a router sheds on only needs bucket
+#: resolution, the exact percentile definition stays in ``snapshot()``)
+_HEALTH_LAT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                       0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+#: ring slots backing :meth:`SLOMonitor.health` — the short window divided
+#: into this many time buckets (default config: 60 s / 30 = 2 s buckets)
+_HEALTH_SLOTS = 30
+
 
 def nearest_rank_percentile(xs: list, q: float) -> float:
     """Nearest-rank percentile over raw observations — THE percentile
@@ -99,6 +110,15 @@ class SLOMonitor:
         self._saturation: list = []
         self.deadline_misses_total = 0
         self.deadline_hits_total = 0
+        # the health ring (see health()): _HEALTH_SLOTS time buckets, each
+        # [stamp, deadline_hits, deadline_misses, latency_bucket_counts].
+        # Written under the lock (writers already hold it); READ without
+        # any lock — slots are replaced wholesale when their stamp rolls
+        # over and int increments are atomic under the GIL, so a reader
+        # sees at worst a slightly-torn but individually-valid view.
+        self._h_width = max(self.config.window_s / _HEALTH_SLOTS, 1e-6)
+        self._h_ring: list = [None] * _HEALTH_SLOTS
+        self._sat_live = 0.0
 
     # -- recording ----------------------------------------------------------
     def observe(self, class_key: str, latency_s: float,
@@ -117,6 +137,19 @@ class SLOMonitor:
                 self.deadline_misses_total += 1
             if len(self._samples) > _MAX_SAMPLES:
                 del self._samples[:_MAX_SAMPLES // 2]
+            b = self._health_bucket(t)
+            if deadline_ok is True:
+                b[1] += 1
+            elif deadline_ok is False:
+                b[2] += 1
+            lat = float(latency_s)
+            counts = b[3]
+            for i, edge in enumerate(_HEALTH_LAT_BUCKETS):
+                if lat <= edge:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
 
     def observe_queue(self, depth: int, capacity: int,
                       now: float | None = None) -> None:
@@ -124,10 +157,74 @@ class SLOMonitor:
         fraction of the bounded queue."""
         t = time.monotonic() if now is None else now
         frac = depth / capacity if capacity else 1.0
+        self._sat_live = frac      # plain attr: the health() fast read
         with self._lock:
             self._saturation.append((t, frac))
             if len(self._saturation) > _MAX_SAMPLES:
                 del self._saturation[:_MAX_SAMPLES // 2]
+
+    def _health_bucket(self, t: float) -> list:
+        """The ring slot for instant ``t`` (caller holds the lock): reused
+        in place while its time stamp is current, replaced wholesale when
+        the ring wraps onto it."""
+        stamp = int(t / self._h_width)
+        idx = stamp % _HEALTH_SLOTS
+        b = self._h_ring[idx]
+        if b is None or b[0] != stamp:
+            b = self._h_ring[idx] = [stamp, 0, 0,
+                                     [0] * (len(_HEALTH_LAT_BUCKETS) + 1)]
+        return b
+
+    def health(self, now: float | None = None) -> dict:
+        """Cheap LOCK-FREE snapshot for a router's hot path: current queue
+        saturation, a bucket-resolution short-window p99, and the
+        short-window burn rate.  Reads plain attributes and walks the
+        fixed-size health ring without taking the monitor's lock — a
+        concurrent writer can tear the view by at most one in-flight
+        sample, which routing tolerates by construction (asserted
+        < 20 us/call in tests/test_slo.py, alongside the observe bound).
+
+        This is deliberately NOT ``snapshot()``: that one copies every
+        windowed sample and sorts per-class latencies — milliseconds on a
+        loaded service, fine for a scrape, ruinous per-routing-decision."""
+        t = time.monotonic() if now is None else now
+        stamp_min = int(t / self._h_width) - _HEALTH_SLOTS + 1
+        hits = misses = total = 0
+        counts = [0] * (len(_HEALTH_LAT_BUCKETS) + 1)
+        for b in self._h_ring:
+            if b is None or b[0] < stamp_min:
+                continue
+            hits += b[1]
+            misses += b[2]
+            bc = b[3]
+            for i in range(len(counts)):
+                counts[i] += bc[i]
+        total = sum(counts)
+        budget = 1.0 - self.config.deadline_hit_target
+        deadlined = hits + misses
+        burn = ((misses / deadlined) / budget) if deadlined and budget > 0 \
+            else 0.0
+        p99 = 0.0
+        if total:
+            want = max(1, int(0.99 * total + 0.999999))
+            cum = 0
+            # overflow rank clamps to the TOP finite edge ("p99 >= 30 s"),
+            # never inf: this dict lands verbatim in --json documents and
+            # Infinity is not an RFC-JSON token
+            p99 = _HEALTH_LAT_BUCKETS[-1]
+            for i, edge in enumerate(_HEALTH_LAT_BUCKETS):
+                cum += counts[i]
+                if cum >= want:
+                    p99 = edge       # upper bucket edge: a shed decision
+                    break            # needs resolution, not exactness
+        return {
+            "saturation": self._sat_live,
+            "p99_s": p99,
+            "burn_rate": burn,
+            "window_hits": hits,
+            "window_misses": misses,
+            "window_samples": total,
+        }
 
     # -- reading ------------------------------------------------------------
     def _burn(self, samples: list, now: float, window: float) -> tuple:

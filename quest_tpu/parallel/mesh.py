@@ -71,6 +71,43 @@ def broadcast_host_epoch() -> tuple[float, float]:
         return time.time(), 0.0
 
 
+def broadcast_payload(data: bytes, max_bytes: int = 1 << 16) -> bytes:
+    """Broadcast process 0's byte payload to every process — the same
+    ``multihost_utils.broadcast_one_to_all`` primitive as
+    :func:`broadcast_host_epoch`, carrying an opaque length-prefixed buffer
+    instead of a timestamp (the deploy layer ships hot compile-cache class
+    keys over it so cold replicas warm from the persistent store in peer
+    order; quest_tpu/deploy/pool.py).
+
+    Every process passes its OWN ``data`` (non-zero ranks' payloads are
+    ignored, as with any bcast) and receives process 0's.  The buffer is
+    padded to ``max_bytes`` so the collective has one static shape; a
+    payload longer than ``max_bytes - 4`` raises ``ValueError`` at the
+    sender.  Single-process: the identity, no collective.  Backends that
+    cannot run cross-process collectives (the pinned jaxlib's CPU backend,
+    docs/DESIGN.md "Known stack regressions") degrade to returning the
+    LOCAL payload rather than raise — warm-up hints are an optimization,
+    never the thing that kills a launch."""
+    if len(data) > max_bytes - 4:
+        raise ValueError(f"payload of {len(data)} bytes exceeds the "
+                         f"{max_bytes - 4}-byte broadcast buffer")
+    if process_info()["process_count"] <= 1:
+        return data
+    buf = np.zeros(max_bytes, np.uint8)
+    buf[:4] = np.frombuffer(np.uint32(len(data)).tobytes(), np.uint8)
+    buf[4:4 + len(data)] = np.frombuffer(data, np.uint8)
+    try:
+        from jax.experimental import multihost_utils
+        out = np.asarray(multihost_utils.broadcast_one_to_all(buf),
+                         np.uint8)
+        n = int(np.frombuffer(out[:4].tobytes(), np.uint32)[0])
+        if n > max_bytes - 4:
+            return data
+        return out[4:4 + n].tobytes()
+    except Exception:
+        return data
+
+
 def make_amps_mesh(devices) -> Mesh:
     """1-D mesh over the amplitude axis (power-of-2 device count)."""
     devices = np.asarray(devices)

@@ -53,6 +53,10 @@ __all__ = ["CacheOptions", "CacheEntry", "CompileCache", "global_cache",
 DEFAULT_MAX_BYTES = int(os.environ.get("QUEST_TPU_SERVE_CACHE_BYTES",
                                        str(1 << 30)))
 
+_FRESH_STATS = {"hits": 0, "misses": 0, "evictions": 0,
+                "compiles": 0, "compile_seconds": 0.0, "entry_bytes": 0,
+                "persist_hits": 0, "persist_stale": 0, "persist_saves": 0}
+
 
 @dataclasses.dataclass(frozen=True)
 class CacheOptions:
@@ -194,10 +198,23 @@ class CompileCache:
         self.max_bytes = DEFAULT_MAX_BYTES if max_bytes is None else int(max_bytes)
         self._lock = threading.RLock()
         self._entries: "OrderedDict[tuple, CacheEntry]" = OrderedDict()
-        self.stats = {"hits": 0, "misses": 0, "evictions": 0,
-                      "compiles": 0, "compile_seconds": 0.0,
-                      "entry_bytes": 0}
+        self.stats = dict(_FRESH_STATS)
         self.compile_times: list[float] = []
+        # optional persistent executable store (deploy/persist.py): when
+        # attached, _get_program consults it before compiling (a store hit
+        # installs a deserialized executable and touches NO compile
+        # counter) and writes every fresh compile through to it
+        self._store = None
+
+    def attach_store(self, store) -> "CompileCache":
+        """Back this cache with a persistent executable store
+        (``quest_tpu.deploy.persist.ExecutableStore``).  Misses consult the
+        store before compiling: a valid entry loads as ``persist_hits``
+        (zero compiles), a present-but-provenance-mismatched entry is
+        REFUSED, recompiled, and counted ``persist_stale`` (docs/DEPLOY.md
+        "persistence keying"); fresh compiles write through."""
+        self._store = store
+        return self
 
     # -- structural lookup --------------------------------------------------
     def entry_for(self, ops, num_qubits: int | None = None,
@@ -261,11 +278,74 @@ class CompileCache:
                           tuple(offsets), off)
 
     # -- program compilation ------------------------------------------------
+    def _entry_meta(self, entry: CacheEntry) -> dict:
+        """The class metadata a persisted program carries so a COLD cache
+        can re-materialize the CacheEntry (scheduled skeleton included —
+        warmed mesh classes skip the schedule search too)."""
+        return {"num_qubits": entry.num_qubits, "options": entry.options,
+                "skeleton": entry.skeleton, "offsets": entry.offsets,
+                "num_params": entry.num_params}
+
+    def install_entry(self, skey, num_qubits, options, skeleton, offsets,
+                      num_params) -> CacheEntry:
+        """Register a class entry from persisted metadata (the store's
+        warm-up path) — idempotent, and deliberately NOT a hit or a miss:
+        pre-population is provisioning, not traffic."""
+        with self._lock:
+            e = self._entries.get(skey)
+            if e is not None:
+                return e
+            e = CacheEntry(skey, options, num_qubits, skeleton, offsets,
+                           num_params)
+            self._entries[skey] = e
+            self._evict_locked()
+            return e
+
+    def install_program(self, entry: CacheEntry, tag: tuple, call,
+                        nbytes: int) -> _Program:
+        """Install an already-built executable under ``entry`` (a store
+        load or a peer transfer).  Counts ``persist_hits`` — and nothing on
+        the compile counters: the whole point of persistence is that this
+        path compiled NOTHING."""
+        with self._lock:
+            p = entry.programs.get(tag)
+            if p is not None:
+                return p
+            p = entry.programs[tag] = _Program(call, int(nbytes))
+            entry.nbytes += int(nbytes)
+            self.stats["persist_hits"] += 1
+            if entry.alive:
+                self.stats["entry_bytes"] += int(nbytes)
+                self._evict_locked()
+            return p
+
+    def program_keys(self) -> list:
+        """Every live (structural key, program tag) pair holding a
+        compiled program — the hot list a warm replica broadcasts so cold
+        peers know WHICH store entries to load first (deploy/pool.py)."""
+        with self._lock:
+            return [(e.skey, tag) for e in self._entries.values()
+                    for tag in e.programs]
+
     def _get_program(self, entry: CacheEntry, tag: tuple, build) -> _Program:
         with self._lock:
             p = entry.programs.get(tag)
             if p is not None:
                 return p
+        if self._store is not None:
+            status, call, nbytes = self._store.fetch(entry.skey, tag)
+            if status == "hit":
+                with _obs.span("cache.persist_load",
+                               class_key=_obs.key_hash(entry.skey),
+                               tag=str(tag[0])):
+                    return self.install_program(entry, tag, call, nbytes)
+            if status == "stale":
+                # present but refused (jaxlib/calibration provenance or
+                # digest mismatch): recompile below, and say so — a fleet
+                # quietly recompiling everything after an upgrade should
+                # show up on the scrape, not in a post-mortem
+                with self._lock:
+                    self.stats["persist_stale"] += 1
         t0 = time.perf_counter()
         with _obs.span("cache.compile", class_key=_obs.key_hash(entry.skey),
                        tag=str(tag[0]), engine=entry.options.engine):
@@ -290,6 +370,13 @@ class CompileCache:
             if entry.alive:
                 self.stats["entry_bytes"] += nbytes
                 self._evict_locked()
+        if self._store is not None:
+            # write-through (best-effort; opaque callables skip): the NEXT
+            # process to serve this class loads instead of compiling
+            if self._store.put(entry.skey, tag, call, nbytes,
+                               self._entry_meta(entry)):
+                with self._lock:
+                    self.stats["persist_saves"] += 1
         return p
 
     def single_program(self, entry: CacheEntry, state, *,
@@ -531,9 +618,7 @@ class CompileCache:
             for e in self._entries.values():
                 e.alive = False
             self._entries.clear()
-            self.stats = {"hits": 0, "misses": 0, "evictions": 0,
-                          "compiles": 0, "compile_seconds": 0.0,
-                          "entry_bytes": 0}
+            self.stats = dict(_FRESH_STATS)
             self.compile_times = []
 
 

@@ -9,6 +9,16 @@ the SLO.  Kept dependency-free on purpose: the container must not grow a
 prometheus_client requirement, and the text exposition format is a stable,
 trivially-writable contract (one ``name{labels} value`` line per sample).
 
+Counters and gauges take an optional ``labels`` dict so one registry can
+carry per-replica series (``quest_serve_requests_completed_total{replica="2"}``)
+as real Prometheus labels instead of name-mangling — the shape a pod-scale
+deployment (quest_tpu/deploy) scrapes as ONE document.  :meth:`Metrics.labeled`
+returns a VIEW over the same registry that stamps its base labels onto every
+counter/gauge write, so N replica services share one scrape with one TYPE
+line per family.  Histograms stay unlabeled: a deployment-level latency
+histogram aggregates replicas (per-replica percentiles live in each
+replica's windowed SLO monitor, obs/slo.py).
+
 Histograms keep both fixed buckets (the Prometheus export) and a bounded
 reservoir of raw observations (exact p50/p99 for the dict export — at serve
 request rates a few thousand retained floats are noise)."""
@@ -26,6 +36,36 @@ LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
 BATCH_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
 
 _RESERVOIR_CAP = 8192  # raw observations kept per histogram (FIFO halved)
+
+
+def _label_key(labels: dict | None) -> tuple:
+    """Canonical hashable form of a labels dict: sorted (name, value)
+    pairs, values coerced to str.  ``None``/empty -> () (the unlabeled
+    series — exactly the pre-labels registry behaviour)."""
+    if not labels:
+        return ()
+    items = []
+    for k in sorted(labels):
+        name = str(k)
+        if not name.replace("_", "").isalnum() or name[0].isdigit():
+            raise ValueError(f"bad label name {name!r}")
+        items.append((name, str(labels[k])))
+    return tuple(items)
+
+
+def _escape_label_value(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _label_str(key: tuple) -> str:
+    """The rendered ``k="v",...`` body (no braces) for a canonical label
+    key — also the sample-name suffix ``as_dict`` uses, matching what
+    :func:`parse_prometheus` returns as the labels string."""
+    return ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in key)
+
+
+def _sample_name(name: str, key: tuple) -> str:
+    return f"{name}{{{_label_str(key)}}}" if key else name
 
 
 class _Histogram:
@@ -68,23 +108,33 @@ class _Histogram:
 class Metrics:
     """A tiny metric registry: ``inc``/``set_gauge``/``observe`` and two
     exports — ``as_dict()`` for programmatic callers (the selftest gate)
-    and ``to_prometheus()`` for scrapers.  All methods are thread-safe."""
+    and ``to_prometheus()`` for scrapers.  All methods are thread-safe.
+
+    ``inc``/``set_gauge`` take an optional ``labels`` dict; every distinct
+    label set is its own sample under the one metric family.  Unlabeled
+    calls are the ``()`` label set, so the pre-labels API is unchanged."""
 
     def __init__(self, prefix: str = "quest_serve"):
         self.prefix = prefix
         self._lock = threading.Lock()
-        self._counters: dict[str, float] = {}
-        self._gauges: dict[str, float] = {}
+        # family name -> {canonical label key -> value}
+        self._counters: dict[str, dict[tuple, float]] = {}
+        self._gauges: dict[str, dict[tuple, float]] = {}
         self._hists: dict[str, _Histogram] = {}
 
     # -- recording ----------------------------------------------------------
-    def inc(self, name: str, value: float = 1.0) -> None:
+    def inc(self, name: str, value: float = 1.0,
+            labels: dict | None = None) -> None:
+        key = _label_key(labels)
         with self._lock:
-            self._counters[name] = self._counters.get(name, 0.0) + value
+            fam = self._counters.setdefault(name, {})
+            fam[key] = fam.get(key, 0.0) + value
 
-    def set_gauge(self, name: str, value: float) -> None:
+    def set_gauge(self, name: str, value: float,
+                  labels: dict | None = None) -> None:
+        key = _label_key(labels)
         with self._lock:
-            self._gauges[name] = float(value)
+            self._gauges.setdefault(name, {})[key] = float(value)
 
     def observe(self, name: str, value: float, buckets=LATENCY_BUCKETS) -> None:
         with self._lock:
@@ -93,20 +143,43 @@ class Metrics:
                 h = self._hists[name] = _Histogram(buckets)
             h.observe(value)
 
-    def counter(self, name: str) -> float:
+    def counter(self, name: str, labels: dict | None = None) -> float:
+        key = _label_key(labels)
         with self._lock:
-            return self._counters.get(name, 0.0)
+            return self._counters.get(name, {}).get(key, 0.0)
+
+    def counter_total(self, name: str) -> float:
+        """Sum of a counter family across ALL label sets — the deployment
+        view of a per-replica-labeled counter."""
+        with self._lock:
+            return sum(self._counters.get(name, {}).values())
+
+    def labeled(self, **labels) -> "_LabeledMetrics":
+        """A view over THIS registry that stamps ``labels`` onto every
+        counter/gauge write (histograms pass through unlabeled — they
+        aggregate at deployment level).  N replica services constructed
+        with ``pool_metrics.labeled(replica=str(i))`` share one registry,
+        one scrape, one TYPE line per family."""
+        return _LabeledMetrics(self, _label_key(labels))
 
     # -- export -------------------------------------------------------------
     def as_dict(self) -> dict:
+        """Counters/gauges keyed by SAMPLE name: the plain family name for
+        the unlabeled series, ``name{k="v"}`` for labeled ones (the same
+        sample-name strings :func:`parse_prometheus` round-trips)."""
         with self._lock:
             return {
-                "counters": dict(self._counters),
-                "gauges": dict(self._gauges),
+                "counters": {_sample_name(n, k): v
+                             for n, fam in self._counters.items()
+                             for k, v in fam.items()},
+                "gauges": {_sample_name(n, k): v
+                           for n, fam in self._gauges.items()
+                           for k, v in fam.items()},
                 "histograms": {k: h.summary() for k, h in self._hists.items()},
             }
 
-    def to_prometheus(self, extra_gauges: dict | None = None) -> str:
+    def to_prometheus(self, extra_gauges: dict | list | None = None,
+                      extra_labels: dict | None = None) -> str:
         """The Prometheus text exposition format.  ``extra_gauges`` lets the
         service splice point-in-time values into the same scrape without
         them living in the registry — the ONE-scrape contract
@@ -114,24 +187,45 @@ class Metrics:
         cache snapshot (``cache_*``), the tracing/ledger/flight counters
         (``obs_*``) and the windowed SLO view (``slo_*`` — hit rate, burn
         rates, queue saturation from quest_tpu/obs/slo.py) next to the
-        cumulative registry families."""
+        cumulative registry families.  ``extra_labels`` stamps a label set
+        onto every spliced extra gauge (the deployment scrape labels each
+        replica's cache/SLO splice ``{replica="i"}``).
+
+        ``extra_gauges`` may also be a LIST of ``(gauges_dict, labels)``
+        groups — N differently-labeled splices in one scrape (the
+        ``ReplicaPool`` case) without any of them entering the registry:
+        splices are point-in-time by contract, and a registry-resident
+        copy would go stale (and outlive a retired replica)."""
         with self._lock:
-            counters = dict(self._counters)
-            gauges = dict(self._gauges)
+            counters = {n: dict(fam) for n, fam in self._counters.items()}
+            gauges = {n: dict(fam) for n, fam in self._gauges.items()}
             hists = {k: (h.buckets, list(h.counts), h.total, h.count)
                      for k, h in self._hists.items()}
         if extra_gauges:
-            gauges.update({k: float(v) for k, v in extra_gauges.items()})
+            groups = (extra_gauges if isinstance(extra_gauges, list)
+                      else [(extra_gauges, extra_labels)])
+            for group, labels in groups:
+                if isinstance(extra_gauges, list) and extra_labels:
+                    # the list form must not silently drop extra_labels:
+                    # they underlay every group (group labels win ties)
+                    labels = {**extra_labels, **(labels or {})}
+                key = _label_key(labels)
+                for k, v in group.items():
+                    gauges.setdefault(k, {})[key] = float(v)
         p = self.prefix
         lines: list[str] = []
         for name in sorted(counters):
             full = f"{p}_{name}"
             lines.append(f"# TYPE {full} counter")
-            lines.append(f"{full} {_fmt(counters[name])}")
+            for key in sorted(counters[name]):
+                lines.append(f"{_sample_name(full, key)} "
+                             f"{_fmt(counters[name][key])}")
         for name in sorted(gauges):
             full = f"{p}_{name}"
             lines.append(f"# TYPE {full} gauge")
-            lines.append(f"{full} {_fmt(gauges[name])}")
+            for key in sorted(gauges[name]):
+                lines.append(f"{_sample_name(full, key)} "
+                             f"{_fmt(gauges[name][key])}")
         for name in sorted(hists):
             buckets, counts, total, count = hists[name]
             full = f"{p}_{name}"
@@ -145,6 +239,59 @@ class Metrics:
             lines.append(f"{full}_sum {_fmt(total)}")
             lines.append(f"{full}_count {count}")
         return "\n".join(lines) + "\n"
+
+
+class _LabeledMetrics:
+    """A label-stamping view over a shared :class:`Metrics` registry (see
+    :meth:`Metrics.labeled`).  Duck-typed to the registry surface the
+    service consumes; exports delegate to the base registry (ONE scrape)."""
+
+    def __init__(self, base: Metrics, key: tuple):
+        self._base = base
+        self._key = key
+        self.prefix = base.prefix
+
+    @property
+    def base_labels(self) -> dict:
+        return dict(self._key)
+
+    def _merged(self, labels: dict | None) -> dict:
+        merged = dict(self._key)
+        if labels:
+            merged.update({str(k): str(v) for k, v in labels.items()})
+        return merged
+
+    def inc(self, name: str, value: float = 1.0,
+            labels: dict | None = None) -> None:
+        self._base.inc(name, value, labels=self._merged(labels))
+
+    def set_gauge(self, name: str, value: float,
+                  labels: dict | None = None) -> None:
+        self._base.set_gauge(name, value, labels=self._merged(labels))
+
+    def observe(self, name: str, value: float, buckets=LATENCY_BUCKETS) -> None:
+        self._base.observe(name, value, buckets)
+
+    def counter(self, name: str, labels: dict | None = None) -> float:
+        return self._base.counter(name, labels=self._merged(labels))
+
+    def counter_total(self, name: str) -> float:
+        return self._base.counter_total(name)
+
+    def labeled(self, **labels) -> "_LabeledMetrics":
+        return _LabeledMetrics(self._base, _label_key(self._merged(labels)))
+
+    def as_dict(self) -> dict:
+        return self._base.as_dict()
+
+    def to_prometheus(self, extra_gauges=None,
+                      extra_labels: dict | None = None) -> str:
+        if isinstance(extra_gauges, list):
+            extra_gauges = [(g, self._merged(labels))
+                            for g, labels in extra_gauges]
+            return self._base.to_prometheus(extra_gauges)
+        merged = self._merged(extra_labels) if extra_gauges else None
+        return self._base.to_prometheus(extra_gauges, extra_labels=merged)
 
 
 def _fmt(v: float) -> str:

@@ -54,11 +54,15 @@ _U32 = 0xFFFFFFFF
 class ServeResult:
     """One completed request: the final (2, 2^n) SoA state, the per-request
     sample draws (``shots`` joint outcomes over all qubits, or None), and
-    the batch context it executed in."""
+    the batch context it executed in.  ``cache_outcome`` reports whether
+    this request's class lookup hit or missed the compile cache — the
+    affinity feedback the deployment router (quest_tpu/deploy/router.py)
+    re-places on when a replica evicts a class under byte pressure."""
     state: np.ndarray
     samples: np.ndarray | None
     batch_size: int
     request_id: int
+    cache_outcome: str | None = None
 
 
 @dataclasses.dataclass
@@ -422,7 +426,8 @@ class QuESTService:
                 samples = self._sample(st, req) if req.shots else None
                 try:
                     req.future.set_result(ServeResult(np.asarray(st), samples,
-                                                      len(live), req.rid))
+                                                      len(live), req.rid,
+                                                      outcomes[req.rid]))
                 except InvalidStateError:
                     self.flight_recorder.resolve(req.rid, "cancelled",
                                                  batch_id=batch_id)
@@ -489,6 +494,14 @@ class QuESTService:
         return np.minimum(outcomes, last_pos).astype(np.int64)
 
     # -- observability ------------------------------------------------------
+    def queue_saturation(self) -> float:
+        """LIVE queue fullness (depth / max_queue), read without the lock
+        (a list ``len`` is atomic).  The SLO monitor's saturation is
+        sampled at admissions, so a replica that traffic has already been
+        routed AWAY from would report its last (high) sample forever; a
+        router must read the live value to ever un-shed it."""
+        return len(self._queue) / self.max_queue
+
     def metrics_dict(self) -> dict:
         d = self.metrics.as_dict()
         d["cache"] = self._cache.snapshot()

@@ -103,6 +103,80 @@ def test_roundtrip_with_extra_gauges_and_obs_splice():
     assert parsed["quest_serve_requests_total"][""] == 3
 
 
+# ---------------------------------------------------------------------------
+# labeled series (the deploy layer's per-replica contract)
+# ---------------------------------------------------------------------------
+
+def test_labeled_roundtrip_property():
+    """Randomized LABELED counters/gauges: every (name, label set) sample
+    renders as real Prometheus labels and parses back exactly — one TYPE
+    line per family, N labeled samples under it."""
+    for seed in range(8):
+        rng = random.Random(100 + seed)
+        m = Metrics()
+        want = {}
+        for i in range(rng.randint(1, 4)):
+            name = f"ctr{i}_total"
+            for r in range(rng.randint(1, 3)):
+                labels = {"replica": str(r)}
+                if rng.random() < 0.5:
+                    labels["reason"] = rng.choice(["burn", "saturation"])
+                v = float(rng.randint(1, 10**6))
+                m.inc(name, v, labels=labels)
+                label_str = ",".join(f'{k}="{labels[k]}"'
+                                     for k in sorted(labels))
+                want[(f"quest_serve_{name}", label_str)] = v
+        # an unlabeled sample coexists with labeled ones in one family
+        m.inc("ctr0_total", 2.0)
+        want[("quest_serve_ctr0_total", "")] = 2.0
+        m.set_gauge("depth", 4.0, labels={"replica": "0"})
+        m.set_gauge("depth", 9.0, labels={"replica": "1"})
+        want[("quest_serve_depth", 'replica="0"')] = 4.0
+        want[("quest_serve_depth", 'replica="1"')] = 9.0
+        text = m.to_prometheus()
+        assert text.count("# TYPE quest_serve_depth gauge") == 1
+        parsed = parse_prometheus(text)
+        for (metric, label), v in want.items():
+            assert parsed[metric][label] == v, (metric, label)
+
+
+def test_labeled_view_shares_one_registry():
+    m = Metrics()
+    r0, r1 = m.labeled(replica="0"), m.labeled(replica="1")
+    r0.inc("requests_total", 5)
+    r1.inc("requests_total", 7)
+    r1.inc("shed_total", labels={"reason": "burn"})
+    assert m.counter("requests_total", labels={"replica": "0"}) == 5
+    assert m.counter_total("requests_total") == 12
+    assert r0.counter("requests_total") == 5       # view reads its own labels
+    parsed = parse_prometheus(m.to_prometheus())
+    assert parsed["quest_serve_requests_total"] == {
+        'replica="0"': 5.0, 'replica="1"': 7.0}
+    assert parsed["quest_serve_shed_total"] == {
+        'reason="burn",replica="1"': 1.0}
+    # histograms pass through unlabeled (deployment-level aggregation)
+    r0.observe("lat", 0.5)
+    r1.observe("lat", 1.5)
+    assert m.as_dict()["histograms"]["lat"]["count"] == 2
+
+
+def test_label_value_escaping_roundtrips():
+    m = Metrics()
+    tricky = 'a"b\\c\nd'
+    m.set_gauge("g", 1.0, labels={"k": tricky})
+    parsed = parse_prometheus(m.to_prometheus())
+    assert parsed["quest_serve_g"] == {'k="a\\"b\\\\c\\nd"': 1.0}
+
+
+def test_bad_label_name_rejected():
+    import pytest
+    m = Metrics()
+    with pytest.raises(ValueError):
+        m.inc("x", labels={"bad-name": "v"})
+    with pytest.raises(ValueError):
+        m.set_gauge("x", 1.0, labels={"9leading": "v"})
+
+
 def test_reservoir_percentiles_across_fifo_halving_boundary():
     """> 8192 observations: the reservoir drops its oldest half at the cap
     (documented O(1)-amortised recency bias) while the histogram's bucket

@@ -149,6 +149,52 @@ def test_observe_overhead_within_budget():
 
 
 # ---------------------------------------------------------------------------
+# the lock-free health() snapshot (the deploy router's hot-path read)
+# ---------------------------------------------------------------------------
+
+def test_health_snapshot_matches_injected_samples():
+    m = _monitor(deadline_hit_target=0.99, window_s=60.0)
+    t0 = time.monotonic()
+    for i in range(80):
+        m.observe("ck", 0.004, deadline_ok=(i % 10 != 0), now=t0)
+    m.observe_queue(30, 100, now=t0)
+    h = m.health(now=t0)
+    assert h["saturation"] == pytest.approx(0.30)
+    assert h["window_hits"] == 72 and h["window_misses"] == 8
+    # miss_rate 0.1 over budget 0.01 => burn 10x, same formula snapshot uses
+    assert h["burn_rate"] == pytest.approx(10.0)
+    # every latency in the (0.0025, 0.005] bucket: p99 reports its edge
+    assert h["p99_s"] == pytest.approx(0.005)
+    assert h["window_samples"] == 80
+
+
+def test_health_window_ages_out():
+    m = _monitor(window_s=60.0)
+    t0 = time.monotonic()
+    m.observe("ck", 5.0, deadline_ok=False, now=t0 - 300)   # ancient miss
+    m.observe("ck", 0.001, deadline_ok=True, now=t0)
+    h = m.health(now=t0)
+    assert h["window_misses"] == 0 and h["window_hits"] == 1
+    assert h["burn_rate"] == 0.0
+
+
+def test_health_read_overhead_within_budget():
+    """health() is read PER ROUTING DECISION — it must stay as cheap as
+    observe(): < 20 us/call, no lock taken (the ring walk is ~500 plain
+    int reads)."""
+    m = _monitor()
+    for i in range(5000):
+        m.observe("ck", 0.001 * (i % 11), deadline_ok=(i % 7 != 0))
+        m.observe_queue(i % 60, 100)
+    reps = 20_000
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        m.health()
+    per_call = (time.perf_counter() - t0) / reps
+    assert per_call < 20e-6, f"health costs {per_call * 1e6:.2f}us"
+
+
+# ---------------------------------------------------------------------------
 # service wiring
 # ---------------------------------------------------------------------------
 
