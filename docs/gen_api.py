@@ -9,6 +9,7 @@ import jax
 
 jax.config.update('jax_platforms', 'cpu')
 import quest_tpu as qt  # noqa: E402
+import quest_tpu.analysis  # noqa: E402,F401 (dotted-group resolution)
 
 GROUPS = [
     ("Environment", ["createQuESTEnv", "destroyQuESTEnv", "syncQuESTEnv",
@@ -91,6 +92,15 @@ GROUPS = [
       "load_profile", "validate_profile", "activate_calibration",
       "deactivate_calibration", "active_profile", "use_profile",
       "RuntimeCounters", "global_counters", "hbm_watermark"]),
+    ("Static analysis & concurrency audit (quest_tpu.analysis)",
+     ["analysis.analyze_circuit", "analysis.check_abstract_eval",
+      "analysis.lint_package", "analysis.lint_paths",
+      "analysis.verify_schedule", "analysis.check_equivalence",
+      "analysis.audit_concurrency_package",
+      "analysis.audit_concurrency_paths",
+      "analysis.audit_concurrency_source",
+      "analysis.strip_first_lock_scope",
+      "analysis.Interleaver", "analysis.run_schedule_fuzz_smoke"]),
 ]
 
 
@@ -107,7 +117,9 @@ def main() -> None:
         lines.append(f"## {title}")
         lines.append("")
         for n in names:
-            fn = getattr(qt, n)
+            fn = qt
+            for part in n.split("."):
+                fn = getattr(fn, part)
             try:
                 sig = str(inspect.signature(fn))
             except (TypeError, ValueError):

@@ -21,9 +21,15 @@ QuEST_validation.c plays in the reference but *ahead* of run time:
 5. :func:`audit_dispatch` / :func:`audit_schedule_pair` /
    :func:`audit_overlap` — lowered-jaxpr / compiled-HLO collective,
    donation and async-overlap audit against the planner's comm model.
+6. :func:`audit_concurrency_package` — lock-discipline audit over the
+   serve/deploy/obs runtime (``# guarded-by:`` / ``# lock-free:``
+   annotations, lock-order graph, blocking-under-lock; ``T_*`` codes)
+   with :func:`run_schedule_fuzz_smoke` as its dynamic twin: forced
+   thread interleavings stress-proving the lock-free read surfaces.
 
-CLI: ``python -m quest_tpu.analysis --self-lint`` (the tier-1 CI gate) and
-``--verify-schedule`` (the scheduler translation-validation smoke), see
+CLI: ``python -m quest_tpu.analysis --self-lint`` (the tier-1 CI gate),
+``--verify-schedule`` (the scheduler translation-validation smoke) and
+``--concurrency [--fuzz-smoke]`` (the lock-discipline gate), see
 ``python -m quest_tpu.analysis --help`` and docs/ANALYSIS.md.
 """
 
@@ -40,6 +46,14 @@ from .jaxpr_audit import (audit_dispatch, audit_epoch_donation,  # noqa: F401
                           count_hlo_async_collectives,
                           count_hlo_collectives, count_jaxpr_collectives,
                           donation_aliased)
+from .concurrency import (  # noqa: F401
+    audit_package as audit_concurrency_package,
+    audit_paths as audit_concurrency_paths,
+    audit_source as audit_concurrency_source,
+    strip_first_lock_scope)
+from .schedfuzz import (  # noqa: F401
+    Interleaver,
+    run_smoke as run_schedule_fuzz_smoke)
 
 __all__ = [
     "AnalysisCode", "Diagnostic", "Severity", "max_severity", "message_for",
@@ -51,4 +65,7 @@ __all__ = [
     "audit_schedule_pair",
     "count_jaxpr_collectives", "count_hlo_collectives",
     "count_hlo_async_collectives", "donation_aliased",
+    "audit_concurrency_package", "audit_concurrency_paths",
+    "audit_concurrency_source", "strip_first_lock_scope",
+    "Interleaver", "run_schedule_fuzz_smoke",
 ]

@@ -4,6 +4,20 @@ Modes (combinable; exit status is 1 iff any ERROR-severity diagnostic):
 
 - ``--self-lint``: purity-lint the installed quest_tpu tree (the CI gate).
 - ``--lint PATH [PATH ...]``: purity-lint arbitrary files/trees.
+- ``--concurrency``: lock-discipline audit over the serve/deploy/obs
+  runtime packages (analysis/concurrency.py): per lock-owning class,
+  every shared attribute's reads/writes are checked against its
+  ``# guarded-by:`` / ``# lock-free:`` annotation (``T_*`` codes: missing
+  guards, inconsistent guards, lock-order cycles, blocking calls under a
+  lock).  ``--concurrency-paths PATH ...`` audits arbitrary trees
+  instead.  ``--fuzz-smoke`` additionally runs the schedule-fuzzing
+  harness (analysis/schedfuzz.py) over the annotated lock-free read
+  surfaces — forced interleavings asserting every concurrent snapshot is
+  internally consistent; violations are ``T_SCHEDULE_FUZZ_FAILURE``
+  errors.  Under ``--json`` everything lands in the single document's
+  ``"concurrency"`` section (classes, lock graph, fuzz rows) with
+  severities in the shared ``diagnostics``/``summary`` sections the CI
+  gate already parses.
 - ``--qft N`` / ``--random N DEPTH``: analyze a generated benchmark circuit.
 - ``--circuit module:attr``: import and analyze a user circuit — ``attr``
   may be a :class:`quest_tpu.Circuit` or a zero-argument factory.
@@ -369,6 +383,26 @@ def main(argv=None) -> int:
                         help="purity-lint the quest_tpu package tree")
     parser.add_argument("--lint", nargs="+", metavar="PATH",
                         help="purity-lint the given files/directories")
+    parser.add_argument("--concurrency", action="store_true",
+                        help="lock-discipline audit over the serve/deploy/"
+                             "obs runtime packages (docs/ANALYSIS.md "
+                             "pass 7)")
+    parser.add_argument("--concurrency-paths", nargs="+", metavar="PATH",
+                        dest="concurrency_paths",
+                        help="audit these files/trees instead of the "
+                             "installed runtime packages (implies "
+                             "--concurrency)")
+    parser.add_argument("--fuzz-smoke", action="store_true",
+                        dest="fuzz_smoke",
+                        help="with --concurrency: run the schedule-fuzz "
+                             "smoke (analysis/schedfuzz.py) over the "
+                             "lock-free read surfaces; inconsistent "
+                             "snapshots are T_SCHEDULE_FUZZ_FAILURE "
+                             "errors (implies --concurrency)")
+    parser.add_argument("--fuzz-seeds", type=int, default=2,
+                        dest="fuzz_seeds", metavar="N",
+                        help="interleaving seeds per fuzz scenario "
+                             "(default %(default)s)")
     parser.add_argument("--qft", type=int, metavar="N",
                         help="analyze an N-qubit QFT circuit")
     parser.add_argument("--random", nargs=2, type=int, metavar=("N", "DEPTH"),
@@ -448,8 +482,8 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     doc: dict = {"circuits": [], "schedule": [], "verify": [],
-                 "serve_audit": [], "trace_report": [], "diagnostics": [],
-                 "summary": {}}
+                 "serve_audit": [], "trace_report": [], "concurrency": None,
+                 "diagnostics": [], "summary": {}}
 
     def echo(line: str) -> None:
         if not args.as_json:
@@ -463,6 +497,38 @@ def main(argv=None) -> int:
     if args.lint:
         diagnostics += lint_paths(args.lint)
         ran = True
+
+    if args.fuzz_smoke or args.concurrency_paths:
+        args.concurrency = True
+    if args.concurrency:
+        ran = True
+        from .concurrency import audit_package, audit_paths
+        if args.concurrency_paths:
+            report, found = audit_paths(args.concurrency_paths)
+        else:
+            report, found = audit_package()
+        echo(f"concurrency: {len(report['classes'])} lock-owning class(es) "
+             f"over {report['files']} file(s), "
+             f"{len(report['lock_graph']['edges'])} acquisition edge(s), "
+             f"{len(report['lock_graph']['cycles'])} cycle(s), "
+             f"{len(found)} finding(s)")
+        report["fuzz"] = None
+        if args.fuzz_smoke:
+            from .diagnostics import AnalysisCode, diag
+            from .schedfuzz import run_smoke
+            fuzz = run_smoke(seeds=range(max(1, args.fuzz_seeds)))
+            report["fuzz"] = fuzz
+            found = found + [
+                diag(AnalysisCode.SCHEDULE_FUZZ_FAILURE, Severity.ERROR,
+                     detail=v)
+                for v in fuzz["violations"]]
+            for row in fuzz["scenarios"]:
+                echo(f"fuzz {row['scenario']}[seed={row['seed']}]: "
+                     f"{row['switches']} forced switch(es), "
+                     f"{row['violations']} violation(s), "
+                     f"{row['errors']} error(s)")
+        doc["concurrency"] = report
+        diagnostics += found
 
     circuits = []
     if args.qft is not None:

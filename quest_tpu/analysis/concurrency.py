@@ -1,0 +1,808 @@
+"""Pass 7: lock-discipline static audit over the serve/deploy/obs runtime.
+
+PR 11 made quest_tpu a multi-replica deployment: thread-backed replica
+pools, an affinity router reading a lock-free SLO health ring, one shared
+labeled metrics registry, a batching service worker.  That is exactly the
+concurrency surface a pod-scale deployment stresses — and nothing in the
+analysis subsystem could prove a single lock is held where it must be.
+This pass makes the locking discipline *checkable*, in the spirit of
+lockset-based race detection (Eraser) and guarded-by annotation checking
+(Clang thread-safety analysis), but over plain Python ``threading``:
+
+- Per class that owns a lock (``threading.Lock`` / ``RLock`` /
+  ``Condition`` instance attribute), every instance attribute's reads and
+  writes are collected together with the **lexical lock scope** they run
+  under (``with self._lock:`` blocks and ``acquire()``/``try/finally
+  release()`` pairs).
+- Accesses are checked against the annotation convention
+  (docs/ANALYSIS.md "Concurrency audit"):
+
+  - ``# guarded-by: <lockname>`` on the attribute-initialising assignment
+    declares the guard; every post-``__init__`` write must hold it
+    (``T_UNGUARDED_SHARED_WRITE``), every read should
+    (``T_UNGUARDED_SHARED_READ``, WARNING).
+  - ``# lock-free: <reason>`` declares a deliberately unlocked structure
+    (the SLO health ring, single-word gauges); the reason string is
+    REQUIRED (``T_LOCK_FREE_NO_REASON``) and the schedule-fuzzing harness
+    (analysis/schedfuzz.py) stress-proves these surfaces dynamically.
+    The same comment on an individual access line waives that one site.
+  - ``# requires-lock: <lockname>`` on a helper method declares that its
+    CALLERS must hold the lock; its body is analysed as holding it, and a
+    call site that does not hold it is flagged.
+  - An attribute written outside ``__init__`` with no annotation gets its
+    guard *inferred* Eraser-style (the intersection of locks held across
+    write sites) and a ``T_UNANNOTATED_SHARED_ATTR`` warning asking for
+    the declaration.
+
+- The same walk builds a cross-class **lock acquisition-order graph**
+  (attribute-to-class bindings inferred from ``__init__``): a cycle is a
+  deadlock two opposite-order threads can hit (``T_LOCK_ORDER_CYCLE``),
+  including the degenerate self-cycle of re-acquiring a non-reentrant
+  ``Lock``.
+- Blocking operations inside a lock region (XLA compile/dispatch entry
+  points, ``Future.result``, ``sleep``, thread ``join``, ``wait`` on
+  anything that is not the held condition) are
+  ``T_BLOCKING_CALL_UNDER_LOCK``: on the routing/admission hot path they
+  serialise every contending thread behind device latency.
+
+Everything is intra-class and lexical on purpose: a rule fires only on
+provable violations of the declared (or unanimously inferred) discipline,
+so the pass stays false-positive-free on a clean tree and is enforceable
+in CI (``python -m quest_tpu.analysis --concurrency --json``) next to
+``--self-lint``.  Construction (``__init__``) is exempt — an object under
+construction is thread-private by the publication rules the rest of the
+tree already follows.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .diagnostics import AnalysisCode, Diagnostic, Severity, diag
+
+__all__ = ["audit_paths", "audit_package", "audit_source",
+           "strip_first_lock_scope", "AUDIT_SUBPACKAGES"]
+
+#: the quest_tpu subpackages the repo self-audit covers (the concurrent
+#: runtime surface; the analysis package itself is host-single-threaded
+#: except schedfuzz, whose scheduler is its own test subject)
+AUDIT_SUBPACKAGES = ("serve", "deploy", "obs")
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_]\w*)")
+_LOCKFREE_RE = re.compile(r"#\s*lock-free:\s*(.*?)\s*$")
+_REQUIRES_RE = re.compile(r"#\s*requires-lock:\s*([A-Za-z_]\w*)")
+
+#: threading constructors whose instance attributes count as locks
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+#: the reentrant kinds: re-acquiring one you hold is NOT a self-deadlock
+_REENTRANT_CTORS = {"RLock", "Condition"}
+
+#: method calls that mutate their receiver in place: ``self.X.append(...)``
+#: is a WRITE to X for lockset purposes
+_MUTATORS = frozenset((
+    "append", "appendleft", "extend", "insert", "pop", "popleft", "popitem",
+    "remove", "clear", "add", "discard", "update", "setdefault",
+    "move_to_end", "sort", "reverse",
+))
+
+#: attribute names whose call blocks (compile/dispatch, Future.result,
+#: sleep, thread join) — flagged inside any lock region.  ``wait`` is
+#: special-cased: waiting on the HELD condition releases it by contract.
+_BLOCKING_ATTRS = frozenset((
+    "sleep", "result", "block_until_ready", "lower", "compile",
+    "entry_for", "single_program", "batch_program", "overlap_program",
+    "epoch_program", "epoch_plane_program", "_get_program", "join", "wait",
+))
+#: dotted prefixes exempt from the blocking scan (``re.compile`` is a host
+#: regex build, not an XLA compile)
+_BLOCKING_EXEMPT_PREFIXES = ("re.",)
+
+#: factory functions whose return type is a known locking class — lets the
+#: lock-order graph bind ``self._cache = global_cache()`` style attributes
+_FACTORY_CLASSES = {
+    "global_cache": "CompileCache",
+    "global_ledger": "Ledger",
+    "global_counters": "RuntimeCounters",
+    "recorder": "TraceRecorder",
+}
+
+
+def _dotted(node: ast.AST) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """'X' for a ``self.X`` attribute node, else None."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class _Annotations:
+    """Per-file comment annotations, looked up by line number.  A comment
+    counts for a statement when it sits on the statement's first line or
+    on the directly preceding line (a pure comment line)."""
+
+    def __init__(self, source: str):
+        self.lines = source.splitlines()
+
+    def _line(self, lineno: int | None) -> str:
+        if lineno is None or not 1 <= lineno <= len(self.lines):
+            return ""
+        return self.lines[lineno - 1]
+
+    def _match(self, pattern: re.Pattern, lineno: int | None):
+        m = pattern.search(self._line(lineno))
+        if m is None and lineno is not None:
+            prev = self._line(lineno - 1).strip()
+            if prev.startswith("#"):
+                m = pattern.search(prev)
+        return m
+
+    def guarded_by(self, lineno: int | None) -> str | None:
+        m = self._match(_GUARDED_RE, lineno)
+        return m.group(1) if m else None
+
+    def lock_free(self, lineno: int | None) -> str | None:
+        """The reason string of a ``# lock-free:`` annotation ('' when the
+        annotation is present but unreasoned), None when absent."""
+        m = self._match(_LOCKFREE_RE, lineno)
+        return m.group(1) if m else None
+
+    def requires_lock(self, lineno: int | None) -> str | None:
+        m = self._match(_REQUIRES_RE, lineno)
+        return m.group(1) if m else None
+
+    def site_waived(self, lineno: int | None) -> bool:
+        """A site-level waiver: a reasoned ``# lock-free:`` comment on the
+        access line or the pure-comment line directly above it."""
+        m = self._match(_LOCKFREE_RE, lineno)
+        return bool(m and m.group(1))
+
+
+class _AttrInfo:
+    __slots__ = ("name", "guard", "lock_free", "init_line", "is_lock",
+                 "lock_ctor", "init_writes_only")
+
+    def __init__(self, name: str, init_line: int | None = None):
+        self.name = name
+        self.guard: str | None = None
+        self.lock_free: str | None = None       # reason ('' = unreasoned)
+        self.init_line = init_line
+        self.is_lock = False
+        self.lock_ctor: str | None = None
+        self.init_writes_only = True
+
+
+class _Access:
+    __slots__ = ("attr", "method", "line", "kind", "held", "waived")
+
+    def __init__(self, attr: str, method: str, line: int, kind: str,
+                 held: tuple, waived: bool):
+        self.attr = attr
+        self.method = method
+        self.line = line
+        self.kind = kind                # "read" | "write"
+        self.held = frozenset(held)
+        self.waived = waived
+
+
+class _ClassAudit:
+    """One class's inferred concurrency facts."""
+
+    def __init__(self, name: str, filename: str, line: int):
+        self.name = name
+        self.filename = filename
+        self.line = line
+        self.attrs: dict[str, _AttrInfo] = {}
+        self.accesses: list[_Access] = []
+        # lock attr -> ctor kind ("Lock" | "RLock" | "Condition")
+        self.locks: dict[str, str] = {}
+        # method name -> set of lock attrs it acquires lexically
+        self.method_acquires: dict[str, set] = {}
+        # method name -> lock it declares callers must hold
+        self.method_requires: dict[str, str] = {}
+        # self attr -> bound class name (for the cross-class lock graph)
+        self.attr_classes: dict[str, str] = {}
+        # (held_lock, attr, called_method, line) call events, resolved
+        # against other classes once every file is parsed
+        self.cross_calls: list[tuple] = []
+        # (from_lock, to_lock, line) intra-class acquisition order
+        self.intra_edges: list[tuple] = []
+        # (dotted_call, line, held) blocking calls inside lock regions
+        self.blocking: list[tuple] = []
+        # (method, line, required_lock) requires-lock violations
+        self.requires_violations: list[tuple] = []
+
+    def lock_kind(self, lock: str) -> str:
+        return self.locks.get(lock, "Lock")
+
+
+class _MethodWalker:
+    """Walks one method body tracking the lexical lock scope."""
+
+    def __init__(self, audit: _ClassAudit, ann: _Annotations, method: str,
+                 requires: str | None):
+        self.audit = audit
+        self.ann = ann
+        self.method = method
+        self.base_held: tuple = (requires,) if requires else ()
+
+    # -- entry ----------------------------------------------------------------
+    def walk(self, body: list) -> None:
+        self._walk_body(body, self.base_held)
+
+    # -- statement dispatch ---------------------------------------------------
+    def _walk_body(self, stmts: list, held: tuple) -> None:
+        i = 0
+        while i < len(stmts):
+            st = stmts[i]
+            lk = self._acquire_target(st)
+            if (lk is not None and i + 1 < len(stmts)
+                    and isinstance(stmts[i + 1], ast.Try)
+                    and self._releases(stmts[i + 1].finalbody, lk)):
+                # self.L.acquire(); try: ... finally: self.L.release()
+                self._note_acquisition(lk, held, st.lineno)
+                tr = stmts[i + 1]
+                inner = held + (lk,)
+                self._walk_body(tr.body, inner)
+                self._walk_body(tr.orelse, inner)
+                for h in tr.handlers:
+                    self._walk_body(h.body, inner)
+                self._walk_body(tr.finalbody, inner)
+                i += 2
+                continue
+            self._visit_stmt(st, held)
+            i += 1
+
+    def _acquire_target(self, st: ast.AST) -> str | None:
+        if (isinstance(st, ast.Expr) and isinstance(st.value, ast.Call)
+                and isinstance(st.value.func, ast.Attribute)
+                and st.value.func.attr == "acquire"):
+            name = _self_attr(st.value.func.value)
+            if name in self.audit.locks:
+                return name
+        return None
+
+    def _releases(self, finalbody: list, lock: str) -> bool:
+        for st in finalbody:
+            for node in ast.walk(st):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "release"
+                        and _self_attr(node.func.value) == lock):
+                    return True
+        return False
+
+    def _visit_stmt(self, st: ast.AST, held: tuple) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            # a nested def runs LATER, not under the lexically enclosing
+            # lock: analyse its body with an empty scope so a deferred
+            # closure can never inherit a guard it will not actually hold
+            self._walk_body(st.body, ())
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            new = list(held)
+            for item in st.items:
+                self._scan_expr(item.context_expr, tuple(new))
+                lk = _self_attr(item.context_expr)
+                if lk in self.audit.locks:
+                    self._note_acquisition(lk, tuple(new), st.lineno)
+                    new.append(lk)
+            self._walk_body(st.body, tuple(new))
+            return
+        if isinstance(st, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (st.targets if isinstance(st, ast.Assign)
+                       else [st.target])
+            for t in targets:
+                self._record_target(t, held)
+            value = getattr(st, "value", None)
+            if value is not None:
+                self._scan_expr(value, held)
+            return
+        if isinstance(st, ast.Delete):
+            for t in st.targets:
+                self._record_target(t, held)
+            return
+        for _field, value in ast.iter_fields(st):
+            if isinstance(value, list):
+                if value and isinstance(value[0], ast.stmt):
+                    self._walk_body(value, held)
+                elif value and isinstance(value[0], ast.excepthandler):
+                    for h in value:
+                        self._walk_body(h.body, held)
+                else:
+                    for v in value:
+                        if isinstance(v, ast.expr):
+                            self._scan_expr(v, held)
+            elif isinstance(value, ast.expr):
+                self._scan_expr(value, held)
+
+    def _note_acquisition(self, lock: str, held: tuple, line: int) -> None:
+        self.audit.method_acquires.setdefault(self.method, set()).add(lock)
+        for h in held:
+            self.audit.intra_edges.append((h, lock, line))
+
+    # -- targets (writes) -----------------------------------------------------
+    def _record_target(self, node: ast.AST, held: tuple) -> None:
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for el in node.elts:
+                self._record_target(el, held)
+            return
+        if isinstance(node, ast.Starred):
+            self._record_target(node.value, held)
+            return
+        base = node
+        while isinstance(base, ast.Subscript):
+            self._scan_expr(base.slice, held)
+            base = base.value
+        name = _self_attr(base)
+        if name is not None:
+            self._access(name, node.lineno, "write", held)
+            return
+        # non-self targets (locals, cross-object): scan for reads only
+        self._scan_expr(base, held)
+
+    # -- expressions ----------------------------------------------------------
+    def _scan_expr(self, node: ast.AST, held: tuple) -> None:
+        if node is None:
+            return
+        if isinstance(node, ast.Call):
+            self._scan_call(node, held)
+            return
+        if isinstance(node, ast.Lambda):
+            # lambdas are treated as executing at definition (sort keys,
+            # callbacks invoked inline): same lock scope
+            self._scan_expr(node.body, held)
+            return
+        name = _self_attr(node)
+        if name is not None:
+            self._access(name, node.lineno, "read", held)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._scan_expr(child, held)
+
+    def _scan_call(self, node: ast.Call, held: tuple) -> None:
+        func = node.func
+        handled_func = False
+        if isinstance(func, ast.Attribute):
+            recv_attr = _self_attr(func.value)
+            # self.X.mutator(...) => write to X
+            if recv_attr is not None and func.attr in _MUTATORS:
+                self._access(recv_attr, node.lineno, "write", held)
+                handled_func = True
+            # self.helper(...) where helper requires a lock
+            if isinstance(func.value, ast.Name) and func.value.id == "self":
+                req = self.audit.method_requires.get(func.attr)
+                if req is not None and req not in held:
+                    self.audit.requires_violations.append(
+                        (self.method, node.lineno, (func.attr, req)))
+                handled_func = True     # a method lookup is not a data read
+            # self.X.method(...): record for cross-class lock-graph edges
+            if (recv_attr is not None and held
+                    and recv_attr not in self.audit.locks):
+                for h in held:
+                    self.audit.cross_calls.append(
+                        (h, recv_attr, func.attr, node.lineno))
+            # blocking calls under a lock
+            if held and func.attr in _BLOCKING_ATTRS:
+                dotted = _dotted(func)
+                exempt = dotted.startswith(_BLOCKING_EXEMPT_PREFIXES)
+                if func.attr == "wait" and recv_attr in held:
+                    exempt = True       # Condition.wait releases the lock
+                if func.attr in ("result", "join") and isinstance(
+                        func.value, ast.Constant):
+                    exempt = True       # "sep".join(...) et al.
+                if not exempt and not self.ann.site_waived(node.lineno):
+                    self.audit.blocking.append((dotted or func.attr,
+                                                node.lineno, tuple(held)))
+        elif isinstance(func, ast.Name) and held:
+            if func.id in _BLOCKING_ATTRS and func.id == "sleep":
+                self.audit.blocking.append((func.id, node.lineno,
+                                            tuple(held)))
+        if not handled_func:
+            self._scan_expr(func, held)
+        for arg in node.args:
+            self._scan_expr(arg, held)
+        for kw in node.keywords:
+            self._scan_expr(kw.value, held)
+
+    def _access(self, attr: str, line: int, kind: str, held: tuple) -> None:
+        if attr in self.audit.locks:
+            return                      # lock objects audit themselves
+        info = self.audit.attrs.get(attr)
+        if info is None:
+            info = self.audit.attrs[attr] = _AttrInfo(attr)
+        if kind == "write":
+            info.init_writes_only = False
+        self.audit.accesses.append(
+            _Access(attr, self.method, line, kind, held,
+                    self.ann.site_waived(line)))
+
+
+def _lock_ctor_of(value: ast.AST) -> str | None:
+    """'Lock' / 'RLock' / 'Condition' when ``value`` constructs one."""
+    for node in ast.walk(value):
+        if isinstance(node, ast.Call):
+            last = _dotted(node.func).split(".")[-1]
+            if last in _LOCK_CTORS:
+                return last
+    return None
+
+
+def _bound_class(value: ast.AST, known_classes: set) -> str | None:
+    """The audited class name ``value`` constructs (or a known factory
+    returns), for attribute->class lock-graph bindings."""
+    for node in ast.walk(value):
+        if isinstance(node, ast.Call):
+            last = _dotted(node.func).split(".")[-1]
+            if last in known_classes:
+                return last
+            if last in _FACTORY_CLASSES:
+                return _FACTORY_CLASSES[last]
+    return None
+
+
+def _parse_class(node: ast.ClassDef, filename: str,
+                 ann: _Annotations) -> _ClassAudit:
+    audit = _ClassAudit(node.name, filename, node.lineno)
+    methods = [st for st in node.body
+               if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    # pass A: the attribute catalog + lock set from __init__
+    for fn in methods:
+        if fn.name != "__init__":
+            continue
+        for st in ast.walk(fn):
+            if not isinstance(st, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (st.targets if isinstance(st, ast.Assign)
+                       else [st.target])
+            value = st.value
+            for t in targets:
+                name = _self_attr(t)
+                if name is None or value is None:
+                    continue
+                info = audit.attrs.get(name)
+                if info is None:
+                    info = audit.attrs[name] = _AttrInfo(name, st.lineno)
+                elif info.init_line is None:
+                    info.init_line = st.lineno
+                ctor = _lock_ctor_of(value)
+                if ctor is not None:
+                    info.is_lock = True
+                    info.lock_ctor = ctor
+                    audit.locks[name] = ctor
+                info.guard = ann.guarded_by(st.lineno)
+                info.lock_free = ann.lock_free(st.lineno)
+    # pass B: method-level requires-lock declarations (body analysis needs
+    # the full table for call-site checks, so collect them all first)
+    for fn in methods:
+        req = ann.requires_lock(fn.lineno)
+        if req is not None:
+            audit.method_requires[fn.name] = req
+    return audit
+
+
+def _analyse_methods(audit: _ClassAudit, node: ast.ClassDef,
+                     ann: _Annotations) -> None:
+    for fn in node.body:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if fn.name == "__init__":
+            continue        # construction is thread-private by publication
+        _MethodWalker(audit, ann, fn.name,
+                      audit.method_requires.get(fn.name)).walk(fn.body)
+
+
+def _bind_attr_classes(audit: _ClassAudit, node: ast.ClassDef,
+                       known_classes: set) -> None:
+    for fn in node.body:
+        if not (isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and fn.name == "__init__"):
+            continue
+        for st in ast.walk(fn):
+            if not isinstance(st, ast.Assign):
+                continue
+            for t in st.targets:
+                name = _self_attr(t)
+                if name is None:
+                    continue
+                bound = _bound_class(st.value, known_classes)
+                if bound is not None:
+                    audit.attr_classes[name] = bound
+
+
+# ---------------------------------------------------------------------------
+# per-class checking
+# ---------------------------------------------------------------------------
+
+def _check_class(audit: _ClassAudit) -> list[Diagnostic]:
+    out: list[Diagnostic] = []
+    f = audit.filename
+
+    def emit(code: str, severity: Severity, line: int, detail: str) -> None:
+        out.append(diag(code, severity, file=f, line=line,
+                        detail=f"{audit.name}: {detail}"))
+
+    by_attr: dict[str, list[_Access]] = {}
+    for acc in audit.accesses:
+        by_attr.setdefault(acc.attr, []).append(acc)
+
+    for name, info in sorted(audit.attrs.items()):
+        if info.is_lock:
+            continue
+        accesses = by_attr.get(name, [])
+        writes = [a for a in accesses if a.kind == "write"]
+        if not writes:
+            continue            # set in __init__, read-only after: immutable
+        if info.lock_free is not None:
+            if not info.lock_free:
+                emit(AnalysisCode.LOCK_FREE_NO_REASON, Severity.ERROR,
+                     info.init_line or writes[0].line,
+                     f"attribute '{name}' waived without a reason")
+            continue            # deliberately unlocked: schedfuzz's job
+        guard = info.guard
+        if guard is None:
+            # Eraser-style inference: the intersection of locks held over
+            # every (unwaived) write site
+            locksets = [a.held for a in writes if not a.waived]
+            common = (locksets[0].intersection(*locksets[1:])
+                      if locksets else frozenset())
+            emit(AnalysisCode.UNANNOTATED_SHARED_ATTR, Severity.WARNING,
+                 info.init_line or writes[0].line,
+                 f"shared attribute '{name}' has no guarded-by/lock-free "
+                 f"annotation (inferred guard: "
+                 f"{sorted(common) if common else 'NONE'})")
+            for a in writes:
+                if a.waived:
+                    continue
+                if not a.held:
+                    emit(AnalysisCode.UNGUARDED_SHARED_WRITE, Severity.ERROR,
+                         a.line,
+                         f"write to '{name}' in {a.method}() holds no lock")
+            if not common and all(a.held or a.waived for a in writes):
+                distinct = sorted({tuple(sorted(a.held)) for a in writes
+                                   if not a.waived})
+                if len(distinct) > 1:
+                    emit(AnalysisCode.INCONSISTENT_GUARD, Severity.ERROR,
+                         writes[-1].line,
+                         f"'{name}' is written under disjoint locks "
+                         f"{distinct}: no common guard exists")
+            continue
+        if guard not in audit.locks:
+            emit(AnalysisCode.INCONSISTENT_GUARD, Severity.ERROR,
+                 info.init_line or writes[0].line,
+                 f"'{name}' declares guard '{guard}' but {audit.name} owns "
+                 f"no such lock (locks: {sorted(audit.locks)})")
+            continue
+        for a in accesses:
+            if a.waived or guard in a.held:
+                continue
+            if a.kind == "write":
+                if a.held:
+                    emit(AnalysisCode.INCONSISTENT_GUARD, Severity.ERROR,
+                         a.line,
+                         f"write to '{name}' in {a.method}() holds "
+                         f"{sorted(a.held)}, not its declared guard "
+                         f"'{guard}'")
+                else:
+                    emit(AnalysisCode.UNGUARDED_SHARED_WRITE, Severity.ERROR,
+                         a.line,
+                         f"write to '{name}' in {a.method}() without its "
+                         f"declared guard '{guard}'")
+            else:
+                emit(AnalysisCode.UNGUARDED_SHARED_READ, Severity.WARNING,
+                     a.line,
+                     f"read of '{name}' in {a.method}() without its "
+                     f"declared guard '{guard}'")
+
+    for method, line, (callee, req) in audit.requires_violations:
+        emit(AnalysisCode.UNGUARDED_SHARED_WRITE, Severity.ERROR, line,
+             f"{method}() calls {callee}() which requires-lock '{req}' "
+             f"without holding it")
+
+    for dotted, line, held in audit.blocking:
+        emit(AnalysisCode.BLOCKING_CALL_UNDER_LOCK, Severity.ERROR, line,
+             f"blocking call {dotted}(...) while holding {sorted(held)}")
+
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the lock acquisition-order graph
+# ---------------------------------------------------------------------------
+
+def _lock_graph_report(audits: list[_ClassAudit]) -> tuple[list, list,
+                                                           list[Diagnostic]]:
+    """(edge rows, cycles, diagnostics) for the acquisition-order graph."""
+    by_name = {a.name: a for a in audits}
+    edges: dict[tuple, tuple] = {}
+    out: list[Diagnostic] = []
+    for a in audits:
+        for frm, to, line in a.intra_edges:
+            if frm == to:
+                if a.lock_kind(frm) not in _REENTRANT_CTORS:
+                    out.append(diag(
+                        AnalysisCode.LOCK_ORDER_CYCLE, Severity.ERROR,
+                        file=a.filename, line=line,
+                        detail=(f"{a.name}: re-acquiring non-reentrant lock "
+                                f"'{frm}' while holding it: self-deadlock")))
+                continue
+            edges.setdefault((f"{a.name}.{frm}", f"{a.name}.{to}"),
+                             (a.filename, line))
+        for held, attr, called, line in a.cross_calls:
+            target = by_name.get(a.attr_classes.get(attr, ""))
+            if target is None:
+                continue
+            for lk in target.method_acquires.get(called, ()):
+                frm, to = f"{a.name}.{held}", f"{target.name}.{lk}"
+                if frm != to:
+                    edges.setdefault((frm, to), (a.filename, line))
+    adj: dict[str, list[str]] = {}
+    for (frm, to) in edges:
+        adj.setdefault(frm, []).append(to)
+    color: dict[str, int] = {}
+    stack: list[str] = []
+    cycles: list[list[str]] = []
+
+    def dfs(n: str) -> None:
+        color[n] = 1
+        stack.append(n)
+        for m in sorted(adj.get(n, ())):
+            if color.get(m, 0) == 0:
+                dfs(m)
+            elif color.get(m) == 1:
+                cyc = stack[stack.index(m):] + [m]
+                if not any(set(c) == set(cyc) for c in cycles):
+                    cycles.append(cyc)
+        stack.pop()
+        color[n] = 2
+
+    for n in sorted(adj):
+        if color.get(n, 0) == 0:
+            dfs(n)
+    for cyc in cycles:
+        loc = edges.get((cyc[0], cyc[1]))
+        out.append(diag(AnalysisCode.LOCK_ORDER_CYCLE, Severity.ERROR,
+                        file=loc[0] if loc else None,
+                        line=loc[1] if loc else None,
+                        detail="acquisition-order cycle "
+                               + " -> ".join(cyc)))
+    edge_rows = [{"from": frm, "to": to, "file": fl, "line": ln}
+                 for (frm, to), (fl, ln) in sorted(edges.items())]
+    return edge_rows, cycles, out
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def _audit_sources(sources: list[tuple]) -> tuple[dict, list[Diagnostic]]:
+    """Audit ``[(filename, source), ...]`` together (cross-file lock graph).
+    Returns (report document, diagnostics)."""
+    audits: list[_ClassAudit] = []
+    parsed: list[tuple] = []
+    for filename, source in sources:
+        tree = ast.parse(source, filename=filename)
+        ann = _Annotations(source)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                audit = _parse_class(node, filename, ann)
+                if audit.locks:
+                    audits.append(audit)
+                    parsed.append((audit, node, ann))
+    known = {a.name for a in audits}
+    for audit, node, ann in parsed:
+        _bind_attr_classes(audit, node, known)
+        _analyse_methods(audit, node, ann)
+    diagnostics: list[Diagnostic] = []
+    class_rows = []
+    for audit in audits:
+        found = _check_class(audit)
+        diagnostics += found
+        attr_rows = {}
+        for name, info in sorted(audit.attrs.items()):
+            if info.is_lock:
+                continue
+            accesses = [a for a in audit.accesses if a.attr == name]
+            if not accesses and info.init_writes_only:
+                continue
+            attr_rows[name] = {
+                "guard": info.guard,
+                "lock_free": info.lock_free,
+                "writes": sum(a.kind == "write" for a in accesses),
+                "reads": sum(a.kind == "read" for a in accesses),
+            }
+        class_rows.append({
+            "name": audit.name,
+            "file": audit.filename,
+            "line": audit.line,
+            "locks": {k: v for k, v in sorted(audit.locks.items())},
+            "attrs": attr_rows,
+            "findings": len(found),
+        })
+    edge_rows, cycles, graph_diags = _lock_graph_report(audits)
+    diagnostics += graph_diags
+    report = {
+        "files": len(sources),
+        "classes": class_rows,
+        "lock_graph": {"edges": edge_rows, "cycles": cycles},
+        "findings": len(diagnostics),
+    }
+    return report, diagnostics
+
+
+def audit_source(source: str, filename: str = "<string>") -> list[Diagnostic]:
+    """Audit one module's source text (the mutation-harness entry point)."""
+    _report, diagnostics = _audit_sources([(filename, source)])
+    return diagnostics
+
+
+def audit_paths(paths) -> tuple[dict, list[Diagnostic]]:
+    """Audit ``.py`` files / directory trees together."""
+    files: list[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            for root, _dirs, names in os.walk(path):
+                files.extend(os.path.join(root, f) for f in sorted(names)
+                             if f.endswith(".py"))
+        else:
+            files.append(path)
+    sources = []
+    for f in files:
+        with open(f, "r", encoding="utf-8") as fh:
+            sources.append((f, fh.read()))
+    return _audit_sources(sources)
+
+
+def audit_package() -> tuple[dict, list[Diagnostic]]:
+    """Audit the installed quest_tpu serve/deploy/obs trees (the
+    ``--concurrency`` CLI target and the repo self-audit)."""
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return audit_paths([os.path.join(pkg_root, sub)
+                        for sub in AUDIT_SUBPACKAGES])
+
+
+# ---------------------------------------------------------------------------
+# the adversarial mutation helper (mirrors PR 3's mutation-harness pattern)
+# ---------------------------------------------------------------------------
+
+def strip_first_lock_scope(source: str, lock: str = "_lock") -> str:
+    """Return ``source`` with the FIRST ``with self.<lock>:`` statement
+    removed and its body dedented in place — the adversarial self-test's
+    mutation: the auditor must flag the newly unguarded accesses
+    (tests/test_concurrency.py and the CI lint job both assert it)."""
+    tree = ast.parse(source)
+    target: ast.With | None = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.With) and target is None:
+            for item in node.items:
+                if _self_attr(item.context_expr) == lock:
+                    target = node
+                    break
+    if target is None:
+        raise ValueError(f"no 'with self.{lock}:' statement in source")
+    lines = source.splitlines(keepends=True)
+    body_col = target.body[0].col_offset
+    dedent = body_col - target.col_offset
+    out = []
+    body_first = target.body[0].lineno
+    body_last = target.end_lineno or body_first
+    for i, line in enumerate(lines, 1):
+        if i == target.lineno:
+            continue                    # drop the `with self._lock:` line
+        if body_first <= i <= body_last and line[:dedent].isspace():
+            line = line[dedent:]
+        out.append(line)
+    return "".join(out)

@@ -67,6 +67,17 @@ class AnalysisCode:
     ANGLE_NOT_F64 = "P_ANGLE_NOT_F64"
     CALLBACK_IN_SHARD_MAP = "P_HOST_CALLBACK_IN_SHARD_MAP"
     IMPORT_TIME_STATE_MUTATION = "P_IMPORT_TIME_STATE_MUTATION"
+    DAEMON_THREAD_LEAK = "P_DAEMON_THREAD_LEAK"
+    # concurrency lock-discipline audit (analysis/concurrency.py) and its
+    # dynamic twin, the schedule-fuzzing harness (analysis/schedfuzz.py)
+    UNGUARDED_SHARED_WRITE = "T_UNGUARDED_SHARED_WRITE"
+    UNGUARDED_SHARED_READ = "T_UNGUARDED_SHARED_READ"
+    INCONSISTENT_GUARD = "T_INCONSISTENT_GUARD"
+    LOCK_ORDER_CYCLE = "T_LOCK_ORDER_CYCLE"
+    BLOCKING_CALL_UNDER_LOCK = "T_BLOCKING_CALL_UNDER_LOCK"
+    UNANNOTATED_SHARED_ATTR = "T_UNANNOTATED_SHARED_ATTR"
+    LOCK_FREE_NO_REASON = "T_LOCK_FREE_NO_REASON"
+    SCHEDULE_FUZZ_FAILURE = "T_SCHEDULE_FUZZ_FAILURE"
 
 
 ANALYSIS_MESSAGES = {
@@ -186,6 +197,53 @@ ANALYSIS_MESSAGES = {
         "process.  Allowlisted sites only: quest_tpu/_compat.py (the x64 "
         "default) and quest_tpu/obs/trace.py (the span recorder's "
         "crash-dump hook).",
+    AnalysisCode.DAEMON_THREAD_LEAK:
+        "A threading.Thread started in serve/ or deploy/ is neither joined "
+        "on a shutdown()/close() path nor daemonized with a '# daemon-ok: "
+        "<reason>' comment: the deployment would leak a worker (or block "
+        "interpreter exit) every time this code path runs.",
+    AnalysisCode.UNGUARDED_SHARED_WRITE:
+        "A shared instance attribute of a lock-owning class is written "
+        "without holding its guard lock (declared '# guarded-by:' or "
+        "inferred from the other write sites): a concurrent reader or "
+        "writer can observe a torn or lost update.  Hold the guard, or "
+        "annotate the attribute '# lock-free: <reason>' if the unlocked "
+        "access is deliberate.",
+    AnalysisCode.UNGUARDED_SHARED_READ:
+        "A guarded shared attribute is read without its guard lock: the "
+        "read can observe mid-update state.  Take the guard, or waive the "
+        "site with '# lock-free: <reason>' when the tear is tolerated by "
+        "construction (e.g. a single-word hot-path gauge).",
+    AnalysisCode.INCONSISTENT_GUARD:
+        "The same shared attribute is accessed under DIFFERENT locks at "
+        "different sites: no single lock serialises its writers, so the "
+        "locking provides no mutual exclusion at all for this attribute.",
+    AnalysisCode.LOCK_ORDER_CYCLE:
+        "The cross-class lock acquisition-order graph contains a cycle: "
+        "two threads taking the locks in opposite orders deadlock.  Break "
+        "the cycle by moving one call outside the lock region (or by "
+        "imposing one global acquisition order).",
+    AnalysisCode.BLOCKING_CALL_UNDER_LOCK:
+        "A blocking operation (compile/dispatch, Future.result, sleep, "
+        "thread join, non-condition wait) executes inside a lock region on "
+        "the serving hot path: every thread contending for the lock stalls "
+        "behind device or wall-clock latency.  Move the blocking work "
+        "outside the lock (copy state in, publish results after).",
+    AnalysisCode.UNANNOTATED_SHARED_ATTR:
+        "A mutable shared attribute of a lock-owning class carries neither "
+        "'# guarded-by: <lock>' nor '# lock-free: <reason>' on its "
+        "initialising assignment: the lock discipline for it is undeclared "
+        "and cannot be machine-checked (docs/ANALYSIS.md pass 7).",
+    AnalysisCode.LOCK_FREE_NO_REASON:
+        "A '# lock-free:' annotation with an EMPTY reason string: the "
+        "waiver exists to record WHY the unlocked access is safe (torn-read "
+        "tolerance, single-word store, set-once-before-traffic); an "
+        "unreasoned waiver is a refused waiver.",
+    AnalysisCode.SCHEDULE_FUZZ_FAILURE:
+        "The schedule-fuzzing harness (analysis/schedfuzz.py) drove a "
+        "forced thread interleaving in which a lock-free read surface "
+        "returned an internally inconsistent snapshot or a concurrent "
+        "operation raised: a real runtime race, not a static projection.",
 }
 
 

@@ -31,12 +31,20 @@ Rules
   place the package-wide x64 default is set) and ``quest_tpu/obs/trace.py``
   (the span-recorder singleton's crash-dump atexit hook — one process, one
   trace).
+- ``P_DAEMON_THREAD_LEAK`` (``serve/`` and ``deploy/`` files only): every
+  ``threading.Thread`` constructed in the runtime packages must either be
+  joined — a ``.join(...)`` in the same function, or (for ``self.X``
+  threads) a ``self.X.join(...)`` anywhere in the module's shutdown/close
+  paths — or be daemonized WITH a ``# daemon-ok: <reason>`` comment on the
+  construction statement.  An unjoined non-daemon thread blocks process
+  exit; an uncommented daemon thread is a worker nobody owns.
 """
 
 from __future__ import annotations
 
 import ast
 import os
+import re
 
 from .diagnostics import AnalysisCode, Diagnostic, Severity, diag
 
@@ -61,6 +69,12 @@ _IMPORT_MUTATOR_TARGETS = ("jax.config", "config")
 # hook; one process, one trace — docs/OBSERVABILITY.md)
 _IMPORT_MUTATION_ALLOWLIST = ("quest_tpu/_compat.py",
                               "quest_tpu/obs/trace.py")
+
+# the runtime packages whose threads the P_DAEMON_THREAD_LEAK rule owns
+# (path fragments; the analysis CLI lints the installed tree, tests lint
+# synthetic sources with matching names)
+_THREAD_LEAK_SCOPES = ("quest_tpu/serve/", "quest_tpu/deploy/")
+_DAEMON_OK_RE = re.compile(r"#\s*daemon-ok:\s*\S")
 
 
 def _dotted(node: ast.AST) -> str:
@@ -283,12 +297,154 @@ def _lint_import_time(tree: ast.Module, filename: str) -> list[Diagnostic]:
     return out
 
 
+def _thread_ctor(node: ast.AST) -> ast.Call | None:
+    if (isinstance(node, ast.Call)
+            and _dotted(node.func) in ("threading.Thread", "Thread")):
+        return node
+    return None
+
+
+def _lint_thread_leaks(tree: ast.Module, filename: str,
+                       source: str) -> list[Diagnostic]:
+    """``P_DAEMON_THREAD_LEAK`` over serve/ and deploy/ modules: every
+    constructed thread must be joined (same function, or ``self.X.join``
+    anywhere in the module for ``self.X`` threads) or daemonized with a
+    reasoned ``# daemon-ok:`` comment on its construction statement."""
+    normalized = os.path.normpath(filename).replace(os.sep, "/")
+    if not any(scope in normalized for scope in _THREAD_LEAK_SCOPES):
+        return []
+    lines = source.splitlines()
+
+    def has_daemon_ok(start: int, end: int) -> bool:
+        # the statement's own lines, plus the contiguous comment block
+        # directly above it (the conventional place for the reason)
+        while start > 1 and lines[start - 2].lstrip().startswith("#"):
+            start -= 1
+        return any(_DAEMON_OK_RE.search(lines[i - 1])
+                   for i in range(start, min(end, len(lines)) + 1))
+
+    # every `self.X.join(...)` receiver attr in the module (the shutdown/
+    # close path of a worker-owning class joins its own thread attribute)
+    self_joins: set[str] = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"):
+            recv = node.func.value
+            if (isinstance(recv, ast.Attribute)
+                    and isinstance(recv.value, ast.Name)
+                    and recv.value.id == "self"):
+                self_joins.add(recv.attr)
+
+    out: list[Diagnostic] = []
+
+    def check_ctor(ctor: ast.Call, st: ast.stmt,
+                   has_local_join: bool) -> None:
+        daemon = any(kw.arg == "daemon"
+                     and isinstance(kw.value, ast.Constant)
+                     and kw.value.value is True
+                     for kw in ctor.keywords)
+        if daemon:
+            if not has_daemon_ok(st.lineno, st.end_lineno or st.lineno):
+                out.append(diag(
+                    AnalysisCode.DAEMON_THREAD_LEAK, Severity.ERROR,
+                    file=filename, line=ctor.lineno,
+                    detail="daemon=True without a '# daemon-ok: <reason>' "
+                           "comment"))
+            return
+        if has_local_join:
+            return
+        self_attr = None
+        if (isinstance(st, ast.Assign) and len(st.targets) == 1
+                and isinstance(st.targets[0], ast.Attribute)
+                and isinstance(st.targets[0].value, ast.Name)
+                and st.targets[0].value.id == "self"):
+            self_attr = st.targets[0].attr
+        if self_attr is not None and self_attr in self_joins:
+            return
+        out.append(diag(
+            AnalysisCode.DAEMON_THREAD_LEAK, Severity.ERROR,
+            file=filename, line=ctor.lineno,
+            detail="thread is never joined (no .join in this function"
+                   + (f", no self.{self_attr}.join in the module"
+                      if self_attr else "")
+                   + ") and not daemonized"))
+
+    def scan_function(fn: ast.AST) -> None:
+        # names bound to threads in THIS function: assignment targets whose
+        # value constructs a Thread (including list-builds), receivers of
+        # .append(Thread(...)), plus for-loop / comprehension variables
+        # iterating over such a name — a `.join(` only counts when its
+        # receiver is one of these (a stray os.path.join or sep.join must
+        # not silently satisfy the rule)
+        joinable: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and any(
+                    _thread_ctor(n) is not None for n in ast.walk(node.value)):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        joinable.add(t.id)
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "append"
+                  and isinstance(node.func.value, ast.Name)
+                  and any(_thread_ctor(n) is not None
+                          for a in node.args for n in ast.walk(a))):
+                joinable.add(node.func.value.id)
+        grew = True
+        while grew:         # loop aliases can chain (for t in ts: ...)
+            grew = False
+            for node in ast.walk(fn):
+                targets: list = []
+                if (isinstance(node, ast.For)
+                        and isinstance(node.iter, ast.Name)
+                        and node.iter.id in joinable):
+                    targets = [node.target]
+                elif (isinstance(node, ast.comprehension)
+                      and isinstance(node.iter, ast.Name)
+                      and node.iter.id in joinable):
+                    targets = [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Name) and t.id not in joinable:
+                        joinable.add(t.id)
+                        grew = True
+        has_local_join = any(
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "join"
+            and ((isinstance(node.func.value, ast.Name)
+                  and node.func.value.id in joinable)
+                 or (isinstance(node.func.value, ast.Subscript)
+                     and isinstance(node.func.value.value, ast.Name)
+                     and node.func.value.value.id in joinable))
+            for node in ast.walk(fn))
+
+        def descend(node: ast.AST, cur_stmt: ast.stmt | None) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue    # a nested def's threads get their own scan
+                st = child if isinstance(child, ast.stmt) else cur_stmt
+                ctor = _thread_ctor(child)
+                if ctor is not None and st is not None:
+                    check_ctor(ctor, st, has_local_join)
+                descend(child, st)
+
+        descend(fn, None)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan_function(node)
+    return out
+
+
 def lint_source(source: str, filename: str = "<string>") -> list[Diagnostic]:
     """Lint one module's source text; returns purity diagnostics."""
     tree = ast.parse(source, filename=filename)
     linter = _Linter(filename)
     linter.visit(tree)
-    return linter.out + _lint_import_time(tree, filename)
+    return (linter.out + _lint_import_time(tree, filename)
+            + _lint_thread_leaks(tree, filename, source))
 
 
 def lint_paths(paths) -> list[Diagnostic]:

@@ -1,0 +1,489 @@
+"""Schedule-fuzzing harness: forced thread interleavings for the serve path.
+
+The static pass (analysis/concurrency.py) proves the locking DISCIPLINE;
+it deliberately exempts the ``# lock-free:`` surfaces — the SLO health
+ring, the single-word saturation gauge, the metrics registry's snapshot
+path — whose safety argument is "a torn read is tolerated by
+construction".  That argument is dynamic, so it gets a dynamic prover:
+this module drives the real objects under **seed-deterministic forced
+interleavings** and asserts every snapshot a concurrent reader takes is
+internally consistent.
+
+How the forcing works (:class:`Interleaver`): each fuzzed thread installs
+a ``sys.settrace`` hook that fires on every LINE of code in the target
+files; the hook is a token-passing scheduler — at each line the thread
+publishes itself runnable, a seeded RNG picks which registered thread owns
+the token next, and everyone else waits.  That turns the interpreter's
+coarse, rarely-adversarial preemption into line-granular schedule control:
+a check-then-act race that a plain stress loop hits once in 10^5 runs is
+forced on the first seed that alternates the two threads (the double-
+``start()`` race fixed in this PR reproduces exactly this way —
+tests/test_concurrency.py).
+
+The harness never INTRODUCES a deadlock: a thread that waits too long for
+the token (because the token holder is blocked on a real application
+lock) times out, records a ``stall``, and proceeds — forced scheduling
+degrades toward free-running rather than hanging the suite.  Runs are
+reproducible per ``seed`` up to that stall escape hatch.
+
+``run_smoke`` is the CI surface (``python -m quest_tpu.analysis
+--concurrency --fuzz-smoke --json``): a few seeds over each canonical
+lock-free scenario — ``slo.health()`` under writer storms, the labeled
+metrics scrape parsed and checked monotone mid-increment, live
+``queue_saturation()`` during a submit storm, flight-recorder ring dumps
+racing admissions, and router route/report feedback races.  Any invariant
+violation or unexpected exception comes back as a
+``T_SCHEDULE_FUZZ_FAILURE`` ERROR diagnostic.
+"""
+
+from __future__ import annotations
+
+import random
+import sys
+import threading
+import time
+
+__all__ = ["Interleaver", "run_smoke", "fuzz_slo_health",
+           "fuzz_metrics_snapshot", "fuzz_queue_saturation",
+           "fuzz_flight_ring", "fuzz_router"]
+
+
+class _FuzzLock:
+    """Instrumented drop-in for a ``threading.Lock`` attribute of an
+    object under fuzz: a failed acquire SPINS THROUGH YIELD POINTS instead
+    of blocking the OS thread, so the scheduler keeps seeing the thread as
+    runnable and the token keeps flowing — a thread parked at a yield
+    point while holding this lock can always be scheduled to release it.
+    Install with :meth:`Interleaver.wrap_lock`."""
+
+    def __init__(self, interleaver: "Interleaver", real):
+        self._il = interleaver
+        self._real = real
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if not blocking:
+            return self._real.acquire(False)
+        while not self._real.acquire(False):
+            if not self._il._yield_point():
+                time.sleep(0.0002)   # scheduler disengaged: plain backoff
+        return True
+
+    def release(self) -> None:
+        self._real.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._real.locked()
+
+
+class Interleaver:
+    """Token-passing line-level scheduler over a set of thunks.
+
+    ``targets`` is a tuple of filename suffixes; only frames executing in
+    matching files hit yield points (everything else free-runs, so jax /
+    stdlib internals are never slowed).  ``max_switches`` bounds the
+    forced-scheduling phase; past it the run free-runs to completion.
+
+    The token only ever goes to a thread that is at a yield point (parked
+    or the caller): handing it to a thread blocked inside an uninstrumented
+    lock would stall the schedule for nothing.  A parked thread whose turn
+    never comes times out (``stall_timeout_s`` x ``max_stalls``), records a
+    ``stall`` and proceeds — the harness degrades toward free-running
+    instead of ever introducing a deadlock of its own.
+    """
+
+    def __init__(self, seed: int = 0, targets: tuple = (),
+                 max_switches: int = 4000, stall_timeout_s: float = 0.02,
+                 max_stalls: int = 3):
+        self.seed = int(seed)
+        self.targets = tuple(targets)
+        self.max_switches = int(max_switches)
+        self.stall_timeout_s = float(stall_timeout_s)
+        self.max_stalls = int(max_stalls)
+        self._rng = random.Random(self.seed)
+        self._cv = threading.Condition()
+        self._live: set = set()      # guarded-by: _cv (registered thread indices)
+        self._parked: set = set()    # guarded-by: _cv (indices waiting at a yield)
+        self._token: int | None = None   # guarded-by: _cv
+        self._index: dict = {}       # guarded-by: _cv (ident -> index)
+        # lock-free: written by run() before any worker thread exists
+        self._barrier: threading.Barrier | None = None
+        self.switches = 0            # guarded-by: _cv
+        self.stalls = 0              # guarded-by: _cv
+        # lock-free: list.append is GIL-atomic and the list is only read after join()
+        self.errors: list = []
+
+    def wrap_lock(self, real) -> _FuzzLock:
+        """Instrument one lock object (assign the result back onto the
+        fuzzed object's lock attribute)."""
+        return _FuzzLock(self, real)
+
+    # -- the scheduler core ---------------------------------------------------
+    def _yield_point(self) -> bool:
+        """One scheduling decision; returns False once the forced phase is
+        over (callers may back off on their own)."""
+        # lock-free: reads this thread's own registration, written before its thunk ran
+        me = self._index.get(threading.get_ident())
+        if me is None:
+            return False
+        with self._cv:
+            if self.switches >= self.max_switches or len(self._live) <= 1:
+                return False
+            self.switches += 1
+            pick = self._rng.choice(sorted(self._live))
+            self._token = pick
+            self._cv.notify_all()
+            if pick == me:
+                return True
+            self._parked.add(me)
+            waits = 0
+            try:
+                while (self._token != me and waits < self.max_stalls
+                       and self.switches < self.max_switches):
+                    if not self._cv.wait(self.stall_timeout_s):
+                        waits += 1
+                if self._token != me:
+                    self.stalls += 1
+            finally:
+                self._parked.discard(me)
+        return True
+
+    def _trace(self, frame, event, _arg):
+        if event != "call":
+            return None
+        fname = frame.f_code.co_filename
+        if fname.endswith(self.targets):
+            return self._local_trace
+        return None
+
+    def _local_trace(self, _frame, event, _arg):
+        if event == "line":
+            self._yield_point()
+        return self._local_trace
+
+    def _wrap(self, idx: int, thunk):
+        def go():
+            ident = threading.get_ident()
+            with self._cv:
+                self._index[ident] = idx
+                self._live.add(idx)
+            sys.settrace(self._trace)
+            try:
+                if self._barrier is not None:
+                    # every thread registers before any runs: a fast thunk
+                    # must not drain before its rivals exist
+                    try:
+                        self._barrier.wait()
+                    except threading.BrokenBarrierError:
+                        pass
+                thunk()
+            except BaseException as exc:  # noqa: BLE001 — the finding itself
+                self.errors.append(f"thread[{idx}] "
+                                   f"{type(exc).__name__}: {exc}")
+            finally:
+                sys.settrace(None)
+                with self._cv:
+                    self._live.discard(idx)
+                    self._parked.discard(idx)
+                    if self._token == idx:
+                        self._token = (self._rng.choice(sorted(self._live))
+                                       if self._live else None)
+                    self._cv.notify_all()
+        return go
+
+    def run(self, thunks, timeout_s: float = 60.0) -> dict:
+        """Run ``thunks`` concurrently under forced interleaving; returns
+        ``{"switches", "stalls", "errors", "completed"}``."""
+        self._barrier = threading.Barrier(len(thunks), timeout=10.0)
+        threads = [threading.Thread(target=self._wrap(i, t),
+                                    name=f"schedfuzz-{i}", daemon=True)
+                   for i, t in enumerate(thunks)]
+        for t in threads:
+            t.start()
+        completed = True
+        deadline = time.monotonic() + timeout_s
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+            completed &= not t.is_alive()
+        # lock-free: every worker is joined (or timed out and abandoned) above
+        switches, stalls, errors = self.switches, self.stalls, self.errors
+        return {"seed": self.seed, "switches": switches, "stalls": stalls,
+                "errors": list(errors), "completed": completed}
+
+
+def _target(module_suffix: str) -> str:
+    import os
+    return module_suffix.replace("/", os.sep)
+
+
+# ---------------------------------------------------------------------------
+# canonical scenarios: the annotated lock-free surfaces, stress-proven
+# ---------------------------------------------------------------------------
+
+def fuzz_slo_health(seed: int = 0, iters: int = 80) -> dict:
+    """``slo.health()`` — the router's per-decision lock-free snapshot —
+    under two observe/observe_queue writer storms: every snapshot must be
+    internally consistent (non-negative windowed counts, saturation within
+    [0, 1], p99 one of the ring's bucket edges)."""
+    from ..obs.slo import _HEALTH_LAT_BUCKETS, SLOMonitor
+    il = Interleaver(seed, targets=(_target("obs/slo.py"),))
+    mon = SLOMonitor()
+    mon._lock = il.wrap_lock(mon._lock)
+    violations: list = []
+    edges = set(_HEALTH_LAT_BUCKETS) | {0.0}
+
+    def writer(base: int):
+        def go():
+            for i in range(iters):
+                mon.observe(f"class{(base + i) % 3}", 0.0009 * (i % 7),
+                            deadline_ok=(i % 5 != 0))
+                mon.observe_queue(i % 17, 16)
+        return go
+
+    def reader():
+        for _ in range(iters):
+            h = mon.health()
+            if not 0.0 <= h["saturation"] <= 1.0:
+                violations.append(f"saturation {h['saturation']} out of "
+                                  "[0, 1]")
+            if h["burn_rate"] < 0:
+                violations.append(f"negative burn rate {h['burn_rate']}")
+            if min(h["window_hits"], h["window_misses"],
+                   h["window_samples"]) < 0:
+                violations.append(f"negative window count in {h}")
+            if h["window_hits"] + h["window_misses"] > h["window_samples"]:
+                violations.append(
+                    f"deadline'd {h['window_hits'] + h['window_misses']} "
+                    f"exceeds window samples {h['window_samples']}")
+            if h["p99_s"] not in edges:
+                violations.append(f"p99 {h['p99_s']} is not a bucket edge")
+
+    res = il.run([writer(0), writer(1), reader])
+    res.update({"scenario": "slo_health", "violations": violations})
+    return res
+
+
+def fuzz_metrics_snapshot(seed: int = 0, iters: int = 40) -> dict:
+    """The labeled metrics registry scraped mid-increment: every
+    ``to_prometheus`` text must parse (cumulative histogram buckets
+    included) and every counter sample must be monotone non-decreasing
+    across successive scrapes."""
+    from ..serve.metrics import Metrics, parse_prometheus
+    il = Interleaver(seed, targets=(_target("serve/metrics.py"),))
+    m = Metrics()
+    m._lock = il.wrap_lock(m._lock)
+    views = [m.labeled(replica=str(i)) for i in range(2)]
+    # pre-seed one sample: an EMPTY registry legitimately fails
+    # parse_prometheus ("no metric samples found"), and the scenario is
+    # about mid-increment consistency, not the empty-scrape contract
+    m.inc("fuzz_seed_total")
+    violations: list = []
+
+    def writer(i: int):
+        def go():
+            v = views[i]
+            for k in range(iters):
+                v.inc("routed_total")
+                v.inc("shed_total", labels={"reason": "burn"})
+                v.set_gauge("queue_depth", k)
+                v.observe("request_latency_seconds", 0.001 * k)
+        return go
+
+    def reader():
+        last: dict = {}
+        for _ in range(iters):
+            try:
+                parsed = parse_prometheus(m.to_prometheus())
+            except ValueError as exc:
+                violations.append(f"scrape failed to parse: {exc}")
+                continue
+            for name, samples in parsed.items():
+                if not name.endswith("_total"):
+                    continue
+                for labels, value in samples.items():
+                    key = (name, labels)
+                    if value < last.get(key, 0.0):
+                        violations.append(
+                            f"counter {name}{{{labels}}} went backwards: "
+                            f"{last[key]} -> {value}")
+                    last[key] = value
+
+    res = il.run([writer(0), writer(1), reader])
+    res.update({"scenario": "metrics_snapshot", "violations": violations})
+    return res
+
+
+def fuzz_queue_saturation(seed: int = 0, iters: int = 30) -> dict:
+    """Live ``queue_saturation()`` reads racing a submit storm against a
+    deliberately stopped worker (the queue fills and bounces): the reading
+    must stay within [0, 1] and the bounce path must raise only
+    ``E_QUEUE_FULL``."""
+    from ..circuit import Circuit
+    from ..serve.service import QuESTService
+    from ..validation import ErrorCode, QuESTError
+    svc = QuESTService(start=False, max_queue=8, max_batch=4)
+    c = Circuit(2)
+    c.h(0).cnot(0, 1)
+    violations: list = []
+
+    def writer():
+        for _ in range(iters):
+            try:
+                svc.submit(c)
+            except QuESTError as exc:
+                if exc.code != ErrorCode.QUEUE_FULL:
+                    violations.append(f"submit raised {exc.code}")
+
+    def reader():
+        for _ in range(iters):
+            s = svc.queue_saturation()
+            if not 0.0 <= s <= 1.0:
+                violations.append(f"queue_saturation {s} out of [0, 1]")
+
+    res = Interleaver(seed, targets=(_target("serve/service.py"),)).run(
+        [writer, writer, reader])
+    try:
+        svc.shutdown(drain=False)
+    except Exception as exc:        # noqa: BLE001 — part of the verdict
+        violations.append(f"shutdown after storm raised {exc!r}")
+    res.update({"scenario": "queue_saturation", "violations": violations})
+    return res
+
+
+def fuzz_flight_ring(seed: int = 0, iters: int = 60) -> dict:
+    """Flight-recorder ring dumps racing admission appends and resolves:
+    a dump is a bounded, well-formed snapshot (depth <= capacity, every
+    record dict carrying its terminal fields) no matter where the writers
+    are mid-append."""
+    from ..obs.flight import FlightRecorder
+    il = Interleaver(seed, targets=(_target("obs/flight.py"),))
+    rec = FlightRecorder(capacity=16)
+    rec._lock = il.wrap_lock(rec._lock)
+    violations: list = []
+
+    def writer(base: int):
+        def go():
+            for i in range(iters):
+                rid = base * iters + i
+                rec.admit(rid, f"class{i % 3}", i % 16)
+                rec.resolve(rid, "ok", batch_id=i, wait_s=0.0)
+        return go
+
+    def reader():
+        for i in range(iters):
+            doc = rec.dump(f"fuzz-{i}")
+            if len(doc["records"]) > rec.capacity:
+                violations.append(
+                    f"dump holds {len(doc['records'])} records, capacity "
+                    f"{rec.capacity}")
+            for r in doc["records"]:
+                if "outcome" not in r or "request_id" not in r:
+                    violations.append(f"malformed dump record {r}")
+            snap = rec.snapshot()
+            if snap["depth"] > rec.capacity:
+                violations.append(f"ring depth {snap['depth']} exceeds "
+                                  f"capacity {rec.capacity}")
+
+    res = il.run([writer(0), writer(1), reader])
+    res.update({"scenario": "flight_ring", "violations": violations})
+    return res
+
+
+class _FakeService:
+    def __init__(self):
+        self.saturation = 0.0
+
+    def queue_saturation(self):
+        return self.saturation
+
+
+class _FakeReplica:
+    def __init__(self, index: int):
+        self.index = index
+        self.service = _FakeService()
+
+    def health(self):
+        return {"burn_rate": 0.0}
+
+
+def fuzz_router(seed: int = 0, iters: int = 40) -> dict:
+    """Router ``route()`` decisions racing ``report()`` cache-outcome
+    feedback (the eviction/re-placement path): every decision must name a
+    real replica and every snapshot must be internally consistent
+    (placements within the replica set)."""
+    from ..circuit import Circuit, qft_circuit
+    from ..deploy.router import Router
+    il = Interleaver(seed, targets=(_target("deploy/router.py"),))
+    replicas = [_FakeReplica(i) for i in range(3)]
+    router = Router(replicas)
+    router._lock = il.wrap_lock(router._lock)
+    c1 = qft_circuit(3)
+    c2 = Circuit(3)
+    c2.h(0).cnot(0, 1)
+    keys = [router.class_key(c1), router.class_key(c2)]
+    indices = {r.index for r in replicas}
+    violations: list = []
+
+    def decider():
+        for i in range(iters):
+            replica, decision = router.route(c1 if i % 2 else c2)
+            if replica.index not in indices:
+                violations.append(f"routed to unknown replica "
+                                  f"{replica.index}")
+            if decision["replica"] != replica.index:
+                violations.append("decision record disagrees with the "
+                                  "returned replica")
+
+    def feeder():
+        for i in range(iters):
+            ck = keys[i % 2]
+            router.report(ck, i % 3, "hit" if i % 3 else "miss")
+
+    def checker():
+        for _ in range(iters):
+            snap = router.snapshot()
+            for ck, idx in snap["placements"].items():
+                if idx not in indices:
+                    violations.append(
+                        f"placement {ck} -> {idx} names no replica")
+
+    res = il.run([decider, feeder, checker])
+    res.update({"scenario": "router", "violations": violations})
+    return res
+
+
+_SCENARIOS = (fuzz_slo_health, fuzz_metrics_snapshot, fuzz_queue_saturation,
+              fuzz_flight_ring, fuzz_router)
+
+
+def run_smoke(seeds=(0, 1), iters: int | None = None) -> dict:
+    """The CI smoke: every scenario under every seed.  Returns one
+    machine-readable document; ``violations`` aggregates invariant
+    failures AND unexpected thread exceptions (each becomes a
+    ``T_SCHEDULE_FUZZ_FAILURE`` diagnostic in the CLI)."""
+    rows: list = []
+    violations: list = []
+    for fn in _SCENARIOS:
+        for seed in seeds:
+            kw = {} if iters is None else {"iters": iters}
+            row = fn(seed=seed, **kw)
+            rows.append({k: row[k] for k in ("scenario", "seed", "switches",
+                                             "stalls", "completed")}
+                        | {"violations": len(row["violations"]),
+                           "errors": len(row["errors"])})
+            violations += [f"{row['scenario']}[seed={seed}]: {v}"
+                           for v in row["violations"]]
+            violations += [f"{row['scenario']}[seed={seed}]: {e}"
+                           for e in row["errors"]]
+            if not row["completed"]:
+                violations.append(f"{row['scenario']}[seed={seed}]: "
+                                  "did not complete (possible deadlock)")
+    return {"scenarios": rows, "violations": violations,
+            "seeds": [int(s) for s in seeds]}

@@ -150,7 +150,7 @@ class ExecutableStore:
         self.root = str(root)
         self.readonly = bool(readonly)
         self._lock = threading.Lock()
-        self.stats = {"saves": 0, "save_skipped": 0, "hits": 0,
+        self.stats = {"saves": 0, "save_skipped": 0, "hits": 0,  # guarded-by: _lock
                       "stale": 0, "absent": 0, "errors": 0}
         if not readonly:
             os.makedirs(self.root, exist_ok=True)

@@ -168,6 +168,11 @@ class ReplicaPool:
         ]
         self.router = Router(self.replicas, config=router_config,
                              metrics=self.metrics.labeled())
+        self._lock = threading.Lock()
+        self._shutdown = False      # guarded-by: _lock
+        # set once the first shutdown()'s fan-out has joined; concurrent
+        # later callers wait on it instead of returning mid-teardown
+        self._shutdown_done = threading.Event()
 
     # -- serving ------------------------------------------------------------
     def submit(self, circuit, params=None, shots: int = 0,
@@ -191,15 +196,29 @@ class ReplicaPool:
 
     def shutdown(self, drain: bool = True,
                  timeout: float | None = None) -> None:
-        # parallel shutdown: one slow replica must not serialize the rest
-        threads = [threading.Thread(target=r.shutdown,
-                                    kwargs={"drain": drain,
-                                            "timeout": timeout})
-                   for r in self.replicas]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+        """Fan-out shutdown of every replica.  Idempotent like
+        ``QuESTService.shutdown``: a second call (operator retry, context-
+        manager exit after an explicit call) is a no-op, not an error —
+        and a CONCURRENT second call waits for the first fan-out to join,
+        so returning always means every replica is stopped."""
+        with self._lock:
+            first = not self._shutdown
+            self._shutdown = True
+        if not first:
+            self._shutdown_done.wait(timeout=timeout)
+            return
+        try:
+            # parallel shutdown: one slow replica must not serialize the rest
+            threads = [threading.Thread(target=r.shutdown,
+                                        kwargs={"drain": drain,
+                                                "timeout": timeout})
+                       for r in self.replicas]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            self._shutdown_done.set()
 
     def __enter__(self) -> "ReplicaPool":
         return self

@@ -76,9 +76,9 @@ class Router:
         self.config = config if config is not None else RouterConfig()
         self.metrics = metrics
         self._lock = threading.Lock()
-        self._placement: dict = {}          # class_key -> replica index
-        self._confirmed: set = set()        # (class_key, index): seen a hit
-        self._cooldown: dict = {}           # (class_key, index) -> t_until
+        self._placement: dict = {}   # guarded-by: _lock (class_key -> replica index)
+        self._confirmed: set = set()  # guarded-by: _lock ((class_key, index): seen a hit)
+        self._cooldown: dict = {}    # guarded-by: _lock ((class_key, index) -> t_until)
 
     # -- affinity -----------------------------------------------------------
     def class_key(self, circuit) -> str:

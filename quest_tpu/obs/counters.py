@@ -39,12 +39,12 @@ class RuntimeCounters:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self.compiles_total = 0
-        self.compile_seconds_total = 0.0
-        self.dispatches_total = 0
-        self.dispatch_seconds_total = 0.0
-        self.hbm_peak_bytes = 0
-        self.hbm_bytes_in_use = 0
+        self.compiles_total = 0             # guarded-by: _lock
+        self.compile_seconds_total = 0.0    # guarded-by: _lock
+        self.dispatches_total = 0           # guarded-by: _lock
+        self.dispatch_seconds_total = 0.0   # guarded-by: _lock
+        self.hbm_peak_bytes = 0             # guarded-by: _lock
+        self.hbm_bytes_in_use = 0           # guarded-by: _lock
 
     def record_compile(self, seconds: float) -> None:
         with self._lock:

@@ -54,9 +54,10 @@ class FlightRecorder:
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
         self.capacity = int(capacity)
         self._lock = threading.Lock()
-        self._ring: deque = deque(maxlen=self.capacity)
-        self._by_rid: dict = {}
-        self.last_dump: dict | None = None
+        self._ring: deque = deque(maxlen=self.capacity)  # guarded-by: _lock
+        self._by_rid: dict = {}                          # guarded-by: _lock
+        self.last_dump: dict | None = None               # guarded-by: _lock
+        # lock-free: monotone int gauge; scrape readers tolerate off-by-one
         self.dumps = 0
 
     # -- recording ----------------------------------------------------------

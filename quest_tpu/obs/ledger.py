@@ -109,8 +109,8 @@ class Ledger:
         self.wall_band = (float(wall_band[0]), float(wall_band[1]))
         self.collectives_per_event = int(collectives_per_event)
         self._lock = threading.Lock()
-        self._records: list[DriftRecord] = []
-        self.drift_total = 0
+        self._records: list[DriftRecord] = []   # guarded-by: _lock
+        self.drift_total = 0                    # guarded-by: _lock
 
     def record(self, label: str, *, engine: str = "xla",
                num_devices: int = 1, platform: str = "cpu",

@@ -105,19 +105,23 @@ class SLOMonitor:
         self.config = config if config is not None else SLOConfig()
         self._lock = threading.Lock()
         # (t_mono, class_key, latency_s, deadline_ok: bool | None)
-        self._samples: list = []
+        self._samples: list = []        # guarded-by: _lock
         # (t_mono, depth / capacity)
-        self._saturation: list = []
-        self.deadline_misses_total = 0
-        self.deadline_hits_total = 0
+        self._saturation: list = []     # guarded-by: _lock
+        self.deadline_misses_total = 0  # guarded-by: _lock
+        self.deadline_hits_total = 0    # guarded-by: _lock
+        self._h_width = max(self.config.window_s / _HEALTH_SLOTS, 1e-6)
         # the health ring (see health()): _HEALTH_SLOTS time buckets, each
         # [stamp, deadline_hits, deadline_misses, latency_bucket_counts].
         # Written under the lock (writers already hold it); READ without
         # any lock — slots are replaced wholesale when their stamp rolls
-        # over and int increments are atomic under the GIL, so a reader
-        # sees at worst a slightly-torn but individually-valid view.
-        self._h_width = max(self.config.window_s / _HEALTH_SLOTS, 1e-6)
+        # over, int increments are atomic under the GIL, and observe()
+        # commits bucket counts before deadline counters so every torn
+        # view stays internally consistent (the schedule fuzzer's
+        # slo_health scenario stress-proves exactly this).
+        # lock-free: torn-read-tolerant ring by store-order construction; proven by analysis/schedfuzz.py
         self._h_ring: list = [None] * _HEALTH_SLOTS
+        # lock-free: single float store; the router's per-decision read needs no ordering
         self._sat_live = 0.0
 
     # -- recording ----------------------------------------------------------
@@ -138,10 +142,12 @@ class SLOMonitor:
             if len(self._samples) > _MAX_SAMPLES:
                 del self._samples[:_MAX_SAMPLES // 2]
             b = self._health_bucket(t)
-            if deadline_ok is True:
-                b[1] += 1
-            elif deadline_ok is False:
-                b[2] += 1
+            # the latency count commits BEFORE the deadline counters: a
+            # lock-free health() reader walks hits/misses first and the
+            # bucket counts after, so this store order is what keeps every
+            # torn view satisfying deadlined <= window_samples (the
+            # schedule fuzzer reproduced the inverted-order tear;
+            # tests/test_concurrency.py pins it)
             lat = float(latency_s)
             counts = b[3]
             for i, edge in enumerate(_HEALTH_LAT_BUCKETS):
@@ -150,6 +156,10 @@ class SLOMonitor:
                     break
             else:
                 counts[-1] += 1
+            if deadline_ok is True:
+                b[1] += 1
+            elif deadline_ok is False:
+                b[2] += 1
 
     def observe_queue(self, depth: int, capacity: int,
                       now: float | None = None) -> None:
@@ -163,6 +173,7 @@ class SLOMonitor:
             if len(self._saturation) > _MAX_SAMPLES:
                 del self._saturation[:_MAX_SAMPLES // 2]
 
+    # requires-lock: _lock
     def _health_bucket(self, t: float) -> list:
         """The ring slot for instant ``t`` (caller holds the lock): reused
         in place while its time stamp is current, replaced wholesale when
@@ -252,6 +263,10 @@ class SLOMonitor:
         with self._lock:
             samples = list(self._samples)
             saturation = list(self._saturation)
+            # totals copied under the same lock as the samples they
+            # summarise: a snapshot must be one consistent cut
+            hits_total = self.deadline_hits_total
+            misses_total = self.deadline_misses_total
         classes: dict = {}
         for ts, ck, lat, _ok in samples:
             if t - ts <= cfg.window_s:
@@ -297,8 +312,8 @@ class SLOMonitor:
                 "long_hit_rate": rate_l,
                 "burn_rate": burn_s,
                 "long_burn_rate": burn_l,
-                "hits_total": self.deadline_hits_total,
-                "misses_total": self.deadline_misses_total,
+                "hits_total": hits_total,
+                "misses_total": misses_total,
             },
             "queue": {"saturation": sat_now, "peak_saturation": sat_peak},
             "warnings": warnings,

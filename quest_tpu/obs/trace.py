@@ -132,15 +132,16 @@ class TraceRecorder:
     def __init__(self, max_spans: int = DEFAULT_MAX_SPANS,
                  enabled: bool = False):
         self.max_spans = int(max_spans)
+        # lock-free: bool flip read unlocked on the disabled-tracing hot path (< 1% overhead contract)
         self.enabled = bool(enabled)
         self._lock = threading.Lock()
-        self._spans: list[Span] = []
-        self._referenced: set = set()   # parent ids of recorded spans
-        self._present: set = set()      # ids of recorded spans
-        self._dropped = 0
-        self._ids = 0
-        self.t0_perf = time.perf_counter()
-        self.t0_epoch = time.time()
+        self._spans: list[Span] = []    # guarded-by: _lock
+        self._referenced: set = set()   # guarded-by: _lock (parent ids of recorded spans)
+        self._present: set = set()      # guarded-by: _lock (ids of recorded spans)
+        self._dropped = 0               # guarded-by: _lock
+        self._ids = 0                   # guarded-by: _lock
+        self.t0_perf = time.perf_counter()  # guarded-by: _lock
+        self.t0_epoch = time.time()         # guarded-by: _lock
 
     # -- recording ----------------------------------------------------------
     def span(self, name: str, **attrs):
@@ -163,14 +164,16 @@ class TraceRecorder:
         parent_id."""
         if not self.enabled:
             return None
+        validate_parent = parent_id is not None
         if parent_id is None:
             parent_id = _PARENT.get()
-        elif parent_id not in self._present:
-            parent_id = None
         sp = Span(name, self._next_id(), parent_id,
                   request_id if request_id is not None else _REQUEST.get(),
                   t0, dur, threading.current_thread().name, attrs)
-        self._append(sp)
+        # the explicit-parent presence check happens inside _append, under
+        # the same lock as the _present set it consults (the old unlocked
+        # membership probe was the concurrency auditor's first real find)
+        self._append(sp, validate_parent=validate_parent)
         return sp.span_id
 
     def _next_id(self) -> int:
@@ -178,13 +181,16 @@ class TraceRecorder:
             self._ids += 1
             return self._ids
 
-    def _append(self, sp: Span) -> None:
+    def _append(self, sp: Span, validate_parent: bool = False) -> None:
         # Spans append on EXIT, children before parents — so a full buffer
         # must still admit a span some recorded child already references as
         # parent, or the export would carry a dangling parent_id (the
         # orphan the validator hard-fails on).  The overshoot is bounded by
         # open-span nesting depth x threads, not by traffic.
         with self._lock:
+            if (validate_parent and sp.parent_id is not None
+                    and sp.parent_id not in self._present):
+                sp.parent_id = None     # dropped parent: record as a root
             if (len(self._spans) >= self.max_spans
                     and sp.span_id not in self._referenced):
                 self._dropped += 1

@@ -197,13 +197,14 @@ class CompileCache:
     def __init__(self, max_bytes: int | None = None):
         self.max_bytes = DEFAULT_MAX_BYTES if max_bytes is None else int(max_bytes)
         self._lock = threading.RLock()
-        self._entries: "OrderedDict[tuple, CacheEntry]" = OrderedDict()
-        self.stats = dict(_FRESH_STATS)
-        self.compile_times: list[float] = []
+        self._entries: "OrderedDict[tuple, CacheEntry]" = OrderedDict()  # guarded-by: _lock
+        self.stats = dict(_FRESH_STATS)         # guarded-by: _lock
+        self.compile_times: list[float] = []    # guarded-by: _lock
         # optional persistent executable store (deploy/persist.py): when
         # attached, _get_program consults it before compiling (a store hit
         # installs a deserialized executable and touches NO compile
         # counter) and writes every fresh compile through to it
+        # lock-free: set once by attach_store at provisioning time, before traffic; readers tolerate either epoch
         self._store = None
 
     def attach_store(self, store) -> "CompileCache":
@@ -583,6 +584,7 @@ class CompileCache:
         return params
 
     # -- bookkeeping --------------------------------------------------------
+    # requires-lock: _lock
     def _evict_locked(self) -> None:
         """Drop least-recently-used classes until the byte budget holds.
         The most recent entry always survives (a budget smaller than one

@@ -118,9 +118,9 @@ class Metrics:
         self.prefix = prefix
         self._lock = threading.Lock()
         # family name -> {canonical label key -> value}
-        self._counters: dict[str, dict[tuple, float]] = {}
-        self._gauges: dict[str, dict[tuple, float]] = {}
-        self._hists: dict[str, _Histogram] = {}
+        self._counters: dict[str, dict[tuple, float]] = {}  # guarded-by: _lock
+        self._gauges: dict[str, dict[tuple, float]] = {}    # guarded-by: _lock
+        self._hists: dict[str, _Histogram] = {}             # guarded-by: _lock
 
     # -- recording ----------------------------------------------------------
     def inc(self, name: str, value: float = 1.0,

@@ -128,8 +128,6 @@ class QuESTService:
         # deadline hit rate and burn-rate early warning — always on, like
         # the metrics registry (one deque append per completed request)
         self.slo = slo if isinstance(slo, SLOMonitor) else SLOMonitor(slo)
-        self._batch_seq = 0
-        self._reject_seq = 0
         self._sharding = None
         if num_devices is not None and num_devices > 1:
             from ..parallel.mesh import amp_sharding, make_amps_mesh
@@ -141,23 +139,40 @@ class QuESTService:
                                  f"{num_devices} requested.)", "QuESTService")
             self._sharding = amp_sharding(make_amps_mesh(devices[:num_devices]))
         self._cond = threading.Condition()
-        self._queue: list[_Request] = []
-        self._inflight = 0
-        self._next_rid = 0
-        self._accepting = True
-        self._stop = False
-        self._draining = False
+        self._queue: list[_Request] = []    # guarded-by: _cond
+        self._inflight = 0                  # guarded-by: _cond
+        self._next_rid = 0                  # guarded-by: _cond
+        self._accepting = True              # guarded-by: _cond
+        self._stop = False                  # guarded-by: _cond
+        self._draining = False              # guarded-by: _cond
+        self._batch_seq = 0                 # guarded-by: _cond
+        self._reject_seq = 0                # guarded-by: _cond
+        # daemon-ok: joined in shutdown(); daemonized so an abandoned
+        # service (no shutdown call) never blocks interpreter exit
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name="quest-serve-worker")
-        self._started = False
+        self._started = False               # guarded-by: _cond
+        self._shutdown = False              # guarded-by: _cond
+        # set once when the FIRST shutdown() finishes tearing down; later
+        # callers wait on it so "shutdown returned" always means "stopped"
+        self._shutdown_done = threading.Event()
         if start:
             self.start()
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "QuESTService":
-        if not self._started:
-            self._started = True
-            self._worker.start()
+        # check-then-act AND the Thread.start both happen under the
+        # condition: two concurrent start() calls used to double-start the
+        # worker (RuntimeError; the schedule fuzzer reproduces the
+        # interleaving, tests/test_concurrency.py), and starting outside
+        # the lock would let a concurrent shutdown() observe _started and
+        # join a thread that has not booted yet.  Thread.start only waits
+        # for the interpreter's bootstrap, not for _run to take the
+        # condition, so holding it here cannot deadlock.
+        with self._cond:
+            if not self._started:
+                self._started = True
+                self._worker.start()
         return self
 
     def drain(self, timeout: float | None = None) -> bool:
@@ -180,20 +195,36 @@ class QuESTService:
 
     def shutdown(self, drain: bool = True, timeout: float | None = None) -> None:
         """Stop accepting requests; with ``drain`` (default) finish
-        everything queued first, otherwise fail pending requests."""
+        everything queued first, otherwise fail pending requests with
+        ``E_SERVICE_SHUTDOWN``.  Idempotent: a second call is a no-op,
+        not an error (the pool's parallel shutdown fan-out and operator
+        retries both depend on it) — a CONCURRENT second call waits for
+        the first teardown to finish, so returning always means the
+        service is stopped."""
         with self._cond:
-            self._accepting = False
-        if drain and self._started:
-            self.drain(timeout=timeout)
-        with self._cond:
-            dropped, self._queue = self._queue, []
-            self._stop = True
-            self._cond.notify_all()
-        for req in dropped:
-            self._fail(req, RuntimeError(
-                "QuESTService shut down before execution"))
-        if self._started:
-            self._worker.join(timeout=timeout)
+            first = not self._shutdown
+            self._shutdown = True
+            if first:
+                self._accepting = False
+                started = self._started
+        if not first:
+            self._shutdown_done.wait(timeout=timeout)
+            return
+        try:
+            if drain and started:
+                self.drain(timeout=timeout)
+            with self._cond:
+                dropped, self._queue = self._queue, []
+                self._stop = True
+                self._cond.notify_all()
+            for req in dropped:
+                self._fail(req, QuESTError(
+                    ErrorCode.SERVICE_SHUTDOWN,
+                    MESSAGES[ErrorCode.SERVICE_SHUTDOWN], "shutdown"))
+            if started:
+                self._worker.join(timeout=timeout)
+        finally:
+            self._shutdown_done.set()
 
     def __enter__(self) -> "QuESTService":
         return self.start()
@@ -248,7 +279,9 @@ class QuESTService:
         fut: Future = Future()
         with self._cond:
             if not self._accepting or self._stop:
-                raise RuntimeError("QuESTService is shut down")
+                raise QuESTError(ErrorCode.SERVICE_SHUTDOWN,
+                                 MESSAGES[ErrorCode.SERVICE_SHUTDOWN],
+                                 "submit")
             if len(self._queue) >= self.max_queue:
                 self.metrics.inc("queue_rejected_total")
                 depth = len(self._queue)
@@ -500,6 +533,7 @@ class QuESTService:
         sampled at admissions, so a replica that traffic has already been
         routed AWAY from would report its last (high) sample forever; a
         router must read the live value to ever un-shed it."""
+        # lock-free: atomic len() of an always-valid list (a torn read is off by at most one request)
         return len(self._queue) / self.max_queue
 
     def metrics_dict(self) -> dict:

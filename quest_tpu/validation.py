@@ -104,6 +104,7 @@ class ErrorCode:
     # QuESTService (docs/SERVING.md)
     QUEUE_FULL = "E_QUEUE_FULL"
     DEADLINE_EXCEEDED = "E_DEADLINE_EXCEEDED"
+    SERVICE_SHUTDOWN = "E_SERVICE_SHUTDOWN"
 
 
 # Human-readable messages; tests substring-match these, mirroring the
@@ -180,6 +181,7 @@ MESSAGES = {
     ErrorCode.INVALID_SCHEDULE_OPTION: "Unknown scheduler option. Circuit.schedule accepts only chip, precision, placement, reorder, overlap and pipeline_chunks.",
     ErrorCode.QUEUE_FULL: "The serving queue holds max_queue pending requests; this request was rejected for backpressure. Retry after the queue drains, raise max_queue, or add capacity.",
     ErrorCode.DEADLINE_EXCEEDED: "The request's deadline expired before a batch slot was available; it was completed exceptionally without executing.",
+    ErrorCode.SERVICE_SHUTDOWN: "The service is shut down (or shutting down): this request was not executed. Submit to a live replica, or restart the service.",
     ErrorCode.PLANE_ONLY: "This register uses plane-pair storage (the single-chip memory ceiling); the requested operation needs the stacked amplitude array, which cannot be materialised at this size. Supported in plane mode: init*, single-qubit gates, applyFullQFT, measure/collapse, probabilities, amplitude reads.",
 }
 

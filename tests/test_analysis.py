@@ -492,6 +492,49 @@ def test_cli_json_carries_severities(tmp_path, capsys):
     assert doc["diagnostics"][0]["severity"] == "ERROR"
 
 
+def test_cli_concurrency_json_roundtrip(capsys):
+    """``--concurrency`` honors the repo-wide ONE-JSON-document contract
+    and the shared severity schema: the document parses, carries the
+    ``concurrency`` section (classes, lock graph, fuzz placeholder), its
+    findings land in the same ``diagnostics``/``summary`` sections every
+    other mode uses, and the counts are internally consistent — the CI
+    gate PARSES this, it does not grep."""
+    import json
+
+    from quest_tpu.analysis.__main__ import main
+    assert main(["--concurrency", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    # one document, all standard sections present
+    for key in ("circuits", "schedule", "verify", "serve_audit",
+                "trace_report", "concurrency", "diagnostics", "summary"):
+        assert key in doc, sorted(doc)
+    c = doc["concurrency"]
+    assert c["files"] > 0
+    assert {"name", "file", "line", "locks", "attrs", "findings"} <= set(
+        c["classes"][0])
+    assert set(c["lock_graph"]) == {"edges", "cycles"}
+    assert c["fuzz"] is None            # smoke not requested
+    # severity schema identical to every other mode
+    assert doc["summary"]["counts"]["ERROR"] == 0
+    assert set(doc["summary"]["counts"]) == {"HINT", "WARNING", "ERROR"}
+    assert doc["summary"]["diagnostics"] == len(doc["diagnostics"])
+    # a tree with a seeded violation exits 1 through the same document
+    import quest_tpu.deploy.router as router_mod
+    from quest_tpu.analysis import concurrency as cc
+    with open(router_mod.__file__, encoding="utf-8") as fh:
+        mutated = cc.strip_first_lock_scope(fh.read())
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        bad = f"{td}/router_mutated.py"
+        with open(bad, "w", encoding="utf-8") as fh:
+            fh.write(mutated)
+        assert main(["--concurrency-paths", bad, "--json"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+    assert doc["summary"]["counts"]["ERROR"] >= 1
+    assert any(d["code"] == AnalysisCode.UNGUARDED_SHARED_WRITE
+               and d["severity"] == "ERROR" for d in doc["diagnostics"])
+
+
 def test_cli_verify_schedule_mode(capsys):
     """--verify-schedule runs the translation validator + lowered audit and
     reports a proven-equivalent rewrite for the shipped scheduler."""

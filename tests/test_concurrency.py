@@ -1,0 +1,721 @@
+"""Concurrency auditor (analysis/concurrency.py) + schedule fuzzer
+(analysis/schedfuzz.py): every T_* rule gets one known-bad source
+(asserting the stable code) plus clean no-false-positive twins, the repo
+self-audit must be clean, the adversarial mutation harness (a removed
+``with self._lock:``) must be caught, and the two fuzzer-reproduced races
+fixed in this PR — the double-``start()`` check-then-act and the SLO
+health-ring store-order tear — are pinned with forced-interleaving
+regression tests.  The ReplicaPool shutdown-under-load stress and the
+shutdown idempotency contracts live here too.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from quest_tpu import qft_circuit
+from quest_tpu.analysis import concurrency as cc
+from quest_tpu.analysis import schedfuzz as sf
+from quest_tpu.analysis.diagnostics import AnalysisCode, Severity
+from quest_tpu.circuit import Circuit
+from quest_tpu.validation import ErrorCode, QuESTError
+
+
+def codes(diags):
+    return [d.code for d in diags]
+
+
+def audit(src):
+    return cc.audit_source(src, "fixture.py")
+
+
+# ---------------------------------------------------------------------------
+# static rules: one bad source per code, clean twins
+# ---------------------------------------------------------------------------
+
+_GUARDED_CLEAN = """
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []        # guarded-by: _lock
+
+    def put(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._items)
+"""
+
+
+def test_guarded_class_is_clean():
+    assert audit(_GUARDED_CLEAN) == []
+
+
+def test_unguarded_write_flagged():
+    src = _GUARDED_CLEAN.replace(
+        "    def put(self, x):\n        with self._lock:\n"
+        "            self._items.append(x)\n",
+        "    def put(self, x):\n        self._items.append(x)\n")
+    found = audit(src)
+    assert AnalysisCode.UNGUARDED_SHARED_WRITE in codes(found)
+    assert all(d.severity == Severity.ERROR for d in found
+               if d.code == AnalysisCode.UNGUARDED_SHARED_WRITE)
+
+
+def test_unguarded_read_is_warning():
+    src = _GUARDED_CLEAN.replace(
+        "    def snapshot(self):\n        with self._lock:\n"
+        "            return list(self._items)\n",
+        "    def snapshot(self):\n        return list(self._items)\n")
+    found = audit(src)
+    assert codes(found) == [AnalysisCode.UNGUARDED_SHARED_READ]
+    assert found[0].severity == Severity.WARNING
+
+
+def test_site_level_lock_free_waiver():
+    src = _GUARDED_CLEAN.replace(
+        "    def snapshot(self):\n        with self._lock:\n"
+        "            return list(self._items)\n",
+        "    def snapshot(self):\n"
+        "        # lock-free: approximate depth probe for the scrape\n"
+        "        return len(self._items)\n")
+    assert audit(src) == []
+
+
+def test_attr_level_lock_free_needs_reason():
+    src = """
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.gauge = 0.0    # lock-free: single float store, readers tolerate staleness
+        self.bad = 0.0      # lock-free:
+
+    def bump(self):
+        self.gauge = 1.0
+        self.bad = 1.0
+"""
+    found = audit(src)
+    assert codes(found) == [AnalysisCode.LOCK_FREE_NO_REASON]
+
+
+def test_inconsistent_guard_under_wrong_lock():
+    src = """
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._aux = threading.Lock()
+        self._items = []    # guarded-by: _lock
+
+    def put(self, x):
+        with self._aux:
+            self._items.append(x)
+"""
+    assert AnalysisCode.INCONSISTENT_GUARD in codes(audit(src))
+
+
+def test_inferred_disjoint_guards_flagged():
+    src = """
+import threading
+
+class Box:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._items = []
+
+    def put(self, x):
+        with self._a:
+            self._items.append(x)
+
+    def drop(self):
+        with self._b:
+            self._items.clear()
+"""
+    found = audit(src)
+    assert AnalysisCode.INCONSISTENT_GUARD in codes(found)
+    # and the annotation nudge rides along
+    assert AnalysisCode.UNANNOTATED_SHARED_ATTR in codes(found)
+
+
+def test_lock_order_cycle_across_classes():
+    src = """
+import threading
+
+class A:
+    def __init__(self, b):
+        self._lock = threading.Lock()
+        self.b = b if b is not None else B(None)
+
+    def poke(self):
+        with self._lock:
+            self.b.poke()
+
+class B:
+    def __init__(self, a):
+        self._lock = threading.Lock()
+        self.a = a if a is not None else A(None)
+
+    def poke(self):
+        with self._lock:
+            self.a.poke()
+"""
+    found = [d for d in audit(src)
+             if d.code == AnalysisCode.LOCK_ORDER_CYCLE]
+    assert len(found) == 1
+    assert "A._lock" in found[0].message and "B._lock" in found[0].message
+
+
+def test_self_deadlock_on_plain_lock():
+    src = """
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []    # guarded-by: _lock
+
+    def outer(self):
+        with self._lock:
+            with self._lock:
+                self._items.append(1)
+"""
+    assert AnalysisCode.LOCK_ORDER_CYCLE in codes(audit(src))
+    # the same nesting on an RLock is reentrant: clean
+    assert AnalysisCode.LOCK_ORDER_CYCLE not in codes(
+        audit(src.replace("threading.Lock()", "threading.RLock()")))
+
+
+def test_blocking_call_under_lock():
+    src = """
+import threading, time
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._out = []      # guarded-by: _lock
+
+    def bad(self, fut):
+        with self._lock:
+            self._out.append(fut.result())
+"""
+    assert AnalysisCode.BLOCKING_CALL_UNDER_LOCK in codes(audit(src))
+
+
+def test_condition_wait_is_not_blocking():
+    src = """
+import threading
+
+class Box:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._items = []    # guarded-by: _cond
+
+    def take(self):
+        with self._cond:
+            while not self._items:
+                self._cond.wait(timeout=0.1)
+            return self._items.pop()
+"""
+    assert audit(src) == []
+
+
+def test_acquire_try_finally_scope_recognized():
+    src = """
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []    # guarded-by: _lock
+
+    def put(self, x):
+        self._lock.acquire()
+        try:
+            self._items.append(x)
+        finally:
+            self._lock.release()
+"""
+    assert audit(src) == []
+
+
+def test_requires_lock_seeds_scope_and_checks_callers():
+    src = """
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []    # guarded-by: _lock
+
+    # requires-lock: _lock
+    def _evict_locked(self):
+        self._items.clear()
+
+    def good(self):
+        with self._lock:
+            self._evict_locked()
+
+    def bad(self):
+        self._evict_locked()
+"""
+    found = audit(src)
+    assert codes(found) == [AnalysisCode.UNGUARDED_SHARED_WRITE]
+    assert "_evict_locked" in found[0].message and "bad" in found[0].message
+
+
+def test_nested_def_does_not_inherit_lock_scope():
+    src = """
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []    # guarded-by: _lock
+
+    def runner(self):
+        with self._lock:
+            def later():
+                self._items.append(1)
+            return later
+"""
+    assert AnalysisCode.UNGUARDED_SHARED_WRITE in codes(audit(src))
+
+
+def test_init_only_and_lockless_classes_exempt():
+    src = """
+import threading
+
+class NoLocks:
+    def __init__(self):
+        self.items = []
+
+    def put(self, x):
+        self.items.append(x)
+
+class ConfigOnly:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.limit = 10
+
+    def read(self):
+        return self.limit
+"""
+    assert audit(src) == []
+
+
+# ---------------------------------------------------------------------------
+# the repo self-audit + the adversarial mutation harness
+# ---------------------------------------------------------------------------
+
+def test_repo_self_audit_is_clean():
+    """The acceptance gate: zero findings (ERROR and WARNING both) over
+    the annotated serve/deploy/obs surface."""
+    report, diags = cc.audit_package()
+    assert diags == [], [d.format() for d in diags]
+    names = {c["name"] for c in report["classes"]}
+    # the load-bearing concurrent classes are all audited
+    assert {"QuESTService", "CompileCache", "Metrics", "Router",
+            "ReplicaPool", "SLOMonitor", "FlightRecorder",
+            "TraceRecorder"} <= names
+    assert report["lock_graph"]["cycles"] == []
+
+
+def test_adversarial_mutation_removed_lock_is_flagged():
+    """PR 3's mutation-harness pattern: delete one ``with self._lock:``
+    from a fixture copy of router.py — the auditor MUST flag the newly
+    unguarded write (this is also a CI lint-job step)."""
+    import quest_tpu.deploy.router as router_mod
+    with open(router_mod.__file__, encoding="utf-8") as fh:
+        src = fh.read()
+    mutated = cc.strip_first_lock_scope(src)
+    assert mutated != src
+    found = cc.audit_source(mutated, "router_mutated.py")
+    assert AnalysisCode.UNGUARDED_SHARED_WRITE in codes(found)
+    # the unmutated source stays clean, so the signal is the mutation
+    assert cc.audit_source(src, "router.py") == []
+
+
+def test_strip_first_lock_scope_requires_a_lock():
+    with pytest.raises(ValueError):
+        cc.strip_first_lock_scope("x = 1\n")
+
+
+# ---------------------------------------------------------------------------
+# the schedule fuzzer: reproduction power + the canonical scenarios
+# ---------------------------------------------------------------------------
+
+_RACY_SRC = """
+import threading
+
+class Racy:
+    def __init__(self):
+        self.flag = False
+        self.starts = 0
+
+    def start(self):
+        if not self.flag:
+            pad_a = 1
+            pad_b = pad_a + 1
+            pad_c = pad_b + 1
+            self.flag = True
+            self.starts += 1
+            if self.starts > 1:
+                raise RuntimeError("double start")
+"""
+
+
+def _load_fixture(tmp_path, name, src):
+    path = tmp_path / name
+    path.write_text(src)
+    ns: dict = {}
+    exec(compile(src, str(path), "exec"), ns)  # noqa: S102 — test fixture
+    return path, ns
+
+
+def test_fuzzer_reproduces_check_then_act_race(tmp_path):
+    """The harness must be able to FORCE the double-start interleaving a
+    plain stress loop almost never hits: some seed interleaves the two
+    threads between the check and the set."""
+    path, ns = _load_fixture(tmp_path, "racy_mod.py", _RACY_SRC)
+    reproduced = False
+    for seed in range(10):
+        r = ns["Racy"]()
+        res = sf.Interleaver(seed=seed, targets=(str(path),)).run(
+            [r.start, r.start])
+        assert res["completed"]
+        if res["errors"]:
+            assert "double start" in res["errors"][0]
+            reproduced = True
+            break
+    assert reproduced, "no seed reproduced the check-then-act race"
+
+
+def test_fuzzer_passes_the_locked_fix(tmp_path):
+    fixed = _RACY_SRC.replace(
+        "        self.starts = 0\n",
+        "        self.starts = 0\n        self._lock = threading.Lock()\n"
+    ).replace(
+        "        if not self.flag:\n",
+        "        with self._lock:\n            if self.flag:\n"
+        "                return\n            self.flag = True\n"
+    ).replace(
+        "            pad_a = 1\n            pad_b = pad_a + 1\n"
+        "            pad_c = pad_b + 1\n            self.flag = True\n",
+        "")
+    path, ns = _load_fixture(tmp_path, "fixed_mod.py", fixed)
+    for seed in range(10):
+        r = ns["Racy"]()
+        res = sf.Interleaver(seed=seed, targets=(str(path),)).run(
+            [r.start, r.start])
+        assert res["completed"] and not res["errors"], (seed, res)
+
+
+def test_service_double_start_race_fixed():
+    """The real thing: two concurrent ``QuESTService.start()`` calls used
+    to double-start the worker thread (RuntimeError: threads can only be
+    started once).  Forced interleaving over service.py must find no
+    error on any seed now that the check-then-act runs under the
+    condition."""
+    from quest_tpu.serve.service import QuESTService
+    for seed in range(6):
+        svc = QuESTService(start=False, max_queue=4)
+        res = sf.Interleaver(
+            seed=seed, targets=(sf._target("serve/service.py"),)).run(
+            [svc.start, svc.start])
+        assert res["completed"] and not res["errors"], (seed, res)
+        assert svc._worker.is_alive()
+        svc.shutdown(drain=False)
+
+
+def test_fuzzer_reproduces_slo_store_order_tear():
+    """The pre-fix ``SLOMonitor.observe`` committed the deadline counters
+    BEFORE the latency bucket counts; a lock-free ``health()`` reader
+    could then see more deadline'd requests than window samples.  Rebuild
+    that store order here and assert the fuzzer reproduces the tear —
+    the inverse (current code clean) is pinned by
+    test_slo_health_consistent_under_fuzz."""
+    from quest_tpu.obs import slo as slo_mod
+
+    def old_order_observe(mon, deadline_ok):
+        t = time.monotonic()
+        with mon._lock:
+            b = mon._health_bucket(t)
+            if deadline_ok:             # the buggy order: counters first
+                b[1] += 1
+            else:
+                b[2] += 1
+            pad = 0
+            pad += 1
+            pad += 1
+            pad += 1
+            pad += 1
+            pad += 1
+            pad += 1
+            # bucket count commits LAST, into an EARLY bucket exactly like
+            # the real sub-ms latencies did: the reader walks bc[0..] right
+            # after reading the deadline counters, so this is the same
+            # few-line tear window the original race had
+            b[3][0] += 1
+    reproduced = False
+    for seed in range(12):
+        # a health() ring walk is ~100 traced lines, so the forced-phase
+        # budget must cover the whole run or it degrades to free-running
+        # and the window is rarely caught (flaked under full-suite load
+        # at the 4000 default)
+        il = sf.Interleaver(
+            seed=seed, targets=(sf._target("obs/slo.py"), __file__),
+            max_switches=60000, stall_timeout_s=0.01)
+        mon = slo_mod.SLOMonitor()
+        mon._lock = il.wrap_lock(mon._lock)
+        tears = []
+
+        def writer():
+            for i in range(40):
+                old_order_observe(mon, i % 2 == 0)
+
+        def reader():
+            for _ in range(80):
+                h = mon.health()
+                if h["window_hits"] + h["window_misses"] \
+                        > h["window_samples"]:
+                    tears.append(h)
+        res = il.run([writer, writer, reader])
+        assert res["completed"]
+        if tears:
+            reproduced = True
+            break
+    assert reproduced, "no seed reproduced the store-order tear"
+
+
+@pytest.mark.parametrize("scenario", ["slo_health", "metrics_snapshot",
+                                      "queue_saturation", "flight_ring",
+                                      "router"])
+def test_fuzz_scenarios_clean(scenario):
+    fn = {"slo_health": sf.fuzz_slo_health,
+          "metrics_snapshot": sf.fuzz_metrics_snapshot,
+          "queue_saturation": sf.fuzz_queue_saturation,
+          "flight_ring": sf.fuzz_flight_ring,
+          "router": sf.fuzz_router}[scenario]
+    for seed in (0, 1, 2):
+        row = fn(seed=seed)
+        assert row["completed"], (scenario, seed, row)
+        assert row["violations"] == [], (scenario, seed)
+        assert row["errors"] == [], (scenario, seed)
+
+
+def test_slo_health_consistent_under_fuzz():
+    """Regression pin for the fixed store order: the shipped observe()
+    never lets a lock-free health() reader see deadlined > samples."""
+    for seed in range(4):
+        row = sf.fuzz_slo_health(seed=seed, iters=120)
+        assert row["violations"] == [], (seed, row["violations"])
+
+
+# ---------------------------------------------------------------------------
+# shutdown contracts: idempotency + the storm stress (tier-1)
+# ---------------------------------------------------------------------------
+
+def test_service_shutdown_idempotent():
+    from quest_tpu.serve.service import QuESTService
+    svc = QuESTService(start=False, max_queue=4)
+    f = svc.submit(qft_circuit(3))
+    svc.shutdown(drain=False)
+    with pytest.raises(QuESTError) as exc:
+        f.result(timeout=10)
+    assert exc.value.code == ErrorCode.SERVICE_SHUTDOWN
+    svc.shutdown(drain=False)           # second call: no-op, no error
+    svc.shutdown()                      # and again, with drain
+    with pytest.raises(QuESTError) as exc:
+        svc.submit(qft_circuit(3))
+    assert exc.value.code == ErrorCode.SERVICE_SHUTDOWN
+
+
+def test_concurrent_start_and_shutdown_never_join_unstarted():
+    """Review regression: start() must put Thread.start under the
+    condition too, or a racing shutdown() can observe _started and join a
+    worker that has not booted yet (RuntimeError: cannot join thread
+    before it is started)."""
+    from quest_tpu.serve.service import QuESTService
+    for seed in range(6):
+        svc = QuESTService(start=False, max_queue=4)
+        res = sf.Interleaver(
+            seed=seed, targets=(sf._target("serve/service.py"),)).run(
+            [svc.start, lambda: svc.shutdown(drain=False, timeout=10)])
+        assert res["completed"] and not res["errors"], (seed, res)
+
+
+def test_concurrent_shutdowns_both_mean_stopped():
+    """Review regression: a second CONCURRENT shutdown() waits for the
+    first teardown instead of returning mid-drain — after either call
+    returns, the worker is gone and submits are refused."""
+    from quest_tpu.serve.service import QuESTService
+    svc = QuESTService(max_queue=8)
+    barrier = threading.Barrier(2)
+
+    def stop():
+        barrier.wait(5)
+        svc.shutdown(timeout=30)
+    threads = [threading.Thread(target=stop) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive()
+    assert not svc._worker.is_alive()
+    with pytest.raises(QuESTError):
+        svc.submit(qft_circuit(3))
+
+
+def test_daemon_thread_leak_unrelated_join_does_not_mask():
+    """Review regression: os.path.join (or any non-thread .join) in the
+    same function must not satisfy the joined-thread requirement."""
+    src = ("import os, threading\n"
+           "def storm(root, f):\n"
+           "    path = os.path.join(root, f)\n"
+           "    t = threading.Thread(target=print)\n"
+           "    t.start()\n")
+    assert lint(src, "quest_tpu/serve/x.py") == \
+        [AnalysisCode.DAEMON_THREAD_LEAK]
+    joined = src.replace("    t.start()\n",
+                         "    t.start()\n    t.join()\n")
+    assert lint(joined, "quest_tpu/serve/x.py") == []
+
+
+def test_pool_shutdown_idempotent():
+    from quest_tpu.deploy.pool import ReplicaPool
+    pool = ReplicaPool(2, start=False)
+    pool.shutdown(drain=False)
+    pool.shutdown(drain=False)          # no-op, not an error
+    pool.shutdown()
+    with pytest.raises(QuESTError) as exc:
+        pool.submit(qft_circuit(3))
+    assert exc.value.code == ErrorCode.SERVICE_SHUTDOWN
+
+
+def test_pool_shutdown_under_load_storm():
+    """The tier-1 stress of the satellite contract: a submit storm racing
+    ``shutdown(drain=True)`` must not hang, and EVERY future the pool
+    accepted resolves — to a result or to a clean QuESTError."""
+    import numpy as np
+
+    from quest_tpu.circuit import param_vector
+    from quest_tpu.deploy.pool import ReplicaPool
+    c = Circuit(3)
+    c.rx(0, 0.3)
+    c.cnot(0, 1)
+    c.rx(2, 0.1)
+    base_params = param_vector(c.key())
+    pool = ReplicaPool(2, max_queue=64, max_batch=8, max_delay_ms=0.5,
+                       dtype=np.float64)
+    futures: list = []
+    flock = threading.Lock()
+    go = threading.Event()
+
+    def storm(base):
+        go.wait(5)
+        for i in range(30):
+            try:
+                f = pool.submit(c, params=base_params)
+            except QuESTError:
+                continue        # bounced or shut down: both clean
+            with flock:
+                futures.append(f)
+
+    threads = [threading.Thread(target=storm, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    go.set()
+    time.sleep(0.05)            # let the storm overlap the shutdown
+    t0 = time.monotonic()
+    pool.shutdown(drain=True, timeout=60)
+    assert time.monotonic() - t0 < 120, "shutdown hung under load"
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "a storm thread hung"
+    assert futures, "the storm never got a request in"
+    resolved = failed = 0
+    for f in futures:
+        try:
+            r = f.result(timeout=30)
+            assert r.state is not None
+            resolved += 1
+        except QuESTError as exc:
+            assert exc.code in (ErrorCode.SERVICE_SHUTDOWN,
+                                ErrorCode.QUEUE_FULL,
+                                ErrorCode.DEADLINE_EXCEEDED), exc.code
+            failed += 1
+    assert resolved + failed == len(futures)
+    pool.shutdown()             # idempotent after the storm too
+
+
+# ---------------------------------------------------------------------------
+# P_DAEMON_THREAD_LEAK (the purity satellite)
+# ---------------------------------------------------------------------------
+
+def lint(src, filename):
+    from quest_tpu.analysis import lint_source
+    return codes(lint_source(src, filename))
+
+
+def test_daemon_thread_leak_unjoined():
+    src = ("import threading\n"
+           "def storm():\n"
+           "    t = threading.Thread(target=print)\n"
+           "    t.start()\n")
+    assert lint(src, "quest_tpu/serve/x.py") == \
+        [AnalysisCode.DAEMON_THREAD_LEAK]
+    # out of the serve/deploy scope: the rule does not apply
+    assert lint(src, "quest_tpu/obs/x.py") == []
+
+
+def test_daemon_thread_leak_joined_ok():
+    src = ("import threading\n"
+           "def storm():\n"
+           "    ts = [threading.Thread(target=print) for _ in range(2)]\n"
+           "    for t in ts:\n"
+           "        t.start()\n"
+           "    for t in ts:\n"
+           "        t.join()\n")
+    assert lint(src, "quest_tpu/deploy/x.py") == []
+
+
+def test_daemon_thread_leak_self_join_ok():
+    src = ("import threading\n"
+           "class S:\n"
+           "    def __init__(self):\n"
+           "        self._w = threading.Thread(target=print)\n"
+           "    def shutdown(self):\n"
+           "        self._w.join()\n")
+    assert lint(src, "quest_tpu/serve/x.py") == []
+
+
+def test_daemon_thread_leak_daemon_comment():
+    src = ("import threading\n"
+           "def go():\n"
+           "    t = threading.Thread(target=print, daemon=True)"
+           "  # daemon-ok: monitor outlives nothing\n"
+           "    t.start()\n")
+    assert lint(src, "quest_tpu/serve/x.py") == []
+    bare = src.replace("  # daemon-ok: monitor outlives nothing", "")
+    assert lint(bare, "quest_tpu/serve/x.py") == \
+        [AnalysisCode.DAEMON_THREAD_LEAK]
+
+
+def test_serve_worker_thread_passes_the_rule():
+    """The shipped worker thread (daemon + joined + commented) is clean —
+    the self-lint CI gate stays green with the new rule on."""
+    from quest_tpu.analysis import lint_package
+    leaks = [d for d in lint_package()
+             if d.code == AnalysisCode.DAEMON_THREAD_LEAK]
+    assert leaks == []

@@ -243,13 +243,19 @@ def test_cancelled_future_does_not_kill_worker():
 
 
 def test_shutdown_without_drain_fails_pending():
+    from quest_tpu.validation import ErrorCode, QuESTError
     svc = QuESTService(dtype=DTYPE, cache=CompileCache(), start=False)
     f = svc.submit(qft_circuit(4))
     svc.shutdown(drain=False)
-    with pytest.raises(RuntimeError):
+    # pending requests and post-shutdown submits both fail with the CLEAN
+    # serving error (E_SERVICE_SHUTDOWN), not a bare RuntimeError — the
+    # pool storm contract of tests/test_concurrency.py
+    with pytest.raises(QuESTError) as exc:
         f.result(timeout=10)
-    with pytest.raises(RuntimeError):
+    assert exc.value.code == ErrorCode.SERVICE_SHUTDOWN
+    with pytest.raises(QuESTError) as exc:
         svc.submit(qft_circuit(4))
+    assert exc.value.code == ErrorCode.SERVICE_SHUTDOWN
 
 
 # ---------------------------------------------------------------------------
