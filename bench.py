@@ -788,6 +788,79 @@ def bench_serve_vqe16_batch64(requests=64, n=16, layers=1):
     return value, cfg
 
 
+def bench_serve_vqe16_probed_overhead(requests=64, n=16, layers=1):
+    """The numeric-health overhead row (docs/OBSERVABILITY.md "Numeric
+    health"): the serve_vqe_16q_batch64 workload served twice — plain, and
+    through the probe-instrumented program variants (obs/numerics.py) —
+    on fresh caches.  Value = probed/unprobed wall ratio; the contract is
+    probe overhead <= 5% (asserted: probes are pure reductions beside the
+    main dataflow, a handful of extra FLOPs against a 2^16-amp gate
+    chain).  Each side runs twice and takes the min wall so a scheduler
+    blip cannot fake (or mask) an overhead regression.  Also asserts the
+    probed side's results carry clean numeric_health records and the
+    ledger saw zero findings — the overhead row doubles as a clean-
+    workload numeric gate."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from quest_tpu.obs import numerics as qnum
+    from quest_tpu.serve import CompileCache, QuESTService
+    from quest_tpu.serve.selftest import vqe_ansatz
+
+    platform = jax.devices()[0].platform
+    dtype = jnp.float64 if platform == "cpu" else jnp.float32
+    circuits = [vqe_ansatz(n, layers, seed=s) for s in range(requests)]
+    gates = len(circuits[0].ops)
+
+    def serve_round(probes):
+        ledger = qnum.NumericLedger()
+        svc = QuESTService(max_batch=requests, max_delay_ms=50.0,
+                          max_queue=requests, dtype=dtype,
+                          cache=CompileCache(), probes=probes,
+                          numeric_ledger=ledger, start=False)
+        walls = []
+        results = None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            futs = [svc.submit(c) for c in circuits]
+            svc.start()
+            if not svc.drain(timeout=1200):
+                raise RuntimeError("probed-overhead drain timed out")
+            results = [f.result(timeout=120) for f in futs]
+            walls.append(time.perf_counter() - t0)
+        svc.shutdown()
+        return min(walls), results, ledger
+
+    plain_s, plain_res, _ = serve_round(False)
+    probed_s, probed_res, ledger = serve_round(True)
+    # the probed side must change NOTHING but the telemetry
+    assert all(r.numeric_health is None for r in plain_res)
+    assert all(r.numeric_health is not None
+               and not r.numeric_health["findings"] for r in probed_res), \
+        "probed serve flagged a clean workload"
+    snap = ledger.snapshot()
+    assert snap["nan_total"] == 0 and snap["drift_total"] == 0, snap
+    # byte-equality over EVERY request pair (circuits are seeded
+    # per-index): a divergence in any batch position must fail the row
+    worst = max(float(np.abs(p.state - np.asarray(u.state)).max())
+                for p, u in zip(probed_res, plain_res))
+    assert worst == 0.0, f"probed result drifted {worst} from unprobed"
+    value = probed_s / max(plain_s, 1e-9)
+    assert value <= 1.05, (
+        f"probe overhead {100 * (value - 1):.1f}% exceeds the 5% budget "
+        f"(probed {probed_s:.3f}s vs {plain_s:.3f}s)")
+    cfg = {"qubits": n, "requests": requests, "gates_per_circuit": gates,
+           "precision": 2 if dtype == jnp.float64 else 1,
+           "platform": platform,
+           "probed_seconds": probed_s,
+           "unprobed_seconds": plain_s,
+           "overhead_frac": value - 1.0,
+           "probed_requests": int(snap["probed_total"]),
+           "numeric_findings": snap["nan_total"] + snap["drift_total"],
+           "seconds": probed_s}
+    return value, cfg
+
+
 def bench_serve_deploy_rps(requests_per_class=16, n=12, replicas=2):
     """Aggregate requests/sec of a 2-replica deployment (quest_tpu/deploy:
     affinity router + per-replica services) vs ONE QuESTService on the
@@ -1345,6 +1418,10 @@ def main() -> None:
         add("densmatr_14q_damping_depol_f64", bench_density, 14, 3, 2)
         # serving subsystem (quest_tpu/serve): 64 tenants, one compile
         add("serve_vqe_16q_batch64", bench_serve_vqe16_batch64)
+        # numeric-health probes (quest_tpu/obs/numerics.py): instrumented
+        # serving must cost <= 5% vs the plain row (asserted in the fn)
+        add("serve_vqe_16q_probed_overhead",
+            bench_serve_vqe16_probed_overhead, unit="x_probed_over_unprobed")
         # deployment layer (quest_tpu/deploy): 2-replica aggregate
         # throughput vs one service, and the persistent-store cold start
         add("serve_deploy_2replica_rps", bench_serve_deploy_rps,

@@ -58,6 +58,8 @@ GROUPS = [
                    "calcDensityInnerProduct", "calcPurity", "calcFidelity",
                    "calcHilbertSchmidtDistance", "calcExpecPauliProd",
                    "calcExpecPauliSum", "calcExpecPauliHamil", "calcExpecDiagonalOp"]),
+    ("Numeric health (QuEST calcTotalProb parity, snake-case)",
+     ["calc_total_prob", "calc_purity", "calc_fidelity"]),
     ("QASM logging", ["startRecordingQASM", "stopRecordingQASM", "clearRecordedQASM",
                       "printRecordedQASM", "writeRecordedQASMToFile"]),
     ("Debug API", ["initStateDebug", "initStateOfSingleQubit",
@@ -87,6 +89,12 @@ GROUPS = [
                                        "load_shard", "merge_shards",
                                        "merge_files",
                                        "SLOConfig", "SLOMonitor"]),
+    ("Numeric-health telemetry (quest_tpu.obs.numerics)",
+     ["obs.numerics.state_probe_vector", "obs.numerics.densmatr_probe_vector",
+      "obs.numerics.ulp_band", "obs.numerics.epoch_pass_probes",
+      "obs.numerics.NumericLedger", "obs.numerics.NumericRecord",
+      "obs.numerics.global_numeric_ledger",
+      "obs.numerics.corruption_selftest"]),
     ("Calibration & runtime counters (quest_tpu.obs)",
      ["CalibrationProfile", "run_calibration", "save_profile",
       "load_profile", "validate_profile", "activate_calibration",

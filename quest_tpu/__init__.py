@@ -52,7 +52,10 @@ from .obs import (TraceRecorder, FlightRecorder, Ledger,  # noqa: F401
                   CalibrationProfile, run_calibration, save_profile,
                   load_profile, validate_profile, activate_calibration,
                   deactivate_calibration, active_profile, use_profile,
-                  RuntimeCounters, global_counters, hbm_watermark)
+                  RuntimeCounters, global_counters, hbm_watermark,
+                  NumericLedger, NumericRecord, global_numeric_ledger,
+                  state_probe_vector, densmatr_probe_vector, ulp_band,
+                  epoch_pass_probes, corruption_selftest)
 
 __version__ = "0.1.0"
 __all__ = list(_api_all) + [
@@ -74,4 +77,7 @@ __all__ = list(_api_all) + [
     "load_profile", "validate_profile", "activate_calibration",
     "deactivate_calibration", "active_profile", "use_profile",
     "RuntimeCounters", "global_counters", "hbm_watermark",
+    "NumericLedger", "NumericRecord", "global_numeric_ledger",
+    "state_probe_vector", "densmatr_probe_vector", "ulp_band",
+    "epoch_pass_probes", "corruption_selftest",
 ]

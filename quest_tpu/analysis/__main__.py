@@ -43,6 +43,20 @@ Modes (combinable; exit status is 1 iff any ERROR-severity diagnostic):
   trace, no human-text blobs) and the process ledger is summarized in a
   top-level ``"ledger"`` section CI parses instead of grepping.
 
+- ``--numeric-report``: execute each circuit through the probe-
+  instrumented program variant (quest_tpu/obs/numerics.py): assert the
+  instrumented primary output BIT-IDENTICAL to the uninstrumented one
+  (violation: ``A_NUMERIC_PROBE_DIVERGENCE``, ERROR), record a numeric
+  drift ledger row (norm vs the precision-and-depth-derived ulp band,
+  NaN/Inf counts), and — with ``--engine pallas`` inside the epoch
+  envelope — run the epoch plan pass by pass with a probe at every
+  fused-pass boundary, independently confirming the planner's pass
+  count.  Ledger findings report as ``O_NUMERIC_DRIFT`` (WARNING) /
+  ``O_NUMERIC_NAN`` (ERROR); under ``--json`` per-circuit rows land in
+  ``"numeric_report"`` and the process numeric ledger in a top-level
+  ``"numeric_ledger"`` section (the CI ``numeric-selftest`` gate parses
+  both).
+
 - ``--serve-audit``: machine-prove the serve layer's parameter-lifted
   compilation cache (analysis/serve_audit.py): per structural class, the
   skeleton + operand-vector reconstruction is translation-validated
@@ -375,6 +389,102 @@ def _trace_report_run(label: str, circuit, args, echo) -> tuple:
             _obs.disable_tracing()
 
 
+def _numeric_report_run(label: str, circuit, args, echo) -> tuple:
+    """The ``--numeric-report`` payload for one circuit: the probed twin
+    of the program is executed beside the plain one (bit-identity
+    asserted — probes are pure reductions grafted BESIDE the dataflow,
+    A_NUMERIC_PROBE_DIVERGENCE if one ever leaks in), the final-state
+    probe is judged by the numeric drift ledger, and — on the Pallas
+    engine inside the epoch envelope — the plan runs pass by pass with a
+    probe at every fused-pass boundary, independently confirming the
+    planner's pass count (obs/numerics.py epoch_pass_probes)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..circuit import _run_ops
+    from ..obs import numerics as _num
+    from .diagnostics import AnalysisCode, Severity, diag
+
+    n = circuit.num_qubits
+    dtype = _dtype(args.precision)
+    ops = circuit.key()
+    ledger = _num.global_numeric_ledger()
+    state = jnp.zeros((2, 1 << n), dtype).at[0, 0].set(1.0)
+    plain = np.asarray(jax.block_until_ready(_run_ops(state, ops)))
+    out, probe = _num.run_ops_probed(state, ops)
+    out = np.asarray(jax.block_until_ready(out))
+    bit_identical = bool(np.array_equal(out, plain))
+    found: list = []
+    rec = ledger.record(label, probe, engine="xla",
+                        dtype=str(jnp.dtype(dtype)), num_qubits=n,
+                        num_ops=len(ops), warn=False)
+    report = {"label": label, "ops": len(ops),
+              "precision": args.precision,
+              "bit_identical": bit_identical,
+              "ledger": rec.as_dict(), "epoch": None}
+    if not bit_identical:
+        found.append(diag(AnalysisCode.NUMERIC_PROBE_DIVERGENCE,
+                          Severity.ERROR,
+                          detail=f"{label}: instrumented primary output "
+                                 "diverged from the uninstrumented run"))
+    recs = [rec]
+    if args.engine == "pallas":
+        from ..ops import epoch_pallas as _ep
+        if _ep.epoch_supported(n, 1):
+            st32 = jnp.zeros((2, 1 << n), jnp.float32).at[0, 0].set(1.0)
+            base = np.asarray(jax.block_until_ready(
+                _ep.jit_program(ops)(st32)))
+            out_e, points, plan = _num.epoch_pass_probes(ops, n, st32)
+            out_e = np.asarray(jax.block_until_ready(out_e))
+            xla_segments = sum(1 for s in plan["segments"]
+                               if s["engine"] == "xla")
+            rec_e = ledger.record(
+                f"{label}/epoch", _num.state_probe_vector(jnp.asarray(out_e)),
+                engine="pallas", dtype="float32", num_qubits=n,
+                num_ops=len(ops), probe_points=tuple(points), warn=False)
+            recs.append(rec_e)
+            epoch = {
+                "plan": plan,
+                "probe_points": points,
+                "pass_probe_count": len(points),
+                # the plan said N fused passes; N probes observed N
+                # intermediate states — the runtime confirmation of the
+                # planner's fused-pass boundaries
+                "boundaries_confirmed": len(points)
+                == plan["pallas_passes"] + xla_segments,
+                "bit_identical": bool(np.array_equal(out_e, base)),
+                "ledger": rec_e.as_dict(),
+            }
+            report["epoch"] = epoch
+            if not epoch["bit_identical"]:
+                found.append(diag(
+                    AnalysisCode.NUMERIC_PROBE_DIVERGENCE, Severity.ERROR,
+                    detail=f"{label}: per-pass-probed epoch output "
+                           "diverged from the uninstrumented program"))
+        else:
+            report["epoch"] = {
+                "skip_reason": "outside the epoch engine envelope (f32, "
+                               f"{_ep.MIN_QUBITS} <= n <= {_ep.MAX_QUBITS})"}
+    for r in recs:
+        for f in r.findings:
+            nan = _num.NUMERIC_NAN in f
+            found.append(diag(
+                AnalysisCode.NUMERIC_NAN if nan
+                else AnalysisCode.NUMERIC_DRIFT,
+                Severity.ERROR if nan else Severity.WARNING,
+                detail=f"{r.label}: {f}"))
+    echo(f"{label}: numeric-report bit_identical={bit_identical}, norm "
+         f"{rec.norm:.17g} (drift {rec.norm_drift:.3g}, band "
+         f"{rec.band:.3g}), {rec.nan_count} NaN / {rec.inf_count} Inf"
+         + (f"; epoch: {report['epoch']['pass_probe_count']} probe "
+            f"point(s), boundaries_confirmed="
+            f"{report['epoch']['boundaries_confirmed']}"
+            if report["epoch"] and "pass_probe_count" in report["epoch"]
+            else ""))
+    return report, found
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m quest_tpu.analysis",
@@ -434,6 +544,15 @@ def main(argv=None) -> int:
                              "per-request/per-span report, and record a "
                              "model-vs-measured ledger row; ledger drift "
                              "is reported as O_MODEL_DRIFT (WARNING)")
+    parser.add_argument("--numeric-report", action="store_true",
+                        dest="numeric_report",
+                        help="execute each circuit through the probe-"
+                             "instrumented program (quest_tpu/obs/"
+                             "numerics.py): bit-identity asserted, a "
+                             "numeric drift ledger row recorded, and "
+                             "(--engine pallas) per-pass probes at every "
+                             "fused-pass boundary; findings report as "
+                             "O_NUMERIC_DRIFT / O_NUMERIC_NAN")
     parser.add_argument("--calibrate", action="store_true",
                         help="run the on-device calibration harness "
                              "(quest_tpu/obs/calibrate.py), write the "
@@ -482,8 +601,8 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     doc: dict = {"circuits": [], "schedule": [], "verify": [],
-                 "serve_audit": [], "trace_report": [], "concurrency": None,
-                 "diagnostics": [], "summary": {}}
+                 "serve_audit": [], "trace_report": [], "numeric_report": [],
+                 "concurrency": None, "diagnostics": [], "summary": {}}
 
     def echo(line: str) -> None:
         if not args.as_json:
@@ -577,6 +696,10 @@ def main(argv=None) -> int:
             report, extra = _trace_report_run(label, circuit, args, echo)
             doc["trace_report"].append(report)
             found += extra
+        if args.numeric_report:
+            report, extra = _numeric_report_run(label, circuit, args, echo)
+            doc["numeric_report"].append(report)
+            found += extra
         diagnostics += found
         doc["circuits"].append({"label": label, "ops": len(circuit.ops),
                                 "findings": len(found)})
@@ -603,6 +726,18 @@ def main(argv=None) -> int:
         led = _obs.global_ledger()
         doc["ledger"] = {"records": led.as_dicts(),
                          "drift_total": led.snapshot()["drift_total"]}
+
+    if args.numeric_report:
+        # same one-document contract for the numeric ledger: the CI
+        # numeric-selftest gate reads NaN/drift totals from HERE and
+        # O_NUMERIC_* severities from "diagnostics"
+        from ..obs import numerics as _num
+        nled = _num.global_numeric_ledger()
+        snap = nled.snapshot()
+        doc["numeric_ledger"] = {"records": nled.as_dicts(),
+                                 "probed_total": snap["probed_total"],
+                                 "nan_total": snap["nan_total"],
+                                 "drift_total": snap["drift_total"]}
 
     if not ran:
         parser.print_usage()

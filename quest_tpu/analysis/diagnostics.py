@@ -57,6 +57,14 @@ class AnalysisCode:
     # string is defined there — the ledger must warn without importing the
     # analysis package
     MODEL_DRIFT = "O_MODEL_DRIFT"
+    # numeric drift ledger (quest_tpu/obs/numerics.py); code strings
+    # defined there for the same reason
+    NUMERIC_DRIFT = "O_NUMERIC_DRIFT"
+    NUMERIC_NAN = "O_NUMERIC_NAN"
+    # probe purity contract of the --numeric-report mode: the instrumented
+    # program's primary output must be bit-identical to the uninstrumented
+    # one
+    NUMERIC_PROBE_DIVERGENCE = "A_NUMERIC_PROBE_DIVERGENCE"
     # optimization hints
     ADJACENT_INVERSE_PAIR = "H_ADJACENT_INVERSE_PAIR"
     FUSABLE_1Q_RUN = "H_FUSABLE_1Q_RUN"
@@ -164,6 +172,24 @@ ANALYSIS_MESSAGES = {
         "no longer describes this deployment — re-calibrate "
         "MEASURED_EFFICIENCY or investigate the partitioner "
         "(docs/OBSERVABILITY.md).",
+    AnalysisCode.NUMERIC_DRIFT:
+        "A numeric probe measured norm/trace drift (or a Hermiticity "
+        "deviation) outside the precision-and-depth-derived ulp-growth "
+        "band: a kernel on this backend is not norm-preserving — the "
+        "wrong-norms-on-chip symptom class of the f64 X64-rewriter "
+        "miscompiles (docs/OBSERVABILITY.md 'Numeric health').",
+    AnalysisCode.NUMERIC_NAN:
+        "A numeric probe observed NaN/Inf amplitudes in a result "
+        "register: the state is poisoned and every downstream consumer "
+        "of this structural class is being served garbage.  The serve "
+        "flight ring dumps on the first such outcome and the deploy "
+        "router quarantines the (class, replica) placement.",
+    AnalysisCode.NUMERIC_PROBE_DIVERGENCE:
+        "The probe-instrumented program's PRIMARY output differs from "
+        "the uninstrumented program's: a probe leaked into the main "
+        "dataflow instead of being grafted beside it, so probed serving "
+        "would change tenants' answers.  Probes must be pure reductions "
+        "(obs/numerics.py).",
     AnalysisCode.ADJACENT_INVERSE_PAIR:
         "Adjacent gates on identical wires compose to the identity and can "
         "be cancelled.",

@@ -85,6 +85,9 @@ __all__ = [
     "calcTotalProb", "calcInnerProduct", "calcDensityInnerProduct",
     "calcPurity", "calcFidelity", "calcHilbertSchmidtDistance",
     "calcExpecPauliProd", "calcExpecPauliSum", "calcExpecPauliHamil",
+    # numeric-health helpers (QuEST's calcTotalProb runtime-sanity
+    # surface, snake-case; obs/numerics.py is the telemetry twin)
+    "calc_total_prob", "calc_purity", "calc_fidelity",
     # decoherence
     "mixDephasing", "mixTwoQubitDephasing", "mixDepolarising", "mixDamping",
     "mixTwoQubitDepolarising", "mixPauli", "mixKrausMap", "mixTwoQubitKrausMap",
@@ -1222,6 +1225,7 @@ def measure(qureg: Qureg, target: int) -> int:
 # ---------------------------------------------------------------------------
 
 def calcTotalProb(qureg: Qureg) -> float:
+    V.validate_qureg_init(qureg, "calcTotalProb")
     if qureg.is_density_matrix:
         return float(_calc.total_prob_densmatr(qureg.amps, qureg.num_qubits_represented))
     if qureg._planes is not None:
@@ -1245,11 +1249,14 @@ def calcDensityInnerProduct(rho1: Qureg, rho2: Qureg) -> float:
 
 
 def calcPurity(qureg: Qureg) -> float:
+    V.validate_qureg_init(qureg, "calcPurity")
     V.validate_density_matr_qureg(qureg, "calcPurity")
     return float(_calc.purity(qureg.amps))
 
 
 def calcFidelity(qureg: Qureg, pure: Qureg) -> float:
+    V.validate_qureg_init(qureg, "calcFidelity")
+    V.validate_qureg_init(pure, "calcFidelity")
     V.validate_second_qureg_state_vec(pure, "calcFidelity")
     V.validate_matching_qureg_dims(qureg, pure, "calcFidelity")
     if qureg.is_density_matrix:
@@ -1257,6 +1264,32 @@ def calcFidelity(qureg: Qureg, pure: Qureg) -> float:
                                              qureg.num_qubits_represented))
     ip = np.asarray(_calc.inner_product(qureg.amps, pure.amps))
     return float(ip[0] ** 2 + ip[1] ** 2)
+
+
+def calc_total_prob(qureg: Qureg) -> float:
+    """Numeric-health twin of :func:`calcTotalProb` (QuEST's canonical
+    mid-circuit sanity check): the register's total probability — L2 norm
+    of a statevector, trace of a density matrix — with validation-layer
+    errors (``E_QUREG_NOT_INITIALISED``) on destroyed registers.  A
+    unit-norm result within the ulp band of obs/numerics.py says the
+    register is still a physical state; the serve layer computes the same
+    reduction on-device as a probe (docs/OBSERVABILITY.md)."""
+    return calcTotalProb(qureg)
+
+
+def calc_purity(qureg: Qureg) -> float:
+    """Numeric-health twin of :func:`calcPurity`: Tr(rho^2) of a density
+    register (1 for pure, 1/2^n for maximally mixed), with
+    validation-layer errors on destroyed or non-density registers."""
+    return calcPurity(qureg)
+
+
+def calc_fidelity(qureg: Qureg, pure: Qureg) -> float:
+    """Numeric-health twin of :func:`calcFidelity`: |<pure|psi>|^2 (or
+    <pure|rho|pure> for a density register) against a pure reference
+    state, with validation-layer errors on destroyed registers and
+    mismatched dimensions."""
+    return calcFidelity(qureg, pure)
 
 
 def calcHilbertSchmidtDistance(a: Qureg, b: Qureg) -> float:

@@ -35,6 +35,14 @@ the class was evicted there — the sticky placement is dropped, the
 onto the next-best candidate instead of re-warming the evicting replica by
 stale habit (tests/test_deploy.py pins the interplay).
 
+Probed deployments (obs/numerics.py) add a third feedback channel:
+``ServeResult.numeric_health``.  ``quarantine_nans`` consecutive NaN/Inf
+outcomes for a (class, replica) pair quarantine that placement for
+``quarantine_s`` — a placement that poisons a class's registers (a
+miscompiled executable, a bad device) is worse than a cold cache, so the
+class re-places while the pair sits out (``report_numeric``;
+``quest_serve_numeric_quarantined_total{replica=...}``).
+
 Every decision is a traced span (``deploy.route``: class key, chosen
 replica, sticky/shed/cooldown disposition) and a labeled counter in the
 deployment's one registry (``quest_serve_routed_total{replica="i"}``,
@@ -60,10 +68,18 @@ class RouterConfig:
     at which a replica sheds all traffic; ``shed_burn`` the short-window
     burn rate at which it sheds deadline-carrying traffic;
     ``cooldown_s`` how long an evicted (class, replica) pair is avoided
-    before affinity may return."""
+    before affinity may return.  ``quarantine_nans`` is how many
+    CONSECUTIVE NaN/Inf numeric outcomes (ServeResult.numeric_health —
+    probed services only) a (class, replica) pair may produce before it is
+    quarantined for ``quarantine_s``: a placement that keeps poisoning a
+    class's registers is worse than a cold cache, so the router re-places
+    the class instead of re-feeding the bad executable by sticky habit
+    (docs/DEPLOY.md "numeric quarantine")."""
     shed_saturation: float = 0.8
     shed_burn: float = 1.0
     cooldown_s: float = 30.0
+    quarantine_nans: int = 2
+    quarantine_s: float = 300.0
 
 
 class Router:
@@ -79,6 +95,8 @@ class Router:
         self._placement: dict = {}   # guarded-by: _lock (class_key -> replica index)
         self._confirmed: set = set()  # guarded-by: _lock ((class_key, index): seen a hit)
         self._cooldown: dict = {}    # guarded-by: _lock ((class_key, index) -> t_until)
+        self._nan_strikes: dict = {}  # guarded-by: _lock ((class_key, index) -> [strikes, t_last])
+        self._quarantine: dict = {}  # guarded-by: _lock ((class_key, index) -> t_until)
 
     # -- affinity -----------------------------------------------------------
     def class_key(self, circuit) -> str:
@@ -118,18 +136,30 @@ class Router:
         now = time.monotonic()
         with self._lock:
             sticky = self._placement.get(ck)
-            # prune on the way through: without this the dict grows one
-            # entry per eviction for the process lifetime
+            # prune on the way through: without this the dicts grow one
+            # entry per eviction/quarantine for the process lifetime
             for pair in [p for p, t in self._cooldown.items() if t <= now]:
                 del self._cooldown[pair]
+            for pair in [p for p, t in self._quarantine.items() if t <= now]:
+                del self._quarantine[pair]
+                self._nan_strikes.pop(pair, None)
+            # strikes decay too: a strike older than quarantine_s is not
+            # "consecutive" with a NaN weeks later, and without this prune
+            # the dict grows one entry per (class, replica) that ever
+            # produced a single NaN for the process lifetime
+            for pair in [p for p, (_, t) in self._nan_strikes.items()
+                         if now - t > self.config.quarantine_s]:
+                del self._nan_strikes[pair]
             cooled = {i for i in order if (ck, i) in self._cooldown}
+            quarantined = {i for i in order if (ck, i) in self._quarantine}
+        avoid = cooled | quarantined
         if sticky is not None and sticky in order:
             order = [sticky] + [i for i in order if i != sticky]
-        if len(cooled) < len(order):
-            # skip cooled replicas only while an alternative exists: a
-            # fully-cooled class still gets served somewhere
-            order = ([i for i in order if i not in cooled]
-                     + [i for i in order if i in cooled])
+        if len(avoid) < len(order):
+            # skip cooled/quarantined replicas only while an alternative
+            # exists: a fully-avoided class still gets served somewhere
+            order = ([i for i in order if i not in avoid]
+                     + [i for i in order if i in avoid])
         by_index = {r.index: r for r in self.replicas}
         chosen = None
         shed_from: list = []
@@ -156,7 +186,8 @@ class Router:
                     "affinity": hrw_first if sticky is None else sticky,
                     "sticky": sticky is not None,
                     "shed_from": shed_from,
-                    "cooldown_skipped": sorted(cooled)}
+                    "cooldown_skipped": sorted(cooled),
+                    "quarantine_skipped": sorted(quarantined)}
         if self.metrics is not None and shed_from:
             self.metrics.inc("shed_total",
                              labels={"replica": str(shed_from[0]["replica"]),
@@ -215,8 +246,14 @@ class Router:
     def _on_done(self, class_key: str, index: int, fut) -> None:
         if fut.cancelled() or fut.exception() is not None:
             return
-        outcome = getattr(fut.result(), "cache_outcome", None)
+        result = fut.result()
+        outcome = getattr(result, "cache_outcome", None)
         self.report(class_key, index, outcome)
+        health = getattr(result, "numeric_health", None)
+        if health is not None:
+            self.report_numeric(
+                class_key, index,
+                ok=not (health.get("nan_count") or health.get("inf_count")))
 
     def report(self, class_key: str, index: int,
                outcome: str | None) -> None:
@@ -242,6 +279,40 @@ class Router:
             self.metrics.inc("replaced_total",
                              labels={"replica": str(index)})
 
+    def report_numeric(self, class_key: str, index: int, ok: bool) -> None:
+        """Numeric-health feedback from a probed result (obs/numerics.py;
+        also callable directly by out-of-band monitors).  A clean outcome
+        resets the pair's strike count, and so does ``quarantine_s`` of
+        silence (a strike weeks old is not "consecutive" with a fresh
+        NaN); ``quarantine_nans`` CONSECUTIVE NaN/Inf outcomes quarantine
+        the (class, replica) placement for ``quarantine_s`` — the sticky
+        placement is dropped and route() avoids the pair while any
+        alternative replica exists, so the class re-places instead of
+        feeding the poisoning executable forever."""
+        pair = (class_key, index)
+        quarantined = False
+        with self._lock:
+            if ok:
+                self._nan_strikes.pop(pair, None)
+                return
+            now = time.monotonic()
+            strikes, t_last = self._nan_strikes.get(pair, (0, now))
+            if now - t_last > self.config.quarantine_s:
+                strikes = 0     # stale window: not consecutive in time
+            strikes += 1
+            self._nan_strikes[pair] = (strikes, now)
+            if (strikes >= self.config.quarantine_nans
+                    and pair not in self._quarantine):
+                quarantined = True
+                self._quarantine[pair] = (time.monotonic()
+                                          + self.config.quarantine_s)
+                if self._placement.get(class_key) == index:
+                    del self._placement[class_key]
+                self._confirmed.discard(pair)
+        if quarantined and self.metrics is not None:
+            self.metrics.inc("numeric_quarantined_total",
+                             labels={"replica": str(index)})
+
     # -- introspection ------------------------------------------------------
     def snapshot(self) -> dict:
         with self._lock:
@@ -252,4 +323,8 @@ class Router:
                 "cooling": sorted(f"{ck}@{i}"
                                   for (ck, i), t in self._cooldown.items()
                                   if t > time.monotonic()),
+                "quarantined": sorted(
+                    f"{ck}@{i}"
+                    for (ck, i), t in self._quarantine.items()
+                    if t > time.monotonic()),
             }

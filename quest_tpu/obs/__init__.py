@@ -49,6 +49,19 @@ PR 9 closed the cost-model loop:
   the hard-coded defaults (``analysis --calibrate``; the CI
   ``calibrate-selftest`` job).  With a profile active the ledger checks
   walls on ANY platform against the fitted residual band.
+PR 13 added the correctness half — numeric-health telemetry:
+
+- ``numerics.py``: on-device numeric probes (norm / total probability,
+  max |amp|^2, NaN/Inf counts, density trace + Hermiticity deviation)
+  compiled as auxiliary outputs BESIDE the primary dataflow (primary
+  output bit-identical by construction), the precision-and-depth-derived
+  ulp-growth band, and the **numeric drift ledger** — ``O_NUMERIC_DRIFT``
+  / ``O_NUMERIC_NAN`` findings with per-structural-class aggregation.
+  Served through ``QuESTService(probes=True)`` /
+  ``QUEST_TPU_NUMERIC_PROBES=1``, the ``quest_serve_numeric_*`` scrape
+  gauges, the deploy router's NaN quarantine and ``analysis
+  --numeric-report``.
+
 - ``counters.py``: runtime counters — process-wide compile wall seconds,
   dispatch walls, and the live-HBM watermark (``device.memory_stats()``)
   — recorded into trace spans, ledger records, bench rows, and the one
@@ -62,6 +75,10 @@ from .trace import (Span, TraceRecorder, collect_notes, current_request_id,  # n
                     note, obs_snapshot, recorder, request, reset_tracing,
                     span, tracing_enabled)
 from .ledger import DriftRecord, Ledger, global_ledger  # noqa: F401
+from .numerics import (NumericLedger, NumericRecord,  # noqa: F401
+                       corruption_selftest, densmatr_probe_vector,
+                       epoch_pass_probes, global_numeric_ledger,
+                       state_probe_vector, ulp_band)
 from .flight import FlightRecord, FlightRecorder  # noqa: F401
 from .export import chrome_trace, trace_report, validate_chrome_trace  # noqa: F401
 from .aggregate import (load_shard, merge_files, merge_shards,  # noqa: F401
@@ -82,6 +99,9 @@ __all__ = [
     "current_request_id", "note", "collect_notes", "enable_tracing",
     "disable_tracing", "reset_tracing", "tracing_enabled", "obs_snapshot",
     "Ledger", "DriftRecord", "global_ledger",
+    "NumericLedger", "NumericRecord", "global_numeric_ledger",
+    "state_probe_vector", "densmatr_probe_vector", "epoch_pass_probes",
+    "ulp_band", "corruption_selftest",
     "FlightRecorder", "FlightRecord",
     "chrome_trace", "trace_report", "validate_chrome_trace",
     "process_shard", "save_shard", "load_shard", "merge_shards",

@@ -8,8 +8,10 @@ execution time, and the terminal outcome (``ok`` | ``deadline`` |
 ``queue_full`` | ``cancelled`` | ``error:<type>``) — in a ring buffer whose
 memory never grows with traffic.
 
-The service dumps the ring on ``E_QUEUE_FULL`` and on a worker-side
-execution error (the two "something is wrong NOW" moments), keeps the last
+The service dumps the ring on ``E_QUEUE_FULL``, on a worker-side
+execution error, and — probed services (obs/numerics.py) — on the first
+NaN/Inf outcome in a batch (reason ``O_NUMERIC_NAN``): the "something is
+wrong NOW" moments.  It keeps the last
 dump for post-mortems, and exposes both the live ring and the last dump
 through ``python -m quest_tpu.serve --selftest --json`` (the
 ``flight_recorder`` document key; docs/OBSERVABILITY.md has the format).
@@ -42,6 +44,10 @@ class FlightRecord:
     wait_s: float | None = None
     exec_s: float | None = None
     outcome: str = "pending"
+    # numeric-health payload of a probed request (obs/numerics.py
+    # NumericRecord.as_health): norm, drift vs band, NaN/Inf counts,
+    # findings — None when the request ran unprobed
+    numeric_health: dict | None = None
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -86,7 +92,8 @@ class FlightRecorder:
 
     def resolve(self, request_id: int, outcome: str, *,
                 batch_id: int | None = None, wait_s: float | None = None,
-                exec_s: float | None = None) -> None:
+                exec_s: float | None = None,
+                numeric_health: dict | None = None) -> None:
         """Fill a record's terminal fields; unknown ids (already rung out)
         are ignored — the ring is best-effort recent history, not a
         database."""
@@ -101,6 +108,8 @@ class FlightRecorder:
                 rec.wait_s = wait_s
             if exec_s is not None:
                 rec.exec_s = exec_s
+            if numeric_health is not None:
+                rec.numeric_health = numeric_health
 
     # -- reading ------------------------------------------------------------
     def records(self) -> list[FlightRecord]:

@@ -1,0 +1,536 @@
+"""Numeric-health telemetry: on-device probes + the numeric drift ledger.
+
+The reference exposes ``calcTotalProb`` as THE runtime sanity check — QuEST
+users call it mid-circuit to confirm the register is still a unit-norm
+state (PAPER.md L3/L4 validation surface).  Our observability stack (PRs
+7-9) watches only *time*: spans, SLO burn, calibration drift.  Nothing
+watches the *numbers*, even though the two hardest open ROADMAP items are
+numerical at the core (item 3's f64 miscompiles show up as "wrong norms
+on-chip"; item 4's density channels must provably preserve trace and
+Hermiticity).  This module is the correctness half of the observability
+story:
+
+- **Probe kernels** (:func:`state_probe_vector`,
+  :func:`densmatr_probe_vector`): pure reductions — L2 norm / total
+  probability (density: the trace), max |amp|^2, NaN and Inf counts, and
+  the Hermiticity deviation for Choi-flattened density matrices (via the
+  same reduction shapes as ops/calc.py) — compiled as AUXILIARY outputs
+  beside the primary dataflow.  A probe reads the state, it never writes
+  it, so the primary output of a probe-instrumented program is
+  bit-identical to the uninstrumented one (pinned in tier-1 for every
+  engine path; the serve cache's ``*_probed_program`` variants are built
+  on exactly this contract).
+- **The ulp-growth band** (:func:`ulp_band`): the precision-and-depth-
+  derived envelope measured norm drift is judged against.  Unitary gates
+  preserve the norm exactly in exact arithmetic; floating-point rounding
+  random-walks it by ~eps per pass, so after D passes the drift envelope
+  is ``SAFETY * eps(dtype) * sqrt(D)``.  The safety factor covers the
+  walk's constant and dense-gate accumulation order; the band is
+  deliberately generous enough that a clean workload NEVER trips it (the
+  CI ``numeric-selftest`` gate runs 64 probed requests at zero findings)
+  while a 1e-3-scaled state or a miscompiled f64 kernel (wrong norms
+  on-chip — ROADMAP item 3's symptom) trips it by orders of magnitude.
+- **The numeric ledger** (:class:`NumericLedger`) — sibling of
+  obs/ledger.py's model-vs-measured ledger: every probed run records one
+  :class:`NumericRecord`; NaN/Inf counts raise ``O_NUMERIC_NAN``, drift
+  outside the band (norm, density trace, or Hermiticity deviation) raises
+  ``O_NUMERIC_DRIFT``, with per-structural-class aggregation
+  (:meth:`NumericLedger.by_class`) so a fleet scrape can say WHICH class
+  went bad, not just that something did.
+- **Epoch per-pass probes** (:func:`epoch_pass_probes`): the plan of
+  ops/epoch_pallas.py executed pass by pass with a probe at every fused
+  HBM-pass boundary — one probe point per Pallas pass and per XLA
+  fallback segment — independently confirming the planner's fused-pass
+  boundaries (the probe-point count must equal the plan's pass count) and
+  giving the f64 double-float work of ROADMAP item 3 a per-pass
+  norm-drift oracle.  Norm, NaN and Inf probes are invariant under the
+  engine's deferred qubit map, so probing between passes needs no
+  materialization.
+- **Adversarial injections** (:func:`corruption_selftest`): a scaled
+  state, a NaN-poisoned amplitude and a non-Hermitian density
+  perturbation MUST each trip the ledger — the PR 3/12 mutation-harness
+  pattern applied to the numeric gate itself, run by the serve selftest
+  and the CI ``numeric-selftest`` job.
+
+Serving wires this end to end: ``QuESTService(probes=True)`` (or
+``QUEST_TPU_NUMERIC_PROBES=1``) serves every request through the
+probe-instrumented program variant, attaches a ``numeric_health`` record
+to each :class:`~quest_tpu.serve.service.ServeResult` and flight-ring
+record, dumps the ring on the first NaN outcome, exports
+``quest_serve_numeric_*`` in the one Prometheus scrape, and the deploy
+router quarantines a (class, replica) placement on repeated NaN outcomes
+(docs/OBSERVABILITY.md "Numeric health").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+import warnings
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["NUMERIC_DRIFT", "NUMERIC_NAN", "PROBE_FIELDS", "ulp_band",
+           "state_probe_vector", "densmatr_probe_vector", "probe_dict",
+           "run_ops_probed", "epoch_pass_probes",
+           "NumericRecord", "NumericLedger", "global_numeric_ledger",
+           "inject_scale", "inject_nan", "inject_nonhermitian",
+           "corruption_selftest", "DEFAULT_SAFETY"]
+
+#: diagnostic code for measured drift outside the ulp-growth band
+#: (analysis CLI severity: WARNING — the obs taxonomy next to
+#: O_MODEL_DRIFT / O_SLO_BURN)
+NUMERIC_DRIFT = "O_NUMERIC_DRIFT"
+
+#: diagnostic code for NaN/Inf amplitudes observed by a probe (analysis
+#: CLI severity: ERROR — a poisoned register serves garbage to every
+#: downstream consumer); also the flight-ring dump reason
+NUMERIC_NAN = "O_NUMERIC_NAN"
+
+#: the probe vector layout, one fixed shape for statevectors and density
+#: matrices so every instrumented program signature is identical:
+#: ``norm`` is the L2 norm (total probability) for statevectors and the
+#: trace for density matrices; ``herm_dev`` is 0 for statevectors and the
+#: max |rho - rho^H| element for density matrices
+PROBE_FIELDS = ("norm", "max_amp2", "nan_count", "inf_count", "herm_dev")
+
+#: ulp-band safety factor: covers the rounding walk's constant and the
+#: accumulation-order spread of dense multi-target gates.  Chosen so the
+#: committed clean workloads (serve selftest, 17q QFT, random24) sit
+#: orders of magnitude inside the band in BOTH precisions while a 0.1%
+#: scale corruption overshoots it by >1e6 ulps
+DEFAULT_SAFETY = 128.0
+
+#: ledger retention, FIFO beyond this (mirrors obs/ledger.py: a
+#: long-running serve process must not grow the ledger without bound)
+_MAX_RECORDS = 1024
+
+_ACC = jnp.float64
+
+
+def ulp_band(num_ops: int, dtype, safety: float = DEFAULT_SAFETY) -> float:
+    """Allowed |norm - expected| after ``num_ops`` compiled passes in
+    ``dtype``: ``safety * eps * sqrt(D)`` — per-pass rounding random-walks
+    the norm by ~eps, so drift grows with the square root of depth, not
+    linearly (the linear bound would hide real miscompiles behind depth)."""
+    eps = float(jnp.finfo(jnp.dtype(dtype)).eps)
+    return float(safety) * eps * math.sqrt(max(1.0, float(num_ops)))
+
+
+@jax.jit
+def state_probe_vector(state: jax.Array) -> jax.Array:
+    """The (5,) probe vector of a (2, 2^n) SoA statevector — a pure
+    reduction grafted BESIDE the main dataflow (never into it): L2 norm
+    (ops/calc.py total_prob_statevec's accumulation discipline), max
+    |amp|^2, NaN count, Inf count, herm_dev=0.  Safe as an auxiliary
+    output of any compiled program: it reads the state and writes nothing,
+    so the primary output stays bit-identical."""
+    re, im = state[0].astype(_ACC), state[1].astype(_ACC)
+    mag2 = re * re + im * im
+    nan = jnp.sum((jnp.isnan(state[0]) | jnp.isnan(state[1]))
+                  .astype(jnp.int32)).astype(_ACC)
+    inf = jnp.sum((jnp.isinf(state[0]) | jnp.isinf(state[1]))
+                  .astype(jnp.int32)).astype(_ACC)
+    return jnp.stack([jnp.sum(mag2), jnp.max(mag2), nan, inf,
+                      jnp.zeros((), _ACC)])
+
+
+@partial(jax.jit, static_argnames=("num_qubits",))
+def densmatr_probe_vector(state: jax.Array, num_qubits: int) -> jax.Array:
+    """The (5,) probe vector of a Choi-flattened (2, 4^n) density matrix:
+    trace (ops/calc.py total_prob_densmatr's diagonal reduction), max
+    |rho_ij|^2, NaN/Inf counts, and the Hermiticity deviation
+    max |rho - rho^H| — the invariant ROADMAP item 4's fused noise
+    channels must provably preserve."""
+    from ..ops.measure import densmatr_diagonal
+    dim = 1 << num_qubits
+    re, im = state[0].astype(_ACC), state[1].astype(_ACC)
+    mag2 = re * re + im * im
+    nan = jnp.sum((jnp.isnan(state[0]) | jnp.isnan(state[1]))
+                  .astype(jnp.int32)).astype(_ACC)
+    inf = jnp.sum((jnp.isinf(state[0]) | jnp.isinf(state[1]))
+                  .astype(jnp.int32)).astype(_ACC)
+    trace = jnp.sum(densmatr_diagonal(state, num_qubits)[0].astype(_ACC))
+    # rho[r, c] lives at r + c*2^n (the getDensityAmp convention), so the
+    # (col, row)-shaped view's transpose is the adjoint's layout
+    mr = re.reshape(dim, dim)
+    mi = im.reshape(dim, dim)
+    herm = jnp.maximum(jnp.max(jnp.abs(mr - mr.T)),
+                       jnp.max(jnp.abs(mi + mi.T)))
+    return jnp.stack([trace, jnp.max(mag2), nan, inf, herm])
+
+
+def grafted_probe(state: jax.Array) -> jax.Array:
+    """:func:`state_probe_vector` behind an ``optimization_barrier`` — THE
+    graft point for instrumented programs.  The barrier stops XLA from
+    fusing the probe reduction into the kernels producing ``state`` (a
+    fused magnitude-sum inside a ``lax.map`` body was observed to perturb
+    the final gate's FMA contraction by one ulp), so the primary output
+    compiles exactly as if the probe were absent: the bit-identity
+    contract by construction, not by luck."""
+    return state_probe_vector(jax.lax.optimization_barrier(state))
+
+
+def probe_dict(vec) -> dict:
+    """Host-side dict view of a probe vector (floats, JSON-ready)."""
+    vec = np.asarray(vec, np.float64).ravel()
+    return {name: float(vec[i]) for i, name in enumerate(PROBE_FIELDS)}
+
+
+def run_ops_probed(state: jax.Array, ops: tuple):
+    """Probe-instrumented twin of circuit._run_ops: ONE jitted program
+    returning ``(final_state, probe_vector)`` — the probe is an auxiliary
+    output computed from the final state inside the same XLA program, the
+    primary output bit-identical to the uninstrumented run (the analysis
+    ``--numeric-report`` mode asserts exactly that)."""
+    return _run_ops_probed_jit(state, tuple(ops))
+
+
+@partial(jax.jit, static_argnames=("ops",))
+def _run_ops_probed_jit(state: jax.Array, ops: tuple):
+    from ..circuit import _run_ops_routed
+    out = _run_ops_routed(state, ops)
+    return out, grafted_probe(out)
+
+
+# ---------------------------------------------------------------------------
+# epoch-engine per-pass probe points
+# ---------------------------------------------------------------------------
+
+def _plane_probe(re: jax.Array, im: jax.Array) -> dict:
+    """Probe of (re, im) plane-pair storage.  Norm and NaN/Inf counts are
+    permutation-invariant, so a probe at any fused-pass boundary is valid
+    WITHOUT materializing the engine's deferred qubit map."""
+    r = re.astype(_ACC)
+    i = im.astype(_ACC)
+    mag2 = r * r + i * i
+    nan = int(jnp.sum((jnp.isnan(re) | jnp.isnan(im)).astype(jnp.int32)))
+    inf = int(jnp.sum((jnp.isinf(re) | jnp.isinf(im)).astype(jnp.int32)))
+    return {"norm": float(jnp.sum(mag2)), "max_amp2": float(jnp.max(mag2)),
+            "nan_count": nan, "inf_count": inf}
+
+
+@partial(jax.jit, static_argnames=("ops",))
+def _xla_segment_planes(re: jax.Array, im: jax.Array, ops: tuple):
+    """One jitted program per XLA fallback segment of an epoch plan — the
+    same fusion context the uninstrumented ``jit_program`` gives the
+    segment (``pallas_call`` boundaries are opaque to XLA fusion, so the
+    segment subgraph compiles identically standalone), where an EAGER
+    per-op chain could legally differ in the last ulp of FMA contraction
+    and fake a probe divergence."""
+    from ..circuit import _apply_one
+    st = jnp.stack([re, im])
+    for op in ops:
+        st = _apply_one(st, op)
+    return st[0], st[1]
+
+
+def epoch_pass_probes(ops: tuple, num_qubits: int, state: jax.Array):
+    """Run the epoch plan (ops/epoch_pallas.py) pass by pass with a probe
+    at every fused-pass boundary: one probe point per Pallas pass (block or
+    pack) and one per XLA fallback segment.  Returns ``(final_state,
+    points, plan_summary)`` where ``points`` is the ordered list of
+    ``{"pass": tag, "kind": ..., "norm": ..., ...}`` probe dicts.
+
+    The probe-point count equals ``plan.pallas_passes`` plus the number of
+    XLA segments — an independent runtime confirmation of the planner's
+    fused-pass boundaries (the plan said N HBM passes; N probes observed
+    N intermediate states).  The final state is bit-identical to the
+    uninstrumented ``jit_program`` run: the passes are the same aliased
+    kernels, probes only read the planes between them."""
+    from .. import _compat
+    from ..ops import epoch_pallas as _ep
+    from ..ops.apply import reconcile_perm_planes
+    ops = tuple(ops)
+    plan = _ep.plan_circuit(ops, num_qubits)
+    re, im = state[0], state[1]
+    points: list = []
+    idx = 0
+    for segment in plan.segments:
+        if segment.engine == "pallas":
+            for p in segment.passes:
+                with _compat.enable_x64(False):
+                    if p.kind == "block":
+                        re, im = _ep._run_block_pass(re, im, p)
+                    else:
+                        re, im = _ep._run_pack_pass(re, im, p)
+                points.append({"pass": idx, "kind": p.kind,
+                               **_plane_probe(re, im)})
+                idx += 1
+        else:
+            # whole segment as ONE jitted program, traced x64-off like
+            # jit_program: the fusion context matches the uninstrumented
+            # run, so bit-identity cannot break on multi-op segments
+            with _compat.enable_x64(False):
+                re, im = _xla_segment_planes(re, im, tuple(segment.ops))
+            points.append({"pass": idx, "kind": "xla",
+                           **_plane_probe(re, im)})
+            idx += 1
+    with _compat.enable_x64(False):
+        re, im = reconcile_perm_planes(re, im, plan.residual_perm)
+    return jnp.stack([re, im]), points, plan.summary()
+
+
+# ---------------------------------------------------------------------------
+# the numeric drift ledger
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class NumericRecord:
+    """One probed run's numeric-health row.  ``findings`` is empty when
+    every probe sits inside its band; ``probe_points`` carries the
+    per-pass probes of an epoch-instrumented run (empty otherwise)."""
+    label: str
+    kind: str                    # 'statevec' | 'densmatr'
+    engine: str
+    dtype: str
+    num_qubits: int | None
+    num_ops: int
+    class_key: str | None
+    norm: float
+    max_amp2: float
+    nan_count: int
+    inf_count: int
+    herm_dev: float
+    expected_norm: float
+    norm_drift: float
+    band: float
+    findings: tuple = ()
+    probe_points: tuple = ()
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def as_health(self) -> dict:
+        """The compact ``numeric_health`` payload a ServeResult / flight
+        record carries: the numbers plus the findings, no provenance."""
+        return {"norm": self.norm, "norm_drift": self.norm_drift,
+                "band": self.band, "max_amp2": self.max_amp2,
+                "nan_count": self.nan_count, "inf_count": self.inf_count,
+                "herm_dev": self.herm_dev, "findings": list(self.findings)}
+
+
+class NumericLedger:
+    """Thread-safe store of :class:`NumericRecord`; :meth:`record` runs
+    the NaN/drift checks and warns (``O_NUMERIC_NAN`` /
+    ``O_NUMERIC_DRIFT``) on any finding — the sibling of
+    obs/ledger.py's model-vs-measured Ledger, judging values instead of
+    wall clocks."""
+
+    def __init__(self, safety: float = DEFAULT_SAFETY):
+        self.safety = float(safety)
+        self._lock = threading.Lock()
+        self._records: list[NumericRecord] = []  # guarded-by: _lock
+        self.nan_total = 0                       # guarded-by: _lock
+        self.drift_total = 0                     # guarded-by: _lock
+        self.probed_total = 0                    # guarded-by: _lock
+
+    def record(self, label: str, probe, *, kind: str = "statevec",
+               engine: str = "xla", dtype="float64",
+               num_qubits: int | None = None, num_ops: int = 0,
+               class_key: str | None = None, expected_norm: float = 1.0,
+               probe_points=(), warn: bool = True) -> NumericRecord:
+        """Record one probed run.  ``probe`` is a probe vector
+        (:data:`PROBE_FIELDS` order) or its dict view.  NaN/Inf counts
+        are checked first (a poisoned norm is NaN itself); drift is then
+        judged against the precision-and-depth-derived band
+        :func:`ulp_band`; for density probes the Hermiticity deviation
+        is judged against the same band."""
+        p = probe if isinstance(probe, dict) else probe_dict(probe)
+        dtype_s = str(jnp.dtype(dtype)) if not isinstance(dtype, str) else dtype
+        # rounding drift is RELATIVE to the state's magnitude: a tenant's
+        # legitimately scaled input (expected norm S^2) accumulates
+        # ~S^2·eps·sqrt(D) of absolute drift, so the band scales with the
+        # baseline (floored at 1.0 — a tiny-norm state still gets the
+        # unit-scale band, not a vanishing one)
+        band = (ulp_band(num_ops, dtype_s, self.safety)
+                * max(1.0, abs(float(expected_norm))))
+        nan = int(p["nan_count"])
+        inf = int(p["inf_count"])
+        norm = float(p["norm"])
+        drift = abs(norm - float(expected_norm))
+        findings: list[str] = []
+        if nan or inf:
+            findings.append(
+                f"{NUMERIC_NAN}: {nan} NaN / {inf} Inf amplitude(s) in the "
+                f"{kind} result — the register is poisoned; every "
+                "downstream consumer of this class's results is serving "
+                "garbage")
+        else:
+            if not math.isfinite(drift) or drift > band:
+                findings.append(
+                    f"{NUMERIC_DRIFT}: {'trace' if kind == 'densmatr' else 'norm'} "
+                    f"{norm:.17g} drifted {drift:.3g} from "
+                    f"{expected_norm:.3g} (band {band:.3g} = "
+                    f"{self.safety:.0f} ulp(" + dtype_s + ") * sqrt("
+                    f"{max(num_ops, 1)})): a kernel is not norm-preserving "
+                    "on this backend (the ROADMAP item 3 symptom class)")
+            if kind == "densmatr" and float(p["herm_dev"]) > band:
+                findings.append(
+                    f"{NUMERIC_DRIFT}: Hermiticity deviation "
+                    f"{float(p['herm_dev']):.3g} exceeds the band "
+                    f"{band:.3g}: a density channel broke rho = rho^H")
+        rec = NumericRecord(label, kind, engine, dtype_s, num_qubits,
+                            int(num_ops), class_key, norm,
+                            float(p["max_amp2"]), nan, inf,
+                            float(p["herm_dev"]), float(expected_norm),
+                            float(drift), band, tuple(findings),
+                            tuple(probe_points))
+        with self._lock:
+            self._records.append(rec)
+            if len(self._records) > _MAX_RECORDS:
+                del self._records[:_MAX_RECORDS // 2]
+            self.probed_total += 1
+            if nan or inf:
+                self.nan_total += 1
+            self.drift_total += sum(NUMERIC_DRIFT in f for f in findings)
+        if warn:
+            for f in findings:
+                warnings.warn(f"[{label}] {f}", RuntimeWarning, stacklevel=2)
+        return rec
+
+    # -- reading ------------------------------------------------------------
+    def records(self) -> list[NumericRecord]:
+        with self._lock:
+            return list(self._records)
+
+    def as_dicts(self) -> list[dict]:
+        return [r.as_dict() for r in self.records()]
+
+    def by_class(self) -> dict:
+        """Per-structural-class aggregation: the scrape-side answer to
+        WHICH class went numerically bad (records without a class key
+        aggregate under ``"-"``)."""
+        out: dict = {}
+        for r in self.records():
+            ck = r.class_key or "-"
+            agg = out.setdefault(ck, {"count": 0, "nan_records": 0,
+                                      "drift_findings": 0,
+                                      "worst_drift": 0.0,
+                                      "worst_band": 0.0})
+            agg["count"] += 1
+            agg["nan_records"] += 1 if (r.nan_count or r.inf_count) else 0
+            agg["drift_findings"] += sum(NUMERIC_DRIFT in f
+                                         for f in r.findings)
+            if math.isfinite(r.norm_drift) and r.norm_drift > agg["worst_drift"]:
+                agg["worst_drift"] = r.norm_drift
+                agg["worst_band"] = r.band
+        return out
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"records": len(self._records),
+                    "probed_total": self.probed_total,
+                    "nan_total": self.nan_total,
+                    "drift_total": self.drift_total}
+
+    def gauges(self) -> dict:
+        """Flat numeric view for the one Prometheus scrape (the service
+        splices these as ``quest_serve_numeric_ledger_*``)."""
+        return {k: float(v) for k, v in self.snapshot().items()}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records = []
+            self.nan_total = 0
+            self.drift_total = 0
+            self.probed_total = 0
+
+
+_GLOBAL: NumericLedger | None = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def global_numeric_ledger() -> NumericLedger:
+    """The process-wide numeric ledger — the ``--numeric-report`` CLI and
+    the bench rows record here.  Services own a PRIVATE ledger by default
+    (their scrape attributes findings to the right replica); pass
+    ``QuESTService(numeric_ledger=global_numeric_ledger())`` to opt a
+    service into the shared one."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = NumericLedger()
+        return _GLOBAL
+
+
+# ---------------------------------------------------------------------------
+# adversarial corruption injections (the mutation-harness pattern)
+# ---------------------------------------------------------------------------
+
+def inject_scale(state, factor: float = 1.001) -> np.ndarray:
+    """A uniformly scaled state: the norm leaves the ulp band while every
+    amplitude stays finite — the shape of a lost renormalization or a
+    miscompiled kernel that is 'almost' unitary."""
+    return np.asarray(state) * float(factor)
+
+
+def inject_nan(state, index: int = 0) -> np.ndarray:
+    """One NaN-poisoned amplitude — the shape of an uninitialized buffer
+    or a 0/0 in a collapsed-outcome renormalization."""
+    out = np.array(state, copy=True)
+    out[0, index] = np.nan
+    return out
+
+
+def inject_nonhermitian(state, num_qubits: int,
+                        eps: float = 1e-3) -> np.ndarray:
+    """A one-sided off-diagonal perturbation of a Choi-flattened density
+    matrix: rho[0, 1] moves, rho[1, 0] does not — trace preserved,
+    Hermiticity broken (the invariant ROADMAP item 4's fused channels
+    must keep)."""
+    out = np.array(state, copy=True)
+    dim = 1 << num_qubits
+    out[0, 0 + 1 * dim] += eps      # rho[r=0, c=1] at r + c*2^n
+    return out
+
+
+def corruption_selftest(ledger: NumericLedger | None = None,
+                        num_qubits: int = 4) -> dict:
+    """Prove the ledger can actually fail: each injected corruption MUST
+    trip it (zero findings on the clean twins, >= 1 on every corrupted
+    one).  Returns ``{"ok": bool, "trips": {...}}``; gated in the serve
+    selftest and the CI ``numeric-selftest`` job — a numeric gate that
+    cannot catch a planted corruption is not a gate."""
+    led = ledger if ledger is not None else NumericLedger()
+    n = num_qubits
+    state = np.zeros((2, 1 << n))
+    state[0, 0] = 1.0
+    rho = np.zeros((2, 1 << (2 * n)))
+    for k in range(1 << n):
+        rho[0, k + (k << n)] = 1.0 / (1 << n)   # maximally mixed, Tr = 1
+
+    def probe_sv(arr):
+        return state_probe_vector(jnp.asarray(arr))
+
+    def probe_dm(arr):
+        return densmatr_probe_vector(jnp.asarray(arr), n)
+
+    trips = {}
+    clean_sv = led.record("clean_statevec", probe_sv(state), num_ops=4,
+                          warn=False)
+    clean_dm = led.record("clean_densmatr", probe_dm(rho), kind="densmatr",
+                          num_qubits=n, num_ops=4, warn=False)
+    scaled = led.record("inject_scale", probe_sv(inject_scale(state)),
+                        num_ops=4, warn=False)
+    nan = led.record("inject_nan", probe_sv(inject_nan(state)), num_ops=4,
+                     warn=False)
+    herm = led.record("inject_nonhermitian",
+                      probe_dm(inject_nonhermitian(rho, n)),
+                      kind="densmatr", num_qubits=n, num_ops=4, warn=False)
+    trips["clean_statevec"] = len(clean_sv.findings)
+    trips["clean_densmatr"] = len(clean_dm.findings)
+    trips["inject_scale"] = len(scaled.findings)
+    trips["inject_nan"] = len(nan.findings)
+    trips["inject_nonhermitian"] = len(herm.findings)
+    ok = (trips["clean_statevec"] == 0 and trips["clean_densmatr"] == 0
+          and trips["inject_scale"] >= 1 and trips["inject_nan"] >= 1
+          and trips["inject_nonhermitian"] >= 1
+          and any(NUMERIC_NAN in f for f in nan.findings)
+          and any(NUMERIC_DRIFT in f for f in scaled.findings)
+          and any(NUMERIC_DRIFT in f for f in herm.findings))
+    return {"ok": bool(ok), "trips": trips}

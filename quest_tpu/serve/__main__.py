@@ -7,7 +7,9 @@ oracle, a >= 0.9 cache hit rate and a well-formed Prometheus export, then
 printing the metrics.  ``--json`` switches stdout to ONE machine-readable
 document (``{"ok":, "checks":, "metrics":, "prometheus":,
 "flight_recorder":, "slo":}`` plus ``"trace"`` under ``--trace``: the
-merged multi-track Chrome trace from obs/aggregate.py) for the CI gate.
+merged multi-track Chrome trace from obs/aggregate.py; plus ``"numeric"``
+under ``--probes`` / ``QUEST_TPU_NUMERIC_PROBES=1``: the numeric drift
+ledger + injected-corruption trip from obs/numerics.py) for the CI gate.
 Exit status 0 iff every check passed.
 """
 
@@ -35,13 +37,20 @@ def main(argv=None) -> int:
                              "(quest_tpu/obs) and export/validate the "
                              "Chrome-trace JSON; QUEST_TPU_TRACE=1 does "
                              "the same")
+    parser.add_argument("--probes", action="store_true",
+                        help="serve the workload through the numeric-"
+                             "probe-instrumented programs (quest_tpu/obs/"
+                             "numerics.py) and gate the numeric-health "
+                             "checks; QUEST_TPU_NUMERIC_PROBES=1 does "
+                             "the same")
     args = parser.parse_args(argv)
     if not args.selftest:
         parser.print_usage()
         return 2
     from .selftest import run_selftest
     return run_selftest(as_json=args.as_json, scale=max(1, args.scale),
-                        trace=True if args.trace else None)
+                        trace=True if args.trace else None,
+                        probes=True if args.probes else None)
 
 
 if __name__ == "__main__":

@@ -48,40 +48,49 @@ def group_ready(queue, key, max_batch: int) -> list:
 
 
 def execute_group(cache, entry, requests, state_factory, max_batch: int,
-                  mode: str = "map"):
-    """Run one same-class microbatch; returns ``(states, batch)`` where
-    ``states`` is a list of per-request (2, 2^n) device arrays in request
-    order and ``batch`` the padded batch size executed (1 for the singleton
-    fall-through).
+                  mode: str = "map", probes: bool = False):
+    """Run one same-class microbatch; returns ``(states, probes, batch)``
+    where ``states`` is a list of per-request (2, 2^n) device arrays in
+    request order, ``probes`` the matching list of numeric probe vectors
+    (``None`` when probing is off — obs/numerics.py), and ``batch`` the
+    padded batch size executed (1 for the singleton fall-through).
 
     Singletons skip vmap entirely — a lone request runs the class's plain
     single program (no batch-shaped compile for a class that never
     batches).  Groups pad to :func:`bucket_size` and run broadcast or
     stacked depending on whether any request carries its own initial
-    state."""
+    state.  ``probes=True`` routes through the probe-instrumented program
+    variants (cache.py ``*_probed_program``): same lowering, one auxiliary
+    probe output, primary outputs bit-identical."""
     m = len(requests)
     assert m >= 1
     if m == 1:
         req = requests[0]
         state = state_factory(req)
-        out = cache.single_program(entry, state).call(
-            state, cache._check_params(entry, req.params))
-        return [out], 1
+        params = cache._check_params(entry, req.params)
+        if probes:
+            out, pv = cache.single_probed_program(entry, state).call(
+                state, params)
+            return [out], [pv], 1
+        out = cache.single_program(entry, state).call(state, params)
+        return [out], None, 1
     batch = bucket_size(m, max_batch)
     pvec = [np.asarray(r.params, np.float64).ravel() for r in requests]
     pvec += [pvec[-1]] * (batch - m)
     pb = jnp.asarray(np.stack(pvec))
     stacked = any(r.initial_state is not None for r in requests)
+    compile_prog = cache.batch_probed_program if probes else cache.batch_program
     if stacked:
         states = [state_factory(r) for r in requests]
         states += [states[-1]] * (batch - m)
         sb = jnp.stack(states)
-        prog = cache.batch_program(entry, states[0], batch, stacked=True,
-                                   mode=mode)
+        prog = compile_prog(entry, states[0], batch, stacked=True, mode=mode)
         outs = prog.call(sb, pb)
     else:
         state = state_factory(requests[0])
-        prog = cache.batch_program(entry, state, batch, stacked=False,
-                                   mode=mode)
+        prog = compile_prog(entry, state, batch, stacked=False, mode=mode)
         outs = prog.call(state, pb)
-    return [outs[i] for i in range(m)], batch
+    if probes:
+        outs, pvs = outs
+        return [outs[i] for i in range(m)], [pvs[i] for i in range(m)], batch
+    return [outs[i] for i in range(m)], None, batch

@@ -380,25 +380,57 @@ class CompileCache:
                     self.stats["persist_saves"] += 1
         return p
 
+    @staticmethod
+    def _lifted_one(entry: CacheEntry, probes: bool):
+        """The per-request ``(state, params) -> out`` body every lifted
+        program variant lowers: ONE definition, so the probed and plain
+        twins can never desynchronize on the gate chain.  ``probes=True``
+        adds the numeric probe (obs/numerics.py) as an auxiliary output
+        behind its optimization barrier — a pure reduction grafted beside
+        the main dataflow, so the primary output is bit-identical to the
+        unprobed lowering (pinned in tier-1 for every engine path)."""
+        skeleton, offsets = entry.skeleton, entry.offsets
+
+        def one(st, params):
+            out = _circ._run_ops_routed(st, skeleton, params, offsets)
+            if probes:
+                from ..obs import numerics as _num
+                return out, _num.grafted_probe(out)
+            return out
+
+        return one
+
     def single_program(self, entry: CacheEntry, state, *,
-                       donate: bool = False) -> _Program:
+                       donate: bool = False,
+                       probes: bool = False) -> _Program:
         """The class's ``(state, params) -> state`` executable for this
-        state signature."""
+        state signature; ``probes=True`` compiles the probe-instrumented
+        variant ``-> (state, probe_vec)`` under its own tag (byte budget
+        and persistent store govern it like any other signature).
+        Probed programs are never donating (the serving path that probes
+        does not donate)."""
         assert entry.skeleton is not None, "opaque (overlap) entries have no lifted program"
-        tag = ("single", bool(donate), _state_sig(state))
-        skeleton, offsets, n_par = entry.skeleton, entry.offsets, entry.num_params
+        assert not (donate and probes), "probed programs are not donating"
+        tag = (("single_probed", _state_sig(state)) if probes
+               else ("single", bool(donate), _state_sig(state)))
+        n_par = entry.num_params
+        one = self._lifted_one(entry, probes)
 
         def build():
-            def run(st, params):
-                return _circ._run_ops_routed(st, skeleton, params, offsets)
-            jfn = jax.jit(run, donate_argnums=(0,) if donate else ())
+            jfn = jax.jit(one, donate_argnums=(0,) if donate else ())
             pav = jax.ShapeDtypeStruct((n_par,), jnp.float64)
             return jfn.lower(state, pav).compile()
 
         return self._get_program(entry, tag, build)
 
+    def single_probed_program(self, entry: CacheEntry, state) -> _Program:
+        """Probe-instrumented twin of :meth:`single_program` (same
+        lowering via ``probes=True``)."""
+        return self.single_program(entry, state, probes=True)
+
     def batch_program(self, entry: CacheEntry, state, batch: int, *,
-                      stacked: bool = False, mode: str = "map") -> _Program:
+                      stacked: bool = False, mode: str = "map",
+                      probes: bool = False) -> _Program:
         """The microbatch executable: params stacked on axis 0, initial
         state broadcast (``stacked=False``, the shared-|0..0> fast path) or
         per-request (``stacked=True``).  ``state`` is the UNBATCHED
@@ -411,17 +443,20 @@ class CompileCache:
         program — on dense-gate circuits XLA's batched FMA fusion can
         differ from the unbatched codegen in the LAST ULP (measured ~4e-17
         on f64 CPU), so it trades the bit-identity guarantee for
-        throughput; see docs/SERVING.md."""
+        throughput; see docs/SERVING.md.
+
+        ``probes=True`` compiles the probe-instrumented variant through
+        the SAME three-way lowering with a per-request probe vector as
+        the second output — ``(states, probes)`` stacked on axis 0."""
         assert entry.skeleton is not None
         if mode not in ("map", "vmap"):
             raise ValueError(f"batch mode must be 'map' or 'vmap', got {mode!r}")
-        tag = ("batch", int(batch), bool(stacked), mode, _state_sig(state))
-        skeleton, offsets, n_par = entry.skeleton, entry.offsets, entry.num_params
+        tag = ("batch_probed" if probes else "batch", int(batch),
+               bool(stacked), mode, _state_sig(state))
+        n_par = entry.num_params
+        one = self._lifted_one(entry, probes)
 
         def build():
-            def one(st, params):
-                return _circ._run_ops_routed(st, skeleton, params, offsets)
-
             if mode == "vmap":
                 def run(st, pb):
                     return jax.vmap(one, in_axes=(0 if stacked else None, 0))(st, pb)
@@ -438,6 +473,14 @@ class CompileCache:
             return jax.jit(run).lower(sav, pav).compile()
 
         return self._get_program(entry, tag, build)
+
+    def batch_probed_program(self, entry: CacheEntry, state, batch: int, *,
+                             stacked: bool = False,
+                             mode: str = "map") -> _Program:
+        """Probe-instrumented twin of :meth:`batch_program` (same
+        lowering via ``probes=True``)."""
+        return self.batch_program(entry, state, batch, stacked=stacked,
+                                  mode=mode, probes=True)
 
     def overlap_program(self, entry: CacheEntry, ops: tuple, *,
                         donate: bool = False) -> _Program:

@@ -75,7 +75,8 @@ def _check(checks: dict, name: str, ok: bool, detail: str = "") -> bool:
 
 
 def run_selftest(as_json: bool = False, scale: int = 1,
-                 trace: bool | None = None) -> int:
+                 trace: bool | None = None,
+                 probes: bool | None = None) -> int:
     """Run the workload through fresh services sharing one fresh cache;
     print metrics (human text, or ONE JSON document with ``--json``).
     Returns the process exit status: 0 iff every check passed.
@@ -93,7 +94,19 @@ def run_selftest(as_json: bool = False, scale: int = 1,
     flight-recorder ring (``"flight_recorder"``) and the windowed SLO view
     (``"slo"``: per-class latency, deadline hit rate + burn rate, queue
     saturation — obs/slo.py) are included unconditionally — both are
-    always on."""
+    always on.
+
+    ``probes=True`` (or ``QUEST_TPU_NUMERIC_PROBES=1``) serves the whole
+    workload through the probe-instrumented program variants
+    (obs/numerics.py): the document grows a ``"numeric"`` block (ledger
+    totals, per-class aggregation, the injected-corruption trip) and three
+    checks — ``numeric_clean`` (zero NaN/drift findings on the clean
+    workload), ``numeric_attached`` (every result carries its
+    numeric_health record) and ``numeric_corruption_trips`` (each planted
+    corruption trips the ledger) — the ci.yml ``numeric-selftest``
+    contract.  The existing bit-identity check doubles as the
+    instrumented-vs-uninstrumented proof: probed results are compared
+    against the UNPROBED serial oracle."""
     import os
 
     import jax
@@ -116,6 +129,11 @@ def run_selftest(as_json: bool = False, scale: int = 1,
     if trace:
         _obs.enable_tracing()
         _obs.reset_tracing()
+    if probes is None:
+        probes = os.environ.get("QUEST_TPU_NUMERIC_PROBES") == "1"
+
+    from ..obs import numerics as _num
+    numeric_ledger = _num.NumericLedger() if probes else None
 
     cache = CompileCache()
     checks: dict = {}
@@ -128,6 +146,7 @@ def run_selftest(as_json: bool = False, scale: int = 1,
     # the slo_clean gate reads it
     svc = QuESTService(max_batch=16, max_delay_ms=10, seed=_SEED,
                        cache=cache, slo=SLOConfig(window_s=3600.0),
+                       probes=probes, numeric_ledger=numeric_ledger,
                        start=False)
     submitted = []  # (label, circuit, shots, future)
     classes = workload_classes(scale)
@@ -155,7 +174,8 @@ def run_selftest(as_json: bool = False, scale: int = 1,
     if len(jax.devices()) >= 8:
         from ..circuit import qft_circuit
         svc_mesh = QuESTService(num_devices=8, max_batch=8, max_delay_ms=10,
-                                seed=_SEED, cache=cache, start=False)
+                                seed=_SEED, cache=cache, probes=probes,
+                                numeric_ledger=numeric_ledger, start=False)
         mesh_circ = qft_circuit(12)
         mesh_futs = [svc_mesh.submit(qft_circuit(12)) for _ in range(8)]
         svc_mesh.start()
@@ -258,6 +278,39 @@ def run_selftest(as_json: bool = False, scale: int = 1,
                  f"{len(slo['classes'])} windowed class(es), "
                  f"{len(slo['warnings'])} warning(s)")
 
+    numeric_doc = None
+    if probes:
+        # the numeric-health gate (obs/numerics.py; ci.yml
+        # numeric-selftest): the CLEAN workload must record zero NaN and
+        # zero drift findings with every result carrying its
+        # numeric_health record — and the ledger must provably be able to
+        # fail: each injected corruption (scaled state, NaN-poisoned
+        # amplitude, non-Hermitian density perturbation) trips it on a
+        # throwaway ledger (the PR 3/12 mutation-harness pattern)
+        snap_n = numeric_ledger.snapshot()
+        ok &= _check(checks, "numeric_clean",
+                     snap_n["nan_total"] == 0 and snap_n["drift_total"] == 0
+                     and snap_n["probed_total"] >= len(submitted),
+                     f"{snap_n['probed_total']} probed request(s), "
+                     f"{snap_n['nan_total']} NaN, {snap_n['drift_total']} "
+                     "drift finding(s)")
+        attached = [f.result(timeout=60).numeric_health
+                    for _, _, _, f in submitted
+                    if f.exception() is None]
+        ok &= _check(checks, "numeric_attached",
+                     len(attached) == len(submitted)
+                     and all(h is not None and not h["findings"]
+                             for h in attached),
+                     f"{sum(h is not None for h in attached)} of "
+                     f"{len(submitted)} results carry a clean "
+                     "numeric_health record")
+        trip = _num.corruption_selftest()
+        ok &= _check(checks, "numeric_corruption_trips", trip["ok"],
+                     json.dumps(trip["trips"]))
+        numeric_doc = {"ledger": snap_n,
+                       "by_class": numeric_ledger.by_class(),
+                       "corruption": trip}
+
     trace_doc = None
     if trace:
         # export THROUGH the cross-process merge (obs/aggregate.py): the
@@ -283,6 +336,8 @@ def run_selftest(as_json: bool = False, scale: int = 1,
     if as_json:
         doc = {"ok": bool(ok), "checks": checks, "metrics": metrics,
                "prometheus": prom, "flight_recorder": flight, "slo": slo}
+        if numeric_doc is not None:
+            doc["numeric"] = numeric_doc
         if trace_doc is not None:
             doc["trace"] = trace_doc
         print(json.dumps(doc, default=float))

@@ -26,6 +26,8 @@ docs/SERVING.md).
 from __future__ import annotations
 
 import dataclasses
+import math
+import os
 import threading
 import time
 from concurrent.futures import Future, InvalidStateError
@@ -36,6 +38,7 @@ import numpy as np
 
 from .. import circuit as _circ
 from .. import obs as _obs
+from ..obs import numerics as _numerics
 from ..obs.export import EXECUTION_SPAN
 from ..obs.flight import FlightRecorder
 from ..obs.slo import SLOConfig, SLOMonitor
@@ -57,12 +60,17 @@ class ServeResult:
     the batch context it executed in.  ``cache_outcome`` reports whether
     this request's class lookup hit or missed the compile cache — the
     affinity feedback the deployment router (quest_tpu/deploy/router.py)
-    re-places on when a replica evicts a class under byte pressure."""
+    re-places on when a replica evicts a class under byte pressure.
+    ``numeric_health`` (probed requests only) is the numeric-probe record
+    of THIS request's result — norm drift vs the ulp band, NaN/Inf
+    counts, findings (obs/numerics.py); the router quarantines a (class,
+    replica) placement on repeated NaN outcomes read from here."""
     state: np.ndarray
     samples: np.ndarray | None
     batch_size: int
     request_id: int
     cache_outcome: str | None = None
+    numeric_health: dict | None = None
 
 
 @dataclasses.dataclass
@@ -77,7 +85,9 @@ class _Request:
     future: Future
     enqueue_t: float
     group_key: tuple
-    class_key: str = ""             # obs.key_hash(group_key), for SLO/trace
+    class_key: str = ""             # obs.key_hash(structural part), for SLO/trace
+    probes: bool = False            # numeric-probe-instrumented execution
+    expected_norm: float = 1.0      # drift baseline: the input state's norm
 
 
 class QuESTService:
@@ -101,6 +111,8 @@ class QuESTService:
                  metrics: Metrics | None = None,
                  flight_capacity: int = 256,
                  slo: SLOMonitor | SLOConfig | None = None,
+                 probes: bool | None = None,
+                 numeric_ledger: "_numerics.NumericLedger | None" = None,
                  start: bool = True):
         if batch_mode not in ("map", "vmap"):
             raise ValueError(
@@ -128,6 +140,20 @@ class QuESTService:
         # deadline hit rate and burn-rate early warning — always on, like
         # the metrics registry (one deque append per completed request)
         self.slo = slo if isinstance(slo, SLOMonitor) else SLOMonitor(slo)
+        # numeric-health probes (quest_tpu/obs/numerics.py): opt-in per
+        # service (or fleet-wide via QUEST_TPU_NUMERIC_PROBES=1), with a
+        # per-submit override; probed requests execute the instrumented
+        # program variant and record into the numeric drift ledger
+        if probes is None:
+            probes = os.environ.get("QUEST_TPU_NUMERIC_PROBES") == "1"
+        self.default_probes = bool(probes)
+        # a PRIVATE ledger per service (unless injected): the scrape and
+        # metrics_dict splice this ledger's totals, and attributing
+        # another component's findings to this service would point an
+        # operator's alert at the wrong replica (the process-global
+        # ledger remains the CLI/bench recording target)
+        self.numeric_ledger = (numeric_ledger if numeric_ledger is not None
+                               else _numerics.NumericLedger())
         self._sharding = None
         if num_devices is not None and num_devices > 1:
             from ..parallel.mesh import amp_sharding, make_amps_mesh
@@ -235,7 +261,7 @@ class QuESTService:
     # -- submission ---------------------------------------------------------
     def submit(self, circuit, params=None, shots: int = 0,
                deadline_ms: float | None = None,
-               initial_state=None) -> Future:
+               initial_state=None, probes: bool | None = None) -> Future:
         """Enqueue one request; the Future resolves to a
         :class:`ServeResult` (or raises ``QuESTError`` for deadline expiry,
         or whatever the execution raised).
@@ -244,7 +270,11 @@ class QuESTService:
         multi-tenant idiom: ONE recorded ansatz object, per-user angles) —
         it must match the structural class's operand count.  ``shots``
         joint outcomes over all qubits are drawn from the request's private
-        RNG stream.  ``deadline_ms`` is relative to submission."""
+        RNG stream.  ``deadline_ms`` is relative to submission.
+        ``probes`` overrides the service's numeric-probe default for this
+        request: a probed request runs the probe-instrumented program
+        variant (primary output bit-identical) and carries a
+        ``numeric_health`` record on its result and flight record."""
         if not isinstance(circuit, _circ.Circuit):
             raise TypeError(f"submit takes a Circuit, got {type(circuit)!r}")
         ops = circuit.key()
@@ -272,9 +302,25 @@ class QuESTService:
             raise ValueError("shots must be >= 0")
         now = time.monotonic()
         deadline = None if deadline_ms is None else now + float(deadline_ms) / 1000.0
+        probed = self.default_probes if probes is None else bool(probes)
+        # the probe flag is part of the BATCHING key (a probed and an
+        # unprobed request run different compiled programs and must not
+        # co-batch) but NOT of the class identity the SLO monitor, the
+        # flight ring and the router aggregate on — probing is an
+        # observability mode, not a different workload class
         group_key = (circuit.num_qubits, circuit.key(structural=True),
-                     state0 is None)
-        class_key = _obs.key_hash(group_key)
+                     state0 is None, probed)
+        class_key = _obs.key_hash(group_key[:3])
+        # the numeric drift baseline is the REQUEST'S OWN input norm: a
+        # caller-supplied initial state need not be unit-norm (only the
+        # shape is validated above), and judging it against 1.0 would
+        # report the tenant's scaling as a kernel miscompile.  Computed
+        # HERE, on the submitter's thread — a per-request constant has no
+        # business on the worker's latency-critical result loop
+        expected_norm = 1.0
+        if probed and state0 is not None:
+            s0 = state0.astype(np.float64, copy=False)
+            expected_norm = float(np.sum(s0[0] * s0[0] + s0[1] * s0[1]))
         t0p = time.perf_counter()
         fut: Future = Future()
         with self._cond:
@@ -296,7 +342,8 @@ class QuESTService:
                 self._next_rid += 1
                 self._queue.append(_Request(rid, ops, circuit.num_qubits,
                                             pvec, shots, deadline, state0,
-                                            fut, now, group_key, class_key))
+                                            fut, now, group_key, class_key,
+                                            probed, expected_norm))
                 depth = len(self._queue)
                 self.metrics.inc("requests_submitted_total")
                 self.metrics.set_gauge("queue_depth", depth)
@@ -424,16 +471,23 @@ class QuESTService:
                                                       req.num_qubits,
                                                       self._options)
                     outcomes[req.rid] = notes.get("cache_outcome", "miss")
+                probed = live[0].probes   # group key includes the flag
                 t0 = time.perf_counter()
                 if entry.skeleton is None:
-                    # opaque overlapped class (PR 4): per-request programs
+                    # opaque overlapped class (PR 4): per-request programs.
+                    # The program is opaque, so the probe runs as a
+                    # separate pure reduction over the finished state —
+                    # same values, one extra dispatch (documented in
+                    # docs/OBSERVABILITY.md "Numeric health")
                     states = [self._cache.overlap_program(entry, req.ops)
                               .call(self._state(req)) for req in live]
                     padded = len(live)
+                    probe_vecs = ([_numerics.state_probe_vector(st)
+                                   for st in states] if probed else None)
                 else:
-                    states, padded = _batch.execute_group(
+                    states, probe_vecs, padded = _batch.execute_group(
                         self._cache, entry, live, self._state,
-                        self.max_batch, mode=self.batch_mode)
+                        self.max_batch, mode=self.batch_mode, probes=probed)
                 jax.block_until_ready(states[-1])
                 dt = time.perf_counter() - t0
                 class_key = _obs.key_hash(entry.skey)
@@ -445,7 +499,13 @@ class QuESTService:
             if padded > len(live):
                 self.metrics.inc("padded_requests_total", padded - len(live))
             done_t = time.monotonic()
-            for req, st in zip(live, states):
+            nan_dumped = False
+            # ONE device-to-host transfer for the whole batch's probe
+            # vectors: per-row np.asarray in the loop below would issue
+            # one D2H sync per request on the latency-critical path
+            probe_host = (np.asarray(jnp.stack(probe_vecs))
+                          if probed else None)
+            for i, (req, st) in enumerate(zip(live, states)):
                 # the per-request execution span: the trace's link from a
                 # request_id to what ran for it (class, engine, cache
                 # outcome, batch) — the correlation contract
@@ -456,11 +516,36 @@ class QuESTService:
                     engine=entry.options.engine, cache=outcomes[req.rid],
                     batch=batch_id, batch_size=len(live),
                     queue_wait_s=round(done_t - dt - req.enqueue_t, 6))
+                health = None
+                if probed:
+                    # the numeric ledger judges the probe (NaN/Inf first,
+                    # then drift vs the depth-derived ulp band) and keeps
+                    # the per-class aggregation the scrape reports; the
+                    # drift baseline was fixed at submit time (the
+                    # request's own input norm)
+                    rec = self.numeric_ledger.record(
+                        class_key, probe_host[i],
+                        engine=entry.options.engine, dtype=str(st.dtype),
+                        num_qubits=req.num_qubits, num_ops=len(req.ops),
+                        class_key=class_key,
+                        expected_norm=req.expected_norm, warn=False)
+                    health = rec.as_health()
+                    self.metrics.inc("numeric_probed_total")
+                    self.metrics.set_gauge(
+                        "numeric_last_norm_drift",
+                        rec.norm_drift if math.isfinite(rec.norm_drift)
+                        else -1.0)
+                    if rec.nan_count or rec.inf_count:
+                        self.metrics.inc("numeric_nan_total")
+                    if any(_numerics.NUMERIC_DRIFT in f
+                           for f in rec.findings):
+                        self.metrics.inc("numeric_drift_total")
                 samples = self._sample(st, req) if req.shots else None
                 try:
                     req.future.set_result(ServeResult(np.asarray(st), samples,
                                                       len(live), req.rid,
-                                                      outcomes[req.rid]))
+                                                      outcomes[req.rid],
+                                                      health))
                 except InvalidStateError:
                     self.flight_recorder.resolve(req.rid, "cancelled",
                                                  batch_id=batch_id)
@@ -471,7 +556,17 @@ class QuESTService:
                 completed.add(req.rid)
                 self.flight_recorder.resolve(
                     req.rid, "ok", batch_id=batch_id,
-                    wait_s=done_t - dt - req.enqueue_t, exec_s=dt)
+                    wait_s=done_t - dt - req.enqueue_t, exec_s=dt,
+                    numeric_health=health)
+                if (health is not None and not nan_dumped
+                        and (health["nan_count"] or health["inf_count"])):
+                    # a poisoned register is as much a "something is wrong
+                    # NOW" moment as a queue bounce: dump the ring ONCE on
+                    # the first NaN/Inf outcome in a batch (after the
+                    # resolve above, so the dump shows this record's
+                    # numeric_health), not once per poisoned request
+                    nan_dumped = True
+                    self.flight_recorder.dump(_numerics.NUMERIC_NAN)
                 self.metrics.inc("requests_completed_total")
                 self.metrics.observe("request_latency_seconds",
                                      done_t - req.enqueue_t)
@@ -542,6 +637,8 @@ class QuESTService:
         d["cache_hit_rate"] = d["cache"]["hit_rate"]
         d["obs"] = self._obs_gauges()
         d["slo"] = self.slo.snapshot()
+        d["numeric"] = self.numeric_ledger.snapshot()
+        d["numeric"]["by_class"] = self.numeric_ledger.by_class()
         return d
 
     def _obs_gauges(self) -> dict:
@@ -559,4 +656,9 @@ class QuESTService:
                  if isinstance(v, (int, float))}
         extra.update({f"obs_{k}": v for k, v in self._obs_gauges().items()})
         extra.update({f"slo_{k}": v for k, v in self.slo.gauges().items()})
+        # the numeric-health gauges of the ONE scrape (quest_serve_numeric_*):
+        # ledger totals spliced point-in-time, next to the registry's
+        # numeric_probed/nan/drift counters
+        extra.update({f"numeric_ledger_{k}": v
+                      for k, v in self.numeric_ledger.gauges().items()})
         return self.metrics.to_prometheus(extra_gauges=extra)

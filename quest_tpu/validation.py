@@ -375,6 +375,18 @@ def validate_vector(v, func=None):
         _throw(ErrorCode.ZERO_VECTOR, func)
 
 
+def validate_qureg_init(qureg, func=None):
+    """The register still owns amplitude storage (ref analogue: QuEST's
+    validateQuregAllocation) — a destroyed register (destroyQureg) has
+    neither the stacked array nor plane-pair storage.  The numeric-health
+    helpers (calc_total_prob & co.) guard on this so probing a dead
+    register raises ``E_QUREG_NOT_INITIALISED`` instead of an
+    AttributeError from subscripting None."""
+    if (getattr(qureg, "_amps", None) is None
+            and getattr(qureg, "_planes", None) is None):
+        _throw(ErrorCode.QUREG_NOT_INITIALISED, func)
+
+
 def validate_state_vec_qureg(qureg, func=None):
     if qureg.is_density_matrix:
         _throw(ErrorCode.DEFINED_ONLY_FOR_STATEVECS, func)

@@ -1,0 +1,529 @@
+"""Numeric-health telemetry (quest_tpu/obs/numerics.py + the serve/deploy
+wiring): probe kernels, the ulp-band drift ledger, the bit-identity
+contract of probe-instrumented programs on every engine path, the serve
+integration (numeric_health records, NaN flight dumps, the one scrape),
+the deploy router's NaN quarantine, and the calc_total_prob API parity
+surface."""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import quest_tpu as qt
+from quest_tpu.circuit import qft_circuit, random_circuit
+from quest_tpu.obs import numerics as num
+from quest_tpu.serve import CompileCache, QuESTService
+from quest_tpu.serve.cache import CacheOptions
+from quest_tpu.serve.selftest import vqe_ansatz
+from quest_tpu.validation import ErrorCode, QuESTError
+
+
+def _zero_state(n, dtype=jnp.float64):
+    return jnp.zeros((2, 1 << n), dtype).at[0, 0].set(1.0)
+
+
+# ---------------------------------------------------------------------------
+# probe kernels
+# ---------------------------------------------------------------------------
+
+class TestProbeKernels:
+    def test_statevec_probe_of_basis_state(self):
+        p = num.probe_dict(num.state_probe_vector(_zero_state(4)))
+        assert p["norm"] == pytest.approx(1.0, abs=1e-15)
+        assert p["max_amp2"] == pytest.approx(1.0, abs=1e-15)
+        assert p["nan_count"] == 0 and p["inf_count"] == 0
+        assert p["herm_dev"] == 0.0
+
+    def test_statevec_probe_counts_nan_and_inf(self):
+        st = np.zeros((2, 16))
+        st[0, 0] = 1.0
+        st[0, 3] = np.nan
+        st[1, 5] = np.inf
+        p = num.probe_dict(num.state_probe_vector(jnp.asarray(st)))
+        assert p["nan_count"] == 1
+        assert p["inf_count"] == 1
+
+    def test_densmatr_probe_trace_and_hermiticity(self, env_local):
+        q = qt.createDensityQureg(3, env_local)
+        qt.hadamard(q, 0)
+        qt.controlledNot(q, 0, 1)
+        qt.mixDamping(q, 1, 0.3)
+        p = num.probe_dict(num.densmatr_probe_vector(q.amps, 3))
+        assert p["norm"] == pytest.approx(1.0, abs=1e-12)   # trace
+        assert p["herm_dev"] < 1e-12
+        assert p["nan_count"] == 0
+        qt.destroyQureg(q, env_local)
+
+    def test_densmatr_probe_detects_nonhermitian(self):
+        n = 3
+        rho = np.zeros((2, 1 << (2 * n)))
+        for k in range(1 << n):
+            rho[0, k + (k << n)] = 1.0 / (1 << n)
+        bad = num.inject_nonhermitian(rho, n, eps=1e-3)
+        p = num.probe_dict(num.densmatr_probe_vector(jnp.asarray(bad), n))
+        assert p["herm_dev"] == pytest.approx(1e-3, rel=1e-6)
+        assert p["norm"] == pytest.approx(1.0, abs=1e-12)   # trace intact
+
+    def test_ulp_band_scales_with_depth_and_precision(self):
+        assert num.ulp_band(100, "float64") > num.ulp_band(1, "float64")
+        assert num.ulp_band(10, "float32") > num.ulp_band(10, "float64")
+        # sqrt growth, not linear
+        assert num.ulp_band(400, "float64") == pytest.approx(
+            2 * num.ulp_band(100, "float64"))
+
+
+# ---------------------------------------------------------------------------
+# the numeric drift ledger
+# ---------------------------------------------------------------------------
+
+class TestNumericLedger:
+    def test_clean_record_has_no_findings(self):
+        led = num.NumericLedger()
+        rec = led.record("clean", num.state_probe_vector(_zero_state(4)),
+                         num_ops=8, warn=False)
+        assert rec.findings == ()
+        assert led.snapshot() == {"records": 1, "probed_total": 1,
+                                  "nan_total": 0, "drift_total": 0}
+
+    def test_scaled_state_trips_drift(self):
+        led = num.NumericLedger()
+        bad = num.inject_scale(np.asarray(_zero_state(4)), 1.001)
+        rec = led.record("scaled", num.state_probe_vector(jnp.asarray(bad)),
+                         num_ops=8, warn=False)
+        assert any(num.NUMERIC_DRIFT in f for f in rec.findings)
+        assert led.snapshot()["drift_total"] == 1
+
+    def test_nan_trips_and_wins_over_drift(self):
+        led = num.NumericLedger()
+        bad = num.inject_nan(np.asarray(_zero_state(4)))
+        rec = led.record("nan", num.state_probe_vector(jnp.asarray(bad)),
+                         num_ops=8, warn=False)
+        assert any(num.NUMERIC_NAN in f for f in rec.findings)
+        # a NaN norm must not ALSO report as drift noise
+        assert not any(num.NUMERIC_DRIFT in f for f in rec.findings)
+        assert led.snapshot()["nan_total"] == 1
+
+    def test_nonhermitian_density_trips(self):
+        led = num.NumericLedger()
+        n = 3
+        rho = np.zeros((2, 1 << (2 * n)))
+        for k in range(1 << n):
+            rho[0, k + (k << n)] = 1.0 / (1 << n)
+        rec = led.record(
+            "herm", num.densmatr_probe_vector(
+                jnp.asarray(num.inject_nonhermitian(rho, n)), n),
+            kind="densmatr", num_qubits=n, num_ops=8, warn=False)
+        assert any("Hermiticity" in f for f in rec.findings)
+
+    def test_by_class_aggregation(self):
+        led = num.NumericLedger()
+        clean = num.state_probe_vector(_zero_state(4))
+        bad = num.state_probe_vector(jnp.asarray(num.inject_nan(
+            np.asarray(_zero_state(4)))))
+        led.record("a", clean, class_key="ck1", num_ops=4, warn=False)
+        led.record("b", clean, class_key="ck1", num_ops=4, warn=False)
+        led.record("c", bad, class_key="ck2", num_ops=4, warn=False)
+        agg = led.by_class()
+        assert agg["ck1"]["count"] == 2 and agg["ck1"]["nan_records"] == 0
+        assert agg["ck2"]["nan_records"] == 1
+
+    def test_band_scales_with_expected_norm(self):
+        """Rounding drift is relative to the state's magnitude: a tenant's
+        100x-scaled input (expected norm 1e4) must be judged against a
+        1e4-scaled band, not the unit-scale one."""
+        led = num.NumericLedger()
+        st = np.asarray(_zero_state(4)) * 100.0
+        # perturb by ~10 unit-scale bands: real rounding noise at this
+        # magnitude, far inside the SCALED band
+        st[0, 0] = np.nextafter(st[0, 0], np.inf)
+        rec = led.record("scaled_tenant", num.state_probe_vector(
+            jnp.asarray(st)), num_ops=100, expected_norm=1e4, warn=False)
+        assert rec.findings == ()
+        assert rec.band == pytest.approx(
+            1e4 * num.ulp_band(100, "float64"))
+
+    def test_warns_with_code(self):
+        led = num.NumericLedger()
+        bad = num.state_probe_vector(jnp.asarray(num.inject_nan(
+            np.asarray(_zero_state(4)))))
+        with pytest.warns(RuntimeWarning, match="O_NUMERIC_NAN"):
+            led.record("nan", bad, num_ops=4)
+
+    def test_corruption_selftest(self):
+        rep = num.corruption_selftest()
+        assert rep["ok"], rep
+
+
+# ---------------------------------------------------------------------------
+# bit-identity contract: instrumented primary output == uninstrumented,
+# per engine path (the serving contract's numeric twin)
+# ---------------------------------------------------------------------------
+
+class TestBitIdentity:
+    def test_xla_single_program(self):
+        cache = CompileCache()
+        c = random_circuit(6, depth=2, seed=3)
+        ops = tuple(c.key())
+        st = _zero_state(6)
+        entry = cache.entry_for(ops, 6)
+        params = cache._check_params(entry, qt.circuit.param_vector(ops))
+        plain = np.asarray(cache.single_program(entry, st).call(st, params))
+        probed, pv = cache.single_probed_program(entry, st).call(st, params)
+        assert np.array_equal(np.asarray(probed), plain)
+        assert num.probe_dict(pv)["norm"] == pytest.approx(1.0, abs=1e-12)
+
+    def test_batched_map_program(self):
+        cache = CompileCache()
+        led = num.NumericLedger()
+        svc = QuESTService(max_batch=8, max_delay_ms=20, cache=cache,
+                           probes=True, numeric_ledger=led, start=False)
+        circuits = [vqe_ansatz(6, 1, seed=s) for s in range(4)]
+        futs = [svc.submit(c) for c in circuits]
+        svc.start()
+        assert svc.drain(timeout=300)
+        oracle = CompileCache()
+        for c, f in zip(circuits, futs):
+            res = f.result(timeout=60)
+            want = np.asarray(oracle.execute(c.key(), _zero_state(6),
+                                             num_qubits=6))
+            assert np.array_equal(res.state, want)
+            assert res.numeric_health is not None
+            assert res.numeric_health["findings"] == []
+        assert res.batch_size == 4          # actually co-batched
+        svc.shutdown()
+
+    def test_scheduled_mesh_program(self):
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices")
+        cache = CompileCache()
+        c = qft_circuit(8)
+        ops = tuple(c.key())
+        opts = CacheOptions(num_devices=8)
+        st = _zero_state(8)
+        entry = cache.entry_for(ops, 8, opts)
+        assert entry.skeleton is not None
+        params = cache._check_params(entry, qt.circuit.param_vector(ops))
+        plain = np.asarray(cache.single_program(entry, st).call(st, params))
+        probed, pv = cache.single_probed_program(entry, st).call(st, params)
+        assert np.array_equal(np.asarray(probed), plain)
+        assert num.probe_dict(pv)["nan_count"] == 0
+
+    def test_epoch_pallas_per_pass(self):
+        from quest_tpu.ops import epoch_pallas as _ep
+        c = qft_circuit(10)
+        ops = tuple(c.key())
+        st = _zero_state(10, jnp.float32)
+        base = np.asarray(_ep.jit_program(ops)(st))
+        out, points, plan = num.epoch_pass_probes(ops, 10, st)
+        assert np.array_equal(np.asarray(out), base)
+        # the probe-point count independently confirms the planner's
+        # fused-pass boundaries: one probe per Pallas pass + XLA segment
+        xla_segments = sum(1 for s in plan["segments"]
+                           if s["engine"] == "xla")
+        assert len(points) == plan["pallas_passes"] + xla_segments
+        assert all(p["nan_count"] == 0 for p in points)
+        assert points[-1]["norm"] == pytest.approx(1.0, rel=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# serve integration
+# ---------------------------------------------------------------------------
+
+class TestServeNumericHealth:
+    def test_unprobed_requests_carry_no_health(self):
+        svc = QuESTService(max_batch=2, max_delay_ms=5,
+                           cache=CompileCache(), probes=False, start=False)
+        fut = svc.submit(qft_circuit(5))
+        svc.start()
+        assert svc.drain(timeout=120)
+        assert fut.result(timeout=60).numeric_health is None
+        svc.shutdown()
+
+    def test_per_submit_override(self):
+        led = num.NumericLedger()
+        svc = QuESTService(max_batch=2, max_delay_ms=5,
+                           cache=CompileCache(), probes=False,
+                           numeric_ledger=led, start=False)
+        fut = svc.submit(qft_circuit(5), probes=True)
+        svc.start()
+        assert svc.drain(timeout=120)
+        assert fut.result(timeout=60).numeric_health is not None
+        assert led.snapshot()["probed_total"] == 1
+        svc.shutdown()
+
+    def test_env_var_default(self, monkeypatch):
+        monkeypatch.setenv("QUEST_TPU_NUMERIC_PROBES", "1")
+        svc = QuESTService(max_batch=2, cache=CompileCache(), start=False)
+        assert svc.default_probes
+        svc.shutdown(drain=False)
+
+    def test_nan_outcome_dumps_flight_ring(self):
+        led = num.NumericLedger()
+        svc = QuESTService(max_batch=2, max_delay_ms=5,
+                           cache=CompileCache(), probes=True,
+                           numeric_ledger=led, start=False)
+        bad = np.zeros((2, 32))
+        bad[0, 0] = np.nan
+        fut = svc.submit(qft_circuit(5), initial_state=bad)
+        svc.start()
+        assert svc.drain(timeout=120)
+        res = fut.result(timeout=60)
+        assert res.numeric_health["nan_count"] > 0
+        assert any(num.NUMERIC_NAN in f
+                   for f in res.numeric_health["findings"])
+        assert svc.flight_recorder.last_dump is not None
+        assert svc.flight_recorder.last_dump["reason"] == num.NUMERIC_NAN
+        # the ring record carries the health payload for the post-mortem
+        recs = [r for r in svc.flight_recorder.records()
+                if r.numeric_health is not None]
+        assert recs and recs[0].numeric_health["nan_count"] > 0
+        assert led.snapshot()["nan_total"] == 1
+        svc.shutdown()
+
+    def test_non_unit_initial_state_is_not_drift(self):
+        """A legal caller-supplied initial state need not be unit-norm;
+        the drift baseline is the request's OWN input norm, so a scaled
+        (but finite) input must not read as a kernel miscompile."""
+        led = num.NumericLedger()
+        svc = QuESTService(max_batch=2, max_delay_ms=5,
+                           cache=CompileCache(), probes=True,
+                           numeric_ledger=led, start=False)
+        st = np.zeros((2, 32))
+        st[0, 0] = 0.9                      # norm 0.81, deliberately
+        fut = svc.submit(qft_circuit(5), initial_state=st)
+        svc.start()
+        assert svc.drain(timeout=120)
+        health = fut.result(timeout=60).numeric_health
+        assert health["findings"] == []
+        assert health["norm"] == pytest.approx(0.81, abs=1e-12)
+        assert led.snapshot()["drift_total"] == 0
+        svc.shutdown()
+
+    def test_one_scrape_carries_numeric_gauges(self):
+        led = num.NumericLedger()
+        svc = QuESTService(max_batch=2, max_delay_ms=5,
+                           cache=CompileCache(), probes=True,
+                           numeric_ledger=led, start=False)
+        fut = svc.submit(qft_circuit(5))
+        svc.start()
+        assert svc.drain(timeout=120)
+        fut.result(timeout=60)
+        from quest_tpu.serve.metrics import parse_prometheus
+        parsed = parse_prometheus(svc.prometheus())
+        assert parsed["quest_serve_numeric_probed_total"][""] == 1
+        assert parsed["quest_serve_numeric_ledger_nan_total"][""] == 0
+        d = svc.metrics_dict()
+        assert d["numeric"]["probed_total"] == 1
+        assert d["numeric"]["by_class"]
+        svc.shutdown()
+
+    def test_probed_and_unprobed_do_not_cobatch(self):
+        led = num.NumericLedger()
+        svc = QuESTService(max_batch=8, max_delay_ms=20,
+                           cache=CompileCache(), probes=False,
+                           numeric_ledger=led, start=False)
+        c = qft_circuit(5)
+        f1 = svc.submit(c, probes=True)
+        f2 = svc.submit(c, probes=False)
+        svc.start()
+        assert svc.drain(timeout=120)
+        r1, r2 = f1.result(timeout=60), f2.result(timeout=60)
+        assert r1.numeric_health is not None
+        assert r2.numeric_health is None
+        assert r1.batch_size == 1 and r2.batch_size == 1
+        # ... but they share one SLO/trace class identity
+        assert np.array_equal(r1.state, r2.state)
+        svc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# deploy router quarantine
+# ---------------------------------------------------------------------------
+
+class _FakeReplica:
+    def __init__(self, index, service):
+        self.index = index
+        self.service = service
+
+    def health(self):
+        return self.service.slo.health()
+
+
+def _wait_for(cond, timeout=10.0):
+    """Poll until ``cond()`` — Future done-callbacks (the router's
+    feedback channel) run AFTER result() can already return in the
+    submitting thread, so feedback-dependent asserts must wait."""
+    import time as _time
+    end = _time.monotonic() + timeout
+    while _time.monotonic() < end:
+        if cond():
+            return True
+        _time.sleep(0.01)
+    return cond()
+
+
+class TestRouterQuarantine:
+    def test_repeated_nan_quarantines_placement(self):
+        from quest_tpu.deploy import Router, RouterConfig
+        caches = [CompileCache(), CompileCache()]
+        svcs = [QuESTService(max_batch=2, max_delay_ms=5, cache=caches[i],
+                             probes=True, numeric_ledger=num.NumericLedger(),
+                             start=True) for i in range(2)]
+        try:
+            replicas = [_FakeReplica(i, s) for i, s in enumerate(svcs)]
+            router = Router(replicas, RouterConfig(quarantine_nans=2,
+                                                   quarantine_s=300.0))
+            c = qft_circuit(5)
+            ck = router.class_key(c)
+            bad = np.zeros((2, 32))
+            bad[0, 0] = np.nan
+            first = router.route(c)[0].index
+            for _ in range(2):
+                router.submit(c, initial_state=bad).result(timeout=60)
+            assert _wait_for(lambda: router.snapshot()["quarantined"])
+            snap = router.snapshot()
+            assert snap["quarantined"] == [f"{ck}@{first}"]
+            assert ck not in snap["placements"]
+            # the next request re-places away from the quarantined pair
+            replica, decision = router.route(c)
+            assert replica.index != first
+            assert decision["quarantine_skipped"] == [first]
+        finally:
+            for s in svcs:
+                s.shutdown()
+
+    def test_stale_strike_does_not_combine_with_fresh_nan(self):
+        """A strike older than quarantine_s is not 'consecutive' with a
+        fresh NaN: the window decays, and route()'s prune sweep drops the
+        stale entry so the dict cannot grow for the process lifetime."""
+        from quest_tpu.deploy import Router, RouterConfig
+        svc = QuESTService(max_batch=2, max_delay_ms=5,
+                           cache=CompileCache(), probes=True,
+                           numeric_ledger=num.NumericLedger(), start=True)
+        try:
+            router = Router([_FakeReplica(0, svc)],
+                            RouterConfig(quarantine_nans=2,
+                                         quarantine_s=300.0))
+            c = qft_circuit(5)
+            ck = router.class_key(c)
+            router.report_numeric(ck, 0, ok=False)
+            # age the strike past the window, then strike again
+            with router._lock:
+                strikes, t = router._nan_strikes[(ck, 0)]
+                router._nan_strikes[(ck, 0)] = (strikes, t - 301.0)
+            router.report_numeric(ck, 0, ok=False)
+            assert router.snapshot()["quarantined"] == []
+            with router._lock:
+                assert router._nan_strikes[(ck, 0)][0] == 1
+            # the aged-out form is also pruned by the route() sweep
+            with router._lock:
+                strikes, t = router._nan_strikes[(ck, 0)]
+                router._nan_strikes[(ck, 0)] = (strikes, t - 301.0)
+            router.route(c)
+            with router._lock:
+                assert (ck, 0) not in router._nan_strikes
+        finally:
+            svc.shutdown()
+
+    def test_clean_outcome_resets_strikes(self):
+        from quest_tpu.deploy import Router, RouterConfig
+        svc = QuESTService(max_batch=2, max_delay_ms=5,
+                           cache=CompileCache(), probes=True,
+                           numeric_ledger=num.NumericLedger(), start=True)
+        try:
+            router = Router([_FakeReplica(0, svc)],
+                            RouterConfig(quarantine_nans=2))
+            c = qft_circuit(5)
+            ck = router.class_key(c)
+
+            def strikes():
+                with router._lock:
+                    pair = router._nan_strikes.get((ck, 0))
+                    return pair[0] if pair else 0
+
+            bad = np.zeros((2, 32))
+            bad[0, 0] = np.nan
+            router.submit(c, initial_state=bad).result(timeout=60)
+            assert _wait_for(lambda: strikes() == 1)
+            router.submit(c).result(timeout=60)           # clean: resets
+            assert _wait_for(lambda: strikes() == 0)
+            router.submit(c, initial_state=bad).result(timeout=60)
+            assert _wait_for(lambda: strikes() == 1)
+            assert router.snapshot()["quarantined"] == []
+        finally:
+            svc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# API parity: calc_total_prob / calc_purity / calc_fidelity
+# ---------------------------------------------------------------------------
+
+class TestHealthAPI:
+    def test_calc_total_prob_statevec_and_density(self, env_local):
+        q = qt.createQureg(4, env_local)
+        qt.hadamard(q, 0)
+        assert qt.calc_total_prob(q) == pytest.approx(1.0, abs=1e-12)
+        rho = qt.createDensityQureg(3, env_local)
+        qt.mixDepolarising(rho, 0, 0.3)
+        assert qt.calc_total_prob(rho) == pytest.approx(1.0, abs=1e-12)
+        qt.destroyQureg(q, env_local)
+        qt.destroyQureg(rho, env_local)
+
+    def test_destroyed_register_raises_validation_error(self, env_local):
+        q = qt.createQureg(3, env_local)
+        qt.destroyQureg(q, env_local)
+        with pytest.raises(QuESTError) as e:
+            qt.calc_total_prob(q)
+        assert e.value.code == ErrorCode.QUREG_NOT_INITIALISED
+        with pytest.raises(QuESTError):
+            qt.calc_purity(q)
+
+    def test_calc_purity_validates_density(self, env_local):
+        q = qt.createQureg(3, env_local)
+        with pytest.raises(QuESTError) as e:
+            qt.calc_purity(q)
+        assert e.value.code == ErrorCode.DEFINED_ONLY_FOR_DENSMATRS
+        qt.destroyQureg(q, env_local)
+
+    def test_calc_fidelity_matches_camel_surface(self, env_local):
+        rho = qt.createDensityQureg(3, env_local)
+        psi = qt.createQureg(3, env_local)
+        qt.hadamard(psi, 1)
+        got = qt.calc_fidelity(rho, psi)
+        assert got == pytest.approx(qt.calcFidelity(rho, psi))
+        qt.destroyQureg(rho, env_local)
+        qt.destroyQureg(psi, env_local)
+
+    def test_destroyed_fidelity_reference_raises(self, env_local):
+        rho = qt.createDensityQureg(3, env_local)
+        psi = qt.createQureg(3, env_local)
+        qt.destroyQureg(psi, env_local)
+        with pytest.raises(QuESTError) as e:
+            qt.calc_fidelity(rho, psi)
+        assert e.value.code == ErrorCode.QUREG_NOT_INITIALISED
+        qt.destroyQureg(rho, env_local)
+
+
+# ---------------------------------------------------------------------------
+# the --numeric-report CLI (one-JSON-document contract)
+# ---------------------------------------------------------------------------
+
+class TestNumericReportCLI:
+    def test_one_document_with_numeric_sections(self, capsys):
+        from quest_tpu.analysis.__main__ import main
+        num.global_numeric_ledger().clear()
+        rc = main(["--qft", "6", "--numeric-report", "--no-hints", "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        row = doc["numeric_report"][0]
+        assert row["bit_identical"]
+        assert row["ledger"]["findings"] == []
+        led = doc["numeric_ledger"]
+        assert led["probed_total"] >= 1
+        assert led["nan_total"] == 0 and led["drift_total"] == 0
+        assert doc["summary"]["counts"]["ERROR"] == 0
