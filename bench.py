@@ -788,6 +788,112 @@ def bench_serve_vqe16_batch64(requests=64, n=16, layers=1):
     return value, cfg
 
 
+def bench_vqe_grad_16q_batch64(requests=64, n=16, layers=1):
+    """64 same-ansatz, different-angle 16q GRADIENT requests through
+    ``QuESTService.submit_gradient`` (quest_tpu/grad) — the gradient-
+    serving headline row (docs/SERVING.md "Gradient serving").
+
+    One structural class => ONE compile for the whole sweep (asserted),
+    one 64-wide ``lax.map`` adjoint microbatch.  Two baselines, both
+    measured on a subset and reported per-request (each is minutes-per-
+    request territory at 64 tenants):
+
+    - **central finite differences** through the jitted energy program
+      (compiled once): 2·P circuit executions per gradient — what a
+      QuEST-reference user hand-rolls, on our fastest forward path;
+    - **jax.grad through the unlifted program**: taped reverse-mode with
+      a FRESH trace per tenant (the pre-serve angle-sweep cost: a program
+      keyed on the closure is a fresh compile per angle assignment).
+
+    Value = gradients/second through the serve path; the config records
+    per-request walls and the speedups.  Asserts the served gradients
+    match finite differences (tolerance-banded) and that the serve path
+    is STRICTLY faster per request than both baselines."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from quest_tpu.autodiff import expectation_fn
+    from quest_tpu.models import hardware_efficient_ansatz, tfim_hamiltonian
+    from quest_tpu.serve import CompileCache, QuESTService
+
+    platform = jax.devices()[0].platform
+    pc = hardware_efficient_ansatz(n, layers)
+    hamil = tfim_hamiltonian(n)
+    num_params = pc.num_params
+    gates = len(pc.ops)
+    rng = np.random.default_rng(17)
+    params = [rng.uniform(-np.pi, np.pi, num_params)
+              for _ in range(requests)]
+
+    cache = CompileCache()
+    svc = QuESTService(max_batch=requests, max_delay_ms=50.0,
+                      max_queue=requests, cache=cache, start=False)
+    t0 = time.perf_counter()
+    futs = [svc.submit_gradient(pc, p, hamil) for p in params]
+    svc.start()
+    if not svc.drain(timeout=2400):
+        raise RuntimeError("gradient serve drain timed out")
+    results = [f.result(timeout=300) for f in futs]
+    serve_seconds = time.perf_counter() - t0
+    svc.shutdown()
+    snap = cache.snapshot()
+    assert snap["compiles"] == 1, f"expected ONE compile, got {snap}"
+    serve_per_req = serve_seconds / requests
+
+    # baseline (a): central finite differences through ONE jitted energy
+    # program — 2P executions per gradient, measured on one request
+    efn = expectation_fn(pc, hamil)
+    jax.block_until_ready(efn(jnp.asarray(params[0])))  # compile outside
+    p0 = np.asarray(params[0], np.float64)
+    eps = 1e-5
+    t0 = time.perf_counter()
+    fd = np.zeros(num_params)
+    for i in range(num_params):
+        up, dn = p0.copy(), p0.copy()
+        up[i] += eps
+        dn[i] -= eps
+        fd[i] = (float(efn(jnp.asarray(up))) - float(efn(jnp.asarray(dn)))) \
+            / (2 * eps)
+    fd_per_req = time.perf_counter() - t0
+    worst = float(np.abs(results[0].gradient - fd).max())
+    assert worst < 1e-5, f"served gradient drifted {worst} from central FD"
+
+    # baseline (b): unlifted jax.grad, fresh trace per tenant (compile
+    # cost included — that IS the unlifted cost model), 2 tenants measured
+    unlifted_n = 2
+    t0 = time.perf_counter()
+    for p in params[:unlifted_n]:
+        vg = jax.jit(jax.value_and_grad(expectation_fn(pc, hamil)))
+        v, g = vg(jnp.asarray(p))
+        jax.block_until_ready(g)
+    unlifted_per_req = (time.perf_counter() - t0) / unlifted_n
+
+    assert serve_per_req < fd_per_req, (serve_per_req, fd_per_req)
+    assert serve_per_req < unlifted_per_req, (serve_per_req,
+                                              unlifted_per_req)
+    hist = svc.metrics_dict()["histograms"]["batch_size"]
+    value = requests / max(serve_seconds, 1e-9)
+    cfg = {"qubits": n, "requests": requests, "gates_per_circuit": gates,
+           "num_params": num_params,
+           "hamil_terms": hamil.num_sum_terms,
+           "precision": 2, "platform": platform,
+           "serve_seconds": serve_seconds,
+           "serve_seconds_per_request": serve_per_req,
+           "fd_seconds_per_request": fd_per_req,
+           "fd_evals_per_request": 2 * num_params,
+           "unlifted_jaxgrad_seconds_per_request": unlifted_per_req,
+           "unlifted_requests_measured": unlifted_n,
+           "speedup_vs_fd": fd_per_req / max(serve_per_req, 1e-9),
+           "speedup_vs_unlifted_jaxgrad": unlifted_per_req
+           / max(serve_per_req, 1e-9),
+           "serve_compiles": int(snap["compiles"]),
+           "cache_hit_rate": snap["hit_rate"],
+           "mean_batch_size": hist["mean"],
+           "max_abs_diff_vs_fd": worst,
+           "seconds": serve_seconds}
+    return value, cfg
+
+
 def bench_serve_vqe16_probed_overhead(requests=64, n=16, layers=1):
     """The numeric-health overhead row (docs/OBSERVABILITY.md "Numeric
     health"): the serve_vqe_16q_batch64 workload served twice — plain, and
@@ -1418,6 +1524,10 @@ def main() -> None:
         add("densmatr_14q_damping_depol_f64", bench_density, 14, 3, 2)
         # serving subsystem (quest_tpu/serve): 64 tenants, one compile
         add("serve_vqe_16q_batch64", bench_serve_vqe16_batch64)
+        # gradient serving (quest_tpu/grad): 64 adjoint gradients, one
+        # compile, vs finite differences and unlifted jax.grad
+        add("vqe_grad_16q_batch64", bench_vqe_grad_16q_batch64,
+            unit="grad/s")
         # numeric-health probes (quest_tpu/obs/numerics.py): instrumented
         # serving must cost <= 5% vs the plain row (asserted in the fn)
         add("serve_vqe_16q_probed_overhead",

@@ -79,6 +79,14 @@ GROUPS = [
                                        "RouterConfig", "ExecutableStore",
                                        "process_replica",
                                        "broadcast_hot_keys"]),
+    ("Gradient serving (quest_tpu.grad)",
+     ["GradResult", "training_loop", "sgd", "TrainingResult",
+      "QuESTService.submit_gradient", "ReplicaPool.submit_gradient",
+      "Router.submit_gradient",
+      "grad.adjoint_terms_fn", "grad.hamil_masks",
+      "grad.validate_gradient_circuit", "grad.grad_group_signature",
+      "CompileCache.grad_entry_for", "CompileCache.grad_single_program",
+      "CompileCache.grad_batch_program"]),
     ("Observability (quest_tpu.obs)", ["TraceRecorder", "FlightRecorder",
                                        "Ledger", "enable_tracing",
                                        "disable_tracing", "tracing_enabled",

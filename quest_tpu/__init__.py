@@ -39,8 +39,9 @@ from .autodiff import (Param, ParamCircuit, build as build_param_circuit,  # noq
                        adjoint_gradient_fn, expectation_fn, state_fn)
 from .trajectories import (trajectory_expectation_fn,  # noqa: F401
                            trajectory_state_fn)
-from .serve import (CacheOptions, CompileCache, QuESTService,  # noqa: F401
-                    ServeResult)
+from .serve import (CacheOptions, CompileCache, GradResult,  # noqa: F401
+                    QuESTService, ServeResult)
+from .grad import (TrainingResult, sgd, training_loop)  # noqa: F401
 from .deploy import (ExecutableStore, Replica, ReplicaPool, Router,  # noqa: F401
                      RouterConfig, broadcast_hot_keys, process_replica)
 from .obs import (TraceRecorder, FlightRecorder, Ledger,  # noqa: F401
@@ -65,7 +66,8 @@ __all__ = list(_api_all) + [
     "Param", "ParamCircuit", "build_param_circuit", "expectation_fn",
     "state_fn", "adjoint_gradient_fn",
     "trajectory_state_fn", "trajectory_expectation_fn",
-    "QuESTService", "ServeResult", "CompileCache", "CacheOptions",
+    "QuESTService", "ServeResult", "GradResult", "CompileCache",
+    "CacheOptions", "training_loop", "TrainingResult", "sgd",
     "ReplicaPool", "Replica", "Router", "RouterConfig", "ExecutableStore",
     "process_replica", "broadcast_hot_keys",
     "TraceRecorder", "FlightRecorder", "Ledger", "enable_tracing",

@@ -39,3 +39,23 @@ try:  # jax >= 0.4.26 top-level export
     enable_x64 = jax.enable_x64
 except AttributeError:  # pragma: no cover - 0.4.x
     from jax.experimental import enable_x64  # noqa: F401
+
+# jax 0.4.x ships optimization_barrier without a batching rule, so any
+# jax.vmap over a program containing one (the serve layer's mode='vmap'
+# gradient lowering; ops/calc.py's per-term accumulator barrier) dies with
+# NotImplementedError.  The rule is trivial — a barrier is shape-preserving
+# and elementwise-transparent, so binding the batched operands and passing
+# the batch dims through IS the batched barrier (newer jax implements
+# exactly this).  Registered only when missing.
+try:  # pragma: no cover - presence depends on jax version
+    from jax._src.interpreters import batching as _batching
+    from jax._src.lax.lax import optimization_barrier_p as _opt_barrier_p
+
+    if _opt_barrier_p not in _batching.primitive_batchers:
+        def _optimization_barrier_batcher(args, dims, **params):
+            return _opt_barrier_p.bind(*args, **params), dims
+
+        _batching.primitive_batchers[_opt_barrier_p] = \
+            _optimization_barrier_batcher
+except (ImportError, AttributeError):
+    pass

@@ -707,7 +707,8 @@ def main(argv=None) -> int:
 
     if args.serve_audit:
         ran = True
-        from .serve_audit import audit_param_lift, default_workload
+        from .serve_audit import (audit_grad_lift, audit_param_lift,
+                                  default_workload)
         targets = ([(label, c) for label, c in circuits]
                    if circuits else default_workload())
         reports, found = audit_param_lift(
@@ -717,6 +718,16 @@ def main(argv=None) -> int:
         diagnostics += found
         for r in reports:
             echo(f"{r['label']}: serve-audit " + json.dumps(r, default=float))
+        if not circuits:
+            # the gradient arm (quest_tpu/grad): runs on the default
+            # gradient workload when no explicit circuits were given
+            # (explicit --circuit factories are forward circuits)
+            greports, gfound = audit_grad_lift()
+            doc["serve_audit_grad"] = greports
+            diagnostics += gfound
+            for r in greports:
+                echo(f"{r['label']}: serve-audit-grad "
+                     + json.dumps(r, default=float))
 
     if args.trace_report:
         # the process-ledger summary, one section of the single document:

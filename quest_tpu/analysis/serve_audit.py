@@ -39,7 +39,8 @@ import numpy as np
 
 from .diagnostics import AnalysisCode, Diagnostic, Severity, diag
 
-__all__ = ["audit_param_lift", "default_workload"]
+__all__ = ["audit_param_lift", "audit_grad_lift", "default_workload",
+           "default_grad_workload"]
 
 def _probe_eps(dtype) -> float:
     """FMA-contraction slack scaled to the PROBE dtype: a few ulps over a
@@ -135,5 +136,109 @@ def audit_param_lift(circuits, *, num_devices: int = 1, dtype=None,
                                 detail=(f"{label}: an angle-perturbed twin "
                                         "missed the class's cache entry — "
                                         "the structural key is unstable")))
+        reports.append(report)
+    return reports, out
+
+
+def default_grad_workload() -> list:
+    """(label, ParamCircuit factory, PauliHamil) per gradient-serving
+    class — factories, so key-stability is probed across two INDEPENDENT
+    builds of the same ansatz recipe (the multi-tenant reality: every
+    tenant records its own circuit object)."""
+    from ..models import (hardware_efficient_ansatz, maxcut_hamiltonian,
+                          qaoa_maxcut_circuit, tfim_hamiltonian)
+    edges = [(i, (i + 1) % 6) for i in range(6)]
+    return [
+        ("grad_hea6", lambda: hardware_efficient_ansatz(6, 2),
+         tfim_hamiltonian(6)),
+        ("grad_qaoa6", lambda: qaoa_maxcut_circuit(6, edges, 2),
+         maxcut_hamiltonian(6, edges)),
+    ]
+
+
+def audit_grad_lift(workloads=None, *, seed: int = 0,
+                    label_prefix: str = "") -> tuple:
+    """Pass 6's gradient arm: prove the ADJOINT lift (quest_tpu/grad +
+    serve/cache.py ``grad_entry_for``).  Per (ansatz, Hamiltonian) class:
+
+    1. **Lifted vs eager** — the cache's compiled ``(state, params,
+       coeffs)`` adjoint program agrees with the direct
+       ``adjoint_gradient_fn`` closure (constants embedded) on random
+       angles — few-ulp tolerance, the same FMA-contraction freedom as
+       the forward lift.
+    2. **Independent oracle** — energy AND gradient agree with
+       ``jax.value_and_grad(expectation_fn(...))``, taped reverse-mode
+       through an entirely different program.
+    3. **Key stability** — a SECOND independent build of the ansatz
+       recipe (new Circuit objects, same structure) plus an
+       angle-perturbed request land on the same gradient cache entry.
+
+    Any violation is ``A_PARAM_LIFT_DIVERGENCE`` (ERROR) — the audit a
+    drifted lifted-adjoint reconstruction must fail."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..autodiff import adjoint_gradient_fn, expectation_fn
+    from ..grad import adjoint as _gradadj
+    from ..serve.cache import CompileCache
+
+    if workloads is None:
+        workloads = default_grad_workload()
+    cache = CompileCache()  # isolated: the audit must not warm serving caches
+    reports: list[dict] = []
+    out: list[Diagnostic] = []
+    rng = np.random.default_rng(seed)
+    for label, factory, hamil in workloads:
+        pc = factory() if callable(factory) else factory
+        label = f"{label_prefix}{label}"
+        n = pc.num_qubits
+        masks = _gradadj.hamil_masks(hamil)
+        entry = cache.grad_entry_for(tuple(pc.ops), n, pc.num_params, masks)
+        st = jnp.zeros((2, 1 << n), jnp.float64).at[0, 0].set(1.0)
+        cf = jnp.asarray(np.asarray(hamil.term_coeffs, np.float64))
+        params = jnp.asarray(rng.uniform(-1.5, 1.5, pc.num_params))
+        prog = cache.grad_single_program(entry, st)
+        e_l, g_l = prog.call(st, params, cf)
+        report = {"label": label, "num_qubits": n, "ops": len(pc.ops),
+                  "num_params": pc.num_params, "hamil_terms": len(masks)}
+
+        # 1. lifted program vs the direct (constant-embedded) adjoint
+        e_d, g_d = adjoint_gradient_fn(pc, hamil)(params)
+        worst = max(abs(float(e_l) - float(e_d)),
+                    float(np.abs(np.asarray(g_l) - np.asarray(g_d)).max()))
+        report["lifted_vs_eager_max_abs_diff"] = worst
+        if not np.isfinite(worst) or worst > 1e-11:
+            out.append(diag(AnalysisCode.PARAM_LIFT_DIVERGENCE,
+                            Severity.ERROR,
+                            detail=(f"{label}: lifted adjoint program "
+                                    "diverges from the eager "
+                                    f"adjoint_gradient_fn (max |diff| "
+                                    f"{worst:.3g})")))
+
+        # 2. independent taped-AD oracle
+        e_o, g_o = jax.value_and_grad(expectation_fn(pc, hamil))(params)
+        worst_o = max(abs(float(e_l) - float(e_o)),
+                      float(np.abs(np.asarray(g_l) - np.asarray(g_o)).max()))
+        report["vs_jax_grad_max_abs_diff"] = worst_o
+        if not np.isfinite(worst_o) or worst_o > 1e-9:
+            out.append(diag(AnalysisCode.PARAM_LIFT_DIVERGENCE,
+                            Severity.ERROR,
+                            detail=(f"{label}: served gradient diverges "
+                                    "from jax.grad through the unlifted "
+                                    f"program (max |diff| {worst_o:.3g})")))
+
+        # 3. key stability across an independent build of the recipe
+        if callable(factory):
+            twin = factory()
+            entry2 = cache.grad_entry_for(tuple(twin.ops), n,
+                                          twin.num_params, masks)
+            report["twin_shares_entry"] = entry2 is entry
+            if entry2 is not entry:
+                out.append(diag(AnalysisCode.PARAM_LIFT_DIVERGENCE,
+                                Severity.ERROR,
+                                detail=(f"{label}: an independent build of "
+                                        "the ansatz recipe missed the "
+                                        "gradient cache entry — the class "
+                                        "key is unstable")))
         reports.append(report)
     return reports, out

@@ -501,61 +501,41 @@ def _gen_inner_im(lam, psi, op: ParamOp):
 
 def adjoint_gradient_fn(pc: ParamCircuit, hamil, init=None):
     """Jitted ``params -> (energy, gradient)`` by the adjoint method —
-    bit-identical gradients to ``jax.grad(expectation_fn(...))`` at THREE
-    live statevectors for any circuit depth (taped reverse-mode holds
-    depth+1 intermediate states, which is what OOMs deep large-n circuits).
+    matching ``jax.grad(expectation_fn(...))`` to machine precision at
+    THREE live statevectors for any circuit depth (taped reverse-mode
+    holds depth+1 intermediate states, which is what OOMs deep large-n
+    circuits).
 
     Requires a unitary statevector circuit (no noise ops; any recorded
     static matrix must be unitary — its inverse is taken as the conjugate
-    transpose).  TPU-native extension; no reference analogue."""
-    from .api import _pauli_sum_terms
+    transpose); violations raise ``QuESTError`` with the gradient-serving
+    validation codes (``E_GRADIENT_NOT_UNITARY`` for noise channels and
+    non-unitary payloads, ``E_GRADIENT_DENSITY_MODE`` for density
+    registers) — the same codes ``QuESTService.submit_gradient`` rejects
+    with at admission.  The sweep itself is the shared serving body
+    (quest_tpu/grad/adjoint.py ``adjoint_terms_fn``); this wrapper closes
+    it over the initial state and the Hamiltonian's coefficients, where
+    the serve cache keeps both as runtime operands.  TPU-native extension;
+    no reference analogue."""
+    from .grad.adjoint import (adjoint_terms_fn, hamil_masks,
+                               validate_gradient_circuit)
+    from .validation import ErrorCode, MESSAGES, QuESTError
 
-    if any(isinstance(op, ParamOp) and op.kind in _NOISE_KINDS for op in pc.ops):
-        raise ValueError("adjoint_gradient_fn: noise channels are not "
-                         "unitary; use jax.grad(expectation_fn(..., "
-                         "density=True)) for noisy gradients")
-    terms = _pauli_sum_terms(np.asarray(hamil.pauli_codes))
+    validate_gradient_circuit(pc, "adjoint_gradient_fn")
+    terms = hamil_masks(hamil)
     cf = jnp.asarray(np.asarray(hamil.term_coeffs, dtype=np.float64))
     init, density = _resolve_init(pc, init, False)
     if density:
-        raise ValueError("adjoint_gradient_fn: statevector circuits only")
-    ops = tuple(pc.ops)
-    inv_static = {id(op): _inverse_gate_op(op)
-                  for op in ops if isinstance(op, GateOp)}
+        raise QuESTError(ErrorCode.GRADIENT_DENSITY_MODE,
+                         MESSAGES[ErrorCode.GRADIENT_DENSITY_MODE],
+                         "adjoint_gradient_fn")
     n = pc.num_qubits
-    num_params = pc.num_params
+    body = adjoint_terms_fn(pc.ops, n, pc.num_params, terms)
 
     @jax.jit
     def value_and_grad(params):
-        params = jnp.asarray(params)
-        if not jnp.issubdtype(params.dtype, jnp.floating):
-            params = params.astype(_prec.CONFIG.real_dtype)
         psi = (_zero_state(n, False, _prec.CONFIG.real_dtype)
                if init is None else init)
-        for op in ops:  # forward, no taping
-            psi = (_apply_one(psi, op) if isinstance(op, GateOp)
-                   else _apply_param_op(psi, op, params, None))
-            # (a per-op scheduling barrier here was measured to RAISE the
-            # 28q static allocation, 16.06 -> 17.07 GiB — the backward
-            # sweep's barrier is the one that pays)
-        lam = _calc.apply_pauli_sum(psi, terms, cf)
-        energy = jnp.sum(psi[0] * lam[0] + psi[1] * lam[1])
-        grads = jnp.zeros(num_params, dtype=params.dtype)
-        for op in reversed(ops):
-            if isinstance(op, GateOp):
-                inv = inv_static[id(op)]
-                psi = _apply_one(psi, inv)
-                lam = _apply_one(lam, inv)
-            else:
-                if isinstance(op.param, Param):
-                    contrib = _gen_inner_im(lam, psi, op) * op.param.scale
-                    grads = grads.at[op.param.index].add(
-                        contrib.astype(params.dtype))
-                psi = _apply_param_op(psi, op, params, None, invert=True)
-                lam = _apply_param_op(lam, op, params, None, invert=True)
-            # pin the schedule: without the barrier XLA may hold many
-            # uncompute steps' buffers live at once (observed HBM OOM at 28q)
-            psi, lam = jax.lax.optimization_barrier((psi, lam))
-        return energy, grads.astype(params.dtype)
+        return body(psi, params, cf)
 
     return value_and_grad

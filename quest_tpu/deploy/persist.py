@@ -331,7 +331,8 @@ class ExecutableStore:
             try:
                 entry = cache.install_entry(
                     skey, meta["num_qubits"], meta["options"],
-                    meta["skeleton"], meta["offsets"], meta["num_params"])
+                    meta["skeleton"], meta["offsets"], meta["num_params"],
+                    hamil=meta.get("hamil"))
                 cache.install_program(entry, tag, call, nbytes)
             except Exception:
                 with self._lock:

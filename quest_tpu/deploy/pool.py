@@ -181,6 +181,17 @@ class ReplicaPool:
                                   deadline_ms=deadline_ms,
                                   initial_state=initial_state)
 
+    def submit_gradient(self, circuit, params=None, hamiltonian=None,
+                        deadline_ms: float | None = None,
+                        initial_state=None, probes: bool | None = None):
+        """Gradient front door (quest_tpu/grad): routed by the gradient
+        class's own affinity, served by one replica's
+        ``QuESTService.submit_gradient``."""
+        return self.router.submit_gradient(
+            circuit, params=params, hamiltonian=hamiltonian,
+            deadline_ms=deadline_ms, initial_state=initial_state,
+            probes=probes)
+
     def start(self) -> "ReplicaPool":
         for r in self.replicas:
             r.service.start()

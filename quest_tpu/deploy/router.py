@@ -103,6 +103,19 @@ class Router:
         return _obs.key_hash((circuit.num_qubits,
                               circuit.key(structural=True)))
 
+    def grad_class_key(self, circuit, hamiltonian) -> str:
+        """Affinity key of a GRADIENT class (quest_tpu/grad): the ansatz
+        op tuple plus the Hamiltonian's packed term masks — a gradient
+        class is a routable class of its own, with its own rendezvous
+        order, sticky placement, cooldown and NaN quarantine, distinct
+        from the same circuit's forward class (they are different
+        executables with different cache economics)."""
+        from ..grad import adjoint as _gradadj
+        return _obs.key_hash(
+            (circuit.num_qubits,
+             _gradadj.grad_group_signature(
+                 circuit, _gradadj.hamil_masks(hamiltonian))))
+
     def candidates(self, class_key: str) -> list:
         """Replica indices in rendezvous (HRW) order for this class:
         deterministic, uniform over classes, and stable under replica
@@ -206,14 +219,46 @@ class Router:
         request (``E_QUEUE_FULL`` raced past the saturation read) is
         retried at the remaining candidates before the bounce propagates."""
         ck = self.class_key(circuit)
+        return self._routed_submit(
+            circuit, ck, deadline_ms,
+            lambda replica: replica.service.submit(
+                circuit, params=params, shots=shots,
+                deadline_ms=deadline_ms, initial_state=initial_state))
+
+    def submit_gradient(self, circuit, params=None, hamiltonian=None,
+                        deadline_ms: float | None = None,
+                        initial_state=None, probes: bool | None = None):
+        """Route + submit one ``(energy, gradient)`` request
+        (``QuESTService.submit_gradient``; quest_tpu/grad).  The gradient
+        class's OWN affinity key places it — same sticky/shed/bounce
+        policy as forward traffic, and the done-callback feeds the same
+        eviction re-placement and NaN quarantine (a ``GradResult`` carries
+        ``cache_outcome`` and ``numeric_health`` exactly like a
+        ``ServeResult``, so a backward-pass NaN on a probed deployment
+        quarantines the placement)."""
+        if hamiltonian is None:
+            # same clean error surface as QuESTService.submit_gradient —
+            # grad_class_key would otherwise die inside hamil_masks
+            raise TypeError(
+                "submit_gradient(circuit, params, hamiltonian) requires a "
+                "PauliHamil: the energy head is <psi|H|psi>")
+        ck = self.grad_class_key(circuit, hamiltonian)
+        return self._routed_submit(
+            circuit, ck, deadline_ms,
+            lambda replica: replica.service.submit_gradient(
+                circuit, params=params, hamiltonian=hamiltonian,
+                deadline_ms=deadline_ms, initial_state=initial_state,
+                probes=probes))
+
+    def _routed_submit(self, circuit, ck: str, deadline_ms, do_submit):
+        """The shared route + bounce-retry + feedback tail of
+        :meth:`submit` / :meth:`submit_gradient`."""
         replica, _decision = self.route(circuit, deadline_ms, class_key=ck)
         by_index = {r.index: r for r in self.replicas}
         tried = set()
         while True:
             try:
-                fut = replica.service.submit(
-                    circuit, params=params, shots=shots,
-                    deadline_ms=deadline_ms, initial_state=initial_state)
+                fut = do_submit(replica)
                 break
             except QuESTError as exc:
                 if exc.code != ErrorCode.QUEUE_FULL:

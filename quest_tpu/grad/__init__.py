@@ -1,0 +1,52 @@
+"""quest_tpu.grad — adjoint-gradient serving for variational training.
+
+The serving stack (PRs 5/11) ran only FORWARD circuits; this package makes
+``(energy, gradient)`` a first-class served request (ROADMAP item 6):
+
+- :mod:`.adjoint` — the structural-class-lifted adjoint program
+  ``(state, params, coeffs) -> (energy, grad)``: O(1)-state reverse gate
+  replay (three live statevectors at any depth), compiled ONCE per
+  (circuit class, Hamiltonian mask shape) by the serve compile cache's
+  gradient entry kind (serve/cache.py ``grad_entry_for``), plus the
+  admission validation (``E_GRADIENT_NOT_UNITARY`` /
+  ``E_GRADIENT_DENSITY_MODE``).
+- :class:`GradResult` — what ``QuESTService.submit_gradient`` futures
+  resolve to.
+- :mod:`.loop` — :func:`training_loop`: the submit-ahead pipelined
+  optimizer driver (multi-start chains microbatch into one ``lax.map``
+  dispatch per wave; one compile per training run).
+
+See docs/SERVING.md "Gradient serving".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .adjoint import (adjoint_terms_fn, grad_group_signature, hamil_masks,
+                      validate_gradient_circuit)
+from .loop import TrainingResult, sgd, training_loop
+
+__all__ = ["GradResult", "adjoint_terms_fn", "grad_group_signature",
+           "hamil_masks", "validate_gradient_circuit",
+           "TrainingResult", "sgd", "training_loop"]
+
+
+@dataclasses.dataclass
+class GradResult:
+    """One completed gradient request: the energy ``<psi|H|psi>`` and the
+    full parameter gradient at the submitted angles, plus the batch
+    context it executed in — the gradient twin of
+    :class:`~quest_tpu.serve.service.ServeResult`.  ``cache_outcome`` and
+    ``numeric_health`` feed the deploy router exactly like forward
+    results: gradient classes are routable classes with their own
+    affinity, and a NaN in the backward pass quarantines the (class,
+    replica) placement."""
+    energy: float
+    gradient: np.ndarray
+    batch_size: int
+    request_id: int
+    cache_outcome: str | None = None
+    numeric_health: dict | None = None

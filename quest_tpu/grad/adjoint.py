@@ -1,0 +1,218 @@
+"""The lifted adjoint-gradient program: ``(state, params, coeffs) ->
+(energy, gradient)`` compiled ONCE per (ansatz class, Hamiltonian shape).
+
+``autodiff.adjoint_gradient_fn`` (PR seed) already implements the
+O(1)-state adjoint-differentiation method — reverse gate replay holding
+three live statevectors for any depth, where taped reverse-mode holds
+depth+1.  But it closes over the initial state AND the Hamiltonian's term
+coefficients, so every (ansatz, Hamiltonian) pair is its own jit trace:
+the one-compile-per-tenant defect the serve cache fixed for forward
+circuits, reborn for gradients.  This module factors the adjoint sweep
+into a PURE body over three runtime operands:
+
+- ``state``  — the initial statevector (the serving layer's |0..0> or a
+  tenant-supplied register),
+- ``params`` — the flat float64 parameter vector a :class:`ParamCircuit`'s
+  ``Param`` placeholders index (the lift is free: parametric angles are
+  runtime operands by construction, unlike forward GateOp payloads),
+- ``coeffs`` — the Hamiltonian's term coefficients.  The PACKED TERM MASKS
+  (:func:`hamil_masks`) stay static — they select the Pauli-sum kernel's
+  data movement, i.e. the program — so a Hamiltonian-coefficient sweep
+  (bond-length scans, re-weighted MaxCut) reuses one executable while a
+  different Pauli structure is honestly a different class.
+
+The serve cache (serve/cache.py ``grad_entry_for``) keys ONE such program
+on (num_qubits, op tuple, masks): an optimizer driving thousands of steps
+with the same circuit skeleton and different angles — the variational-
+training workload of ROADMAP item 6 — compiles once, total.
+
+Admission validation lives here too (:func:`validate_gradient_circuit`):
+the adjoint method's unitarity requirement surfaces as clean
+``QuESTError`` codes (``E_GRADIENT_NOT_UNITARY`` /
+``E_GRADIENT_DENSITY_MODE``) at BOTH entry points — program construction
+and ``QuESTService.submit_gradient`` admission — instead of the bare
+``ValueError``\\ s the seed raised.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..validation import ErrorCode, MESSAGES, QuESTError
+
+__all__ = ["validate_gradient_circuit", "hamil_masks", "adjoint_terms_fn",
+           "grad_group_signature"]
+
+#: static GateOp kinds the backward sweep can invert exactly (x/y/swap are
+#: self-inverse, mrz negates its angle, matrix takes the conjugate
+#: transpose, diagonal the reciprocal — exact for unit-modulus entries)
+_INVERTIBLE_STATIC = ("matrix", "diagonal", "x", "y", "swap", "mrz")
+
+
+def _unitary_eps() -> float:
+    from ..precision import CONFIG
+    return float(CONFIG.real_eps)
+
+
+def validate_gradient_circuit(pc, func: str = "adjoint_gradient_fn") -> None:
+    """The adjoint method's admission contract, as clean validation codes.
+
+    ``E_GRADIENT_NOT_UNITARY`` — a noise channel (dephase / depolarise /
+    damp: CPTP maps, not unitaries), a gate kind with no exact inverse, a
+    non-unitary embedded matrix, or a non-unit-modulus diagonal: any of
+    these breaks the backward sweep's uncompute (psi and the adjoint state
+    must evolve by U^-1 = U^dagger).  Matrices are checked host-side
+    against the precision layer's REAL_EPS — the same tolerance the eager
+    API's unitarity guards use."""
+    from ..autodiff import ParamCircuit, ParamOp, _NOISE_KINDS
+
+    if not isinstance(pc, ParamCircuit):
+        raise TypeError(
+            f"{func} takes a ParamCircuit (quest_tpu.autodiff), got "
+            f"{type(pc)!r}")
+    eps = _unitary_eps()
+    for op in pc.ops:
+        if isinstance(op, ParamOp):
+            if op.kind in _NOISE_KINDS:
+                raise QuESTError(
+                    ErrorCode.GRADIENT_NOT_UNITARY,
+                    MESSAGES[ErrorCode.GRADIENT_NOT_UNITARY]
+                    + f" (noise channel {op.kind!r} on {op.targets})", func)
+            continue
+        if op.kind not in _INVERTIBLE_STATIC:
+            raise QuESTError(
+                ErrorCode.GRADIENT_NOT_UNITARY,
+                MESSAGES[ErrorCode.GRADIENT_NOT_UNITARY]
+                + f" (gate kind {op.kind!r} has no exact inverse here)",
+                func)
+        if op.kind == "matrix":
+            p = op.payload()
+            m = p[0] + 1j * p[1]
+            if not np.allclose(m @ m.conj().T, np.eye(m.shape[0]),
+                               atol=max(eps, 1e-10)):
+                raise QuESTError(
+                    ErrorCode.GRADIENT_NOT_UNITARY,
+                    MESSAGES[ErrorCode.GRADIENT_NOT_UNITARY]
+                    + f" (embedded matrix on {op.targets} is not unitary)",
+                    func)
+        elif op.kind == "diagonal":
+            p = op.payload()
+            mag2 = p[0] ** 2 + p[1] ** 2
+            if not np.allclose(mag2, 1.0, atol=max(eps, 1e-10)):
+                raise QuESTError(
+                    ErrorCode.GRADIENT_NOT_UNITARY,
+                    MESSAGES[ErrorCode.GRADIENT_NOT_UNITARY]
+                    + f" (diagonal on {op.targets} is not unit-modulus)",
+                    func)
+
+
+def hamil_masks(hamil) -> tuple:
+    """The Hamiltonian's STATIC packed term masks ``((x, zy, yc), ...)`` —
+    per term: the X|Y bit mask, the Z|Y bit mask and the Y count mod 4
+    (api.py ``_pauli_sum_terms``, the structured Pauli-sum kernel's static
+    form).  This tuple is the Hamiltonian's contribution to the gradient
+    class key: same Pauli structure = same program, coefficients ride as a
+    runtime operand."""
+    from ..api import _pauli_sum_terms
+    from .. import validation as V
+
+    V.validate_pauli_hamil(hamil, "hamil_masks")
+    return _pauli_sum_terms(np.asarray(hamil.pauli_codes))
+
+
+def grad_group_signature(pc, masks) -> tuple:
+    """The hashable gradient-class signature ``("grad", op tuple, masks)``
+    shared by the service's batching key, the cache's structural key and
+    the router's affinity key.  The op tuple needs no payload lift:
+    ``Param`` placeholders ARE structural (frozen index/scale/shift
+    records), and a recorded ansatz's static gates (h walls, CZ ladders)
+    are identical across tenants by construction — two builds of the same
+    ansatz recipe hash equal."""
+    return ("grad", tuple(pc.ops), tuple(masks))
+
+
+def adjoint_terms_fn(ops, num_qubits: int, num_params: int, terms,
+                     return_state: bool = False, barriers: bool = True):
+    """The pure adjoint sweep ``(state, params, coeffs) -> (energy,
+    gradient)`` over static ``terms`` masks — the body every gradient
+    program variant (single, batched, probed) lowers, and the one
+    ``autodiff.adjoint_gradient_fn`` closes its constants over.
+
+    Forward applies the circuit with no taping; the head is the fused
+    Pauli-sum ``|lam> = H|psi>`` (ops/calc.py) and ``E = <psi|lam>``; the
+    backward sweep walks the ops in reverse, taking one generator inner
+    product ``Im<lam|P_c G|psi>`` per parametric gate and uncomputing BOTH
+    states by gate inverses — three live statevectors at any depth.  The
+    per-step ``optimization_barrier`` pins the uncompute schedule (without
+    it XLA holds many steps' buffers live at once; observed HBM OOM at
+    28q) and is also what makes the ``lax.map`` batch lowering
+    bit-identical to serial execution.
+
+    ``return_state=True`` additionally returns the round-tripped |psi>
+    (forward then fully uncomputed) — the probe point of the instrumented
+    serving variant: its norm must equal the INPUT norm, so uncompute
+    drift and backward-pass NaN both surface on the numeric ledger.
+
+    ``barriers=False`` builds the barrier-free twin for transforms that
+    lack an ``optimization_barrier`` rule on this jax (``jax.vmap`` — the
+    serve cache's ``mode='vmap'`` throughput lowering, which makes no
+    bit-identity or peak-memory claims)."""
+    from .. import precision as _prec
+    from ..autodiff import (Param, _apply_param_op, _gen_inner_im,
+                            _inverse_gate_op)
+    from ..circuit import GateOp, _apply_one
+
+    ops = tuple(ops)
+    terms = tuple(terms)
+    inv_static = {id(op): _inverse_gate_op(op)
+                  for op in ops if isinstance(op, GateOp)}
+    bar = jax.lax.optimization_barrier if barriers else (lambda x: x)
+
+    def value_and_grad(state, params, coeffs):
+        from ..ops import calc as _calc
+
+        params = jnp.asarray(params)
+        if not jnp.issubdtype(params.dtype, jnp.floating):
+            params = params.astype(_prec.CONFIG.real_dtype)
+        coeffs = jnp.asarray(coeffs)
+        psi = state
+        for op in ops:  # forward, no taping
+            psi = (_apply_one(psi, op) if isinstance(op, GateOp)
+                   else _apply_param_op(psi, op, params, None))
+        # barriers around the head: every later backward step consumes the
+        # previous step's barrier output, but the FIRST step — and the
+        # Pauli-sum head itself — would otherwise read raw forward
+        # dataflow, the one place left where a lax.map batch body and the
+        # singleton program can contract FMAs differently (observed: a
+        # one-ulp drift on exactly the final parameter's gradient, fed by
+        # the head fusing the last gate's application into its first
+        # term).  Batched gradients bit-identical to serial, by
+        # construction — the same discipline as the per-step barrier below.
+        psi = bar(psi)
+        lam = _calc.apply_pauli_sum(psi, terms, coeffs)
+        lam = bar(lam)
+        energy = jnp.sum(psi[0] * lam[0] + psi[1] * lam[1])
+        grads = jnp.zeros(num_params, dtype=params.dtype)
+        for op in reversed(ops):
+            if isinstance(op, GateOp):
+                inv = inv_static[id(op)]
+                psi = _apply_one(psi, inv)
+                lam = _apply_one(lam, inv)
+            else:
+                if isinstance(op.param, Param):
+                    contrib = _gen_inner_im(lam, psi, op) * op.param.scale
+                    grads = grads.at[op.param.index].add(
+                        contrib.astype(params.dtype))
+                psi = _apply_param_op(psi, op, params, None, invert=True)
+                lam = _apply_param_op(lam, op, params, None, invert=True)
+            # pin the schedule: without the barrier XLA may hold many
+            # uncompute steps' buffers live at once (observed HBM OOM at 28q)
+            psi, lam, grads = bar((psi, lam, grads))
+        if return_state:
+            return energy, grads.astype(params.dtype), psi
+        return energy, grads.astype(params.dtype)
+
+    return value_and_grad

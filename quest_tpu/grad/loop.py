@@ -1,0 +1,121 @@
+"""The optimizer-loop driver: variational training over gradient serving.
+
+A VQE/QAOA training run is thousands of optimizer steps with the SAME
+circuit skeleton and different angles — the workload gradient serving
+exists for.  :func:`training_loop` drives it through any object with a
+``submit_gradient`` front door (a :class:`~quest_tpu.serve.service.QuESTService`,
+a deploy :class:`~quest_tpu.deploy.router.Router` or
+:class:`~quest_tpu.deploy.pool.ReplicaPool`) with SUBMIT-AHEAD pipelining:
+every chain's next step is submitted the moment its gradient resolves, so
+while the host runs one chain's optimizer math the service is already
+batching/dispatching the others' device work — and multi-start chains
+(``init_params`` of shape (S, P)) land in the same structural class, so
+the service microbatches them into ONE compiled ``lax.map`` dispatch per
+wave.  One compile serves the entire training run: step 1's class miss is
+the only trace, every later step is a cache hit (pinned in
+tests/test_grad.py).
+
+The update rule is any ``update(params, gradient, step) -> params``
+callable (:func:`sgd` is the batteries-included default); determinism is
+inherited from serving's bit-identity contract — batched gradients are
+bit-identical to serial execution, so a training run's trajectory does not
+depend on how its steps happened to co-batch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from concurrent.futures import FIRST_COMPLETED, wait
+
+import numpy as np
+
+__all__ = ["sgd", "training_loop", "TrainingResult"]
+
+
+def sgd(lr: float = 0.05):
+    """Plain gradient descent ``params - lr * grad`` (the default update;
+    any ``update(params, gradient, step) -> params`` callable slots in —
+    optax users wrap their ``opt.update`` here)."""
+    lr = float(lr)
+
+    def update(params, gradient, step):
+        return params - lr * np.asarray(gradient)
+
+    return update
+
+
+@dataclasses.dataclass
+class TrainingResult:
+    """One finished run: final parameters and the full energy history.
+    ``params`` / ``energies`` keep the submitted shape — (P,) and (steps,)
+    for a single chain, (S, P) and (S, steps) for multi-start."""
+    params: np.ndarray
+    energies: np.ndarray
+    steps: int
+    requests: int
+    wall_seconds: float
+
+    @property
+    def best_energy(self) -> float:
+        return float(np.min(self.energies[..., -1]))
+
+
+def training_loop(service, circuit, hamiltonian, init_params, steps: int,
+                  update=None, *, lr: float = 0.05,
+                  deadline_ms: float | None = None,
+                  probes: bool | None = None,
+                  timeout_s: float = 600.0) -> TrainingResult:
+    """Run ``steps`` optimizer steps per chain through gradient serving.
+
+    ``init_params`` is one parameter vector (P,) or a multi-start stack
+    (S, P).  Each chain's step ``k+1`` is submitted as soon as step ``k``'s
+    ``(energy, gradient)`` resolves and the host update is applied —
+    chains pipeline against each other, and same-class submissions
+    microbatch.  ``update(params, gradient, step)`` defaults to
+    :func:`sgd`(``lr``).  The recorded energy history is the energy AT the
+    submitted parameters (so ``energies[..., 0]`` is the initial point's
+    energy and the final ``params`` has had ``steps`` updates applied)."""
+    if update is None:
+        update = sgd(lr)
+    steps = int(steps)
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+    p0 = np.asarray(init_params, np.float64)
+    single = p0.ndim == 1
+    chains = p0[None, :].copy() if single else p0.copy()
+    n_chains = chains.shape[0]
+    energies = np.zeros((n_chains, steps), np.float64)
+    step_of = [0] * n_chains
+    t0 = time.perf_counter()
+    inflight = {
+        service.submit_gradient(circuit, chains[i], hamiltonian,
+                                deadline_ms=deadline_ms, probes=probes): i
+        for i in range(n_chains)}
+    requests = n_chains
+    while inflight:
+        done, _ = wait(list(inflight), timeout=timeout_s,
+                       return_when=FIRST_COMPLETED)
+        if not done:
+            raise TimeoutError(
+                f"training_loop: no gradient resolved within {timeout_s}s "
+                f"({len(inflight)} chain(s) in flight)")
+        for fut in done:
+            i = inflight.pop(fut)
+            res = fut.result()
+            k = step_of[i]
+            energies[i, k] = float(res.energy)
+            chains[i] = np.asarray(
+                update(chains[i], np.asarray(res.gradient), k), np.float64)
+            step_of[i] = k + 1
+            if k + 1 < steps:
+                # submit-ahead: this chain goes straight back into the
+                # batching window while the loop turns to the next future
+                inflight[service.submit_gradient(
+                    circuit, chains[i], hamiltonian,
+                    deadline_ms=deadline_ms, probes=probes)] = i
+                requests += 1
+    wall = time.perf_counter() - t0
+    if single:
+        return TrainingResult(chains[0], energies[0], steps, requests, wall)
+    return TrainingResult(chains, energies, steps, requests, wall)

@@ -64,6 +64,7 @@ DEFAULT_TOLERANCE = 0.20
 #: does not remove it)
 DEFAULT_ROW_TOLERANCES = {
     "serve_vqe_16q_batch64": 0.40,
+    "vqe_grad_16q_batch64": 0.40,
     "densmatr_14q_damping_depol_f64": 0.30,
 }
 

@@ -14,8 +14,8 @@ workload and prints the metrics (the CI gate); see docs/SERVING.md.
 from .cache import (CacheOptions, CompileCache, circuit_from_params,  # noqa: F401
                     global_cache)
 from .metrics import Metrics, parse_prometheus  # noqa: F401
-from .service import QuESTService, ServeResult  # noqa: F401
+from .service import GradResult, QuESTService, ServeResult  # noqa: F401
 
-__all__ = ["QuESTService", "ServeResult", "CompileCache", "CacheOptions",
-           "global_cache", "circuit_from_params", "Metrics",
+__all__ = ["QuESTService", "ServeResult", "GradResult", "CompileCache",
+           "CacheOptions", "global_cache", "circuit_from_params", "Metrics",
            "parse_prometheus"]

@@ -43,6 +43,12 @@ def main(argv=None) -> int:
                              "numerics.py) and gate the numeric-health "
                              "checks; QUEST_TPU_NUMERIC_PROBES=1 does "
                              "the same")
+    parser.add_argument("--gradients", action="store_true",
+                        help="run the gradient workload phase (quest_tpu/"
+                             "grad): mixed forward+gradient storm with "
+                             "bit-identity, oracle, hit-rate, NaN-trip "
+                             "and router-quarantine gates; "
+                             "QUEST_TPU_GRAD_SELFTEST=1 does the same")
     args = parser.parse_args(argv)
     if not args.selftest:
         parser.print_usage()
@@ -50,7 +56,8 @@ def main(argv=None) -> int:
     from .selftest import run_selftest
     return run_selftest(as_json=args.as_json, scale=max(1, args.scale),
                         trace=True if args.trace else None,
-                        probes=True if args.probes else None)
+                        probes=True if args.probes else None,
+                        gradients=True if args.gradients else None)
 
 
 if __name__ == "__main__":

@@ -24,7 +24,8 @@ from __future__ import annotations
 import numpy as np
 import jax.numpy as jnp
 
-__all__ = ["bucket_size", "group_ready", "execute_group"]
+__all__ = ["bucket_size", "group_ready", "execute_group",
+           "execute_grad_group"]
 
 
 def bucket_size(m: int, max_batch: int) -> int:
@@ -94,3 +95,60 @@ def execute_group(cache, entry, requests, state_factory, max_batch: int,
         outs, pvs = outs
         return [outs[i] for i in range(m)], [pvs[i] for i in range(m)], batch
     return [outs[i] for i in range(m)], None, batch
+
+
+def execute_grad_group(cache, entry, requests, state_factory, max_batch: int,
+                       mode: str = "map", probes: bool = False):
+    """Gradient twin of :func:`execute_group`: run one same-class adjoint
+    microbatch; returns ``(energies, grads, probes, batch)`` — per-request
+    energy scalars and (P,) gradient rows in request order, the matching
+    probe vectors (``None`` when probing is off), and the padded batch
+    size executed.  Params AND term coefficients stack on axis 0 (one
+    class = one mask shape, but tenants may weight terms differently);
+    padding duplicates the last request's rows exactly like the forward
+    path, and the default ``lax.map`` lowering keeps batched gradients
+    bit-identical to the serial loop (the serving contract, satellite-
+    pinned in tests/test_grad.py)."""
+    m = len(requests)
+    assert m >= 1
+    if m == 1:
+        req = requests[0]
+        state = state_factory(req)
+        params = jnp.asarray(np.asarray(req.params, np.float64).ravel())
+        coeffs = jnp.asarray(np.asarray(req.coeffs, np.float64).ravel())
+        out = cache.grad_single_program(entry, state, probes=probes).call(
+            state, params, coeffs)
+        if probes:
+            e, g, pv = out
+            return [e], [g], [pv], 1
+        e, g = out
+        return [e], [g], None, 1
+    # lax.map needs >= 2 rows for the shared-body codegen contract (see
+    # cache.grad_single_program); bucket_size already returns >= 2 here
+    batch = bucket_size(m, max_batch)
+    pvec = [np.asarray(r.params, np.float64).ravel() for r in requests]
+    cvec = [np.asarray(r.coeffs, np.float64).ravel() for r in requests]
+    pvec += [pvec[-1]] * (batch - m)
+    cvec += [cvec[-1]] * (batch - m)
+    pb = jnp.asarray(np.stack(pvec))
+    cb = jnp.asarray(np.stack(cvec))
+    stacked = any(r.initial_state is not None for r in requests)
+    if stacked:
+        states = [state_factory(r) for r in requests]
+        states += [states[-1]] * (batch - m)
+        sb = jnp.stack(states)
+        prog = cache.grad_batch_program(entry, states[0], batch,
+                                        stacked=True, mode=mode,
+                                        probes=probes)
+        outs = prog.call(sb, pb, cb)
+    else:
+        state = state_factory(requests[0])
+        prog = cache.grad_batch_program(entry, state, batch, stacked=False,
+                                        mode=mode, probes=probes)
+        outs = prog.call(state, pb, cb)
+    energies, grads = outs[0], outs[1]
+    out_e = [energies[i] for i in range(m)]
+    out_g = [grads[i] for i in range(m)]
+    if probes:
+        return out_e, out_g, [outs[2][i] for i in range(m)], batch
+    return out_e, out_g, None, batch

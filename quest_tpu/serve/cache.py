@@ -86,7 +86,14 @@ class _Program:
 class CacheEntry:
     """One structural class: the (scheduled) skeleton, the operand-slot map
     back into the original op order, and every compiled signature of the
-    class (singleton / batched / donating variants)."""
+    class (singleton / batched / donating variants).
+
+    GRADIENT entries (``grad_entry_for``) reuse this record with
+    ``skeleton`` holding the ParamCircuit's op tuple (Param placeholders
+    are structural by construction, so no offset map exists —
+    ``offsets=None``) and ``hamil`` the Hamiltonian's packed term masks;
+    their programs are the adjoint ``(state, params, coeffs) ->
+    (energy, grad)`` variants."""
     skey: tuple
     options: CacheOptions
     num_qubits: int | None
@@ -96,6 +103,7 @@ class CacheEntry:
     programs: dict = dataclasses.field(default_factory=dict)
     nbytes: int = 0
     alive: bool = True
+    hamil: tuple | None = None      # packed term masks => gradient entry kind
 
 
 def _provenance_offsets(orig_ops, sched_ops) -> tuple:
@@ -285,19 +293,20 @@ class CompileCache:
         warmed mesh classes skip the schedule search too)."""
         return {"num_qubits": entry.num_qubits, "options": entry.options,
                 "skeleton": entry.skeleton, "offsets": entry.offsets,
-                "num_params": entry.num_params}
+                "num_params": entry.num_params, "hamil": entry.hamil}
 
     def install_entry(self, skey, num_qubits, options, skeleton, offsets,
-                      num_params) -> CacheEntry:
+                      num_params, hamil=None) -> CacheEntry:
         """Register a class entry from persisted metadata (the store's
         warm-up path) — idempotent, and deliberately NOT a hit or a miss:
-        pre-population is provisioning, not traffic."""
+        pre-population is provisioning, not traffic.  ``hamil`` (packed
+        term masks) re-materializes a GRADIENT entry."""
         with self._lock:
             e = self._entries.get(skey)
             if e is not None:
                 return e
             e = CacheEntry(skey, options, num_qubits, skeleton, offsets,
-                           num_params)
+                           num_params, hamil=hamil)
             self._entries[skey] = e
             self._evict_locked()
             return e
@@ -550,6 +559,171 @@ class CompileCache:
             return hit[1](re, im)
 
         return run
+
+    # -- the GRADIENT entry kind (quest_tpu/grad) ---------------------------
+    def grad_entry_for(self, ops, num_qubits: int, num_params: int, masks,
+                       options: CacheOptions = CacheOptions()) -> CacheEntry:
+        """Structural lookup for an adjoint-gradient class: ONE entry per
+        (num_qubits, ParamCircuit op tuple, Hamiltonian packed-mask tuple,
+        options).  No payload lift is needed — ``Param`` placeholders are
+        already structural and a recorded ansatz's static gates are
+        identical across tenants — so the op tuple itself is the skeleton;
+        the masks join the key because they select the Pauli-sum head's
+        data movement (coefficients ride as a runtime operand).  Hits and
+        misses land on the same counters as forward classes: gradient
+        lookups are part of the same serving economics."""
+        skey = ("grad", num_qubits, tuple(ops), tuple(masks), options)
+        with _obs.span("cache.lookup", class_key=_obs.key_hash(skey),
+                       engine=options.engine, grad=True) as sp:
+            with self._lock:
+                e = self._entries.get(skey)
+                if e is not None:
+                    self._entries.move_to_end(skey)
+                    self.stats["hits"] += 1
+                    if sp is not None:
+                        sp.attrs["outcome"] = "hit"
+                    _obs.note("cache_outcome", "hit")
+                    return e
+                self.stats["misses"] += 1
+            if sp is not None:
+                sp.attrs["outcome"] = "miss"
+            _obs.note("cache_outcome", "miss")
+            e = CacheEntry(skey, options, num_qubits, tuple(ops), None,
+                           int(num_params), hamil=tuple(masks))
+        with self._lock:
+            have = self._entries.get(skey)
+            if have is not None:      # raced with another thread's build
+                self._entries.move_to_end(skey)
+                return have
+            self._entries[skey] = e
+            self.stats["entry_bytes"] += e.nbytes
+            self._evict_locked()
+        return e
+
+    @staticmethod
+    def _grad_one(entry: CacheEntry, probes: bool, barriers: bool = True):
+        """The per-request adjoint body ``(state, params, coeffs) ->
+        (energy, grad[, probe])`` every gradient program variant lowers —
+        ONE definition (grad/adjoint.py ``adjoint_terms_fn``), so the
+        probed and plain twins can never desynchronize on the sweep.
+
+        The probed variant extends PR 13's numeric probes to the ADJOINT
+        path: the probe vector is taken from the round-tripped |psi>
+        (forward then fully uncomputed — its norm must equal the input
+        norm, so uncompute drift is judged against the ulp band) with
+        NaN/Inf counts of the energy and gradient folded in, so a NaN
+        born in the backward sweep (a poisoned adjoint state) trips the
+        ledger even though |psi> itself round-trips clean.  Probe inputs
+        pass through ``optimization_barrier`` so the primary (energy,
+        grad) outputs compile bit-identical to the unprobed program.
+
+        ``barriers=False`` builds the barrier-free twin for the vmap
+        throughput lowering (``optimization_barrier`` has no batching
+        rule on this jax; vmap mode makes no bit-identity claims)."""
+        from ..grad.adjoint import adjoint_terms_fn
+
+        body = adjoint_terms_fn(entry.skeleton, entry.num_qubits,
+                                entry.num_params, entry.hamil,
+                                return_state=probes, barriers=barriers)
+        if not probes:
+            return body
+
+        from ..obs import numerics as _num
+
+        def one(st, params, coeffs):
+            energy, grads, psi = body(st, params, coeffs)
+            if barriers:
+                pv = _num.grafted_probe(psi)
+                eb, gb = jax.lax.optimization_barrier((energy, grads))
+            else:
+                pv = _num.state_probe_vector(psi)
+                eb, gb = energy, grads
+            nan = (jnp.sum(jnp.isnan(gb)) + jnp.isnan(eb)).astype(pv.dtype)
+            inf = (jnp.sum(jnp.isinf(gb)) + jnp.isinf(eb)).astype(pv.dtype)
+            return energy, grads, pv.at[2].add(nan).at[3].add(inf)
+
+        return one
+
+    def grad_single_program(self, entry: CacheEntry, state, *,
+                            probes: bool = False) -> _Program:
+        """The gradient class's ``(state, params, coeffs) -> (energy,
+        grad)`` executable for this state signature (``probes=True``: the
+        instrumented ``-> (energy, grad, probe_vec)`` twin under its own
+        tag — byte budget and persistence govern both like any other
+        signature).
+
+        Lowered as a DUPLICATED-ROW ``lax.map`` pair (the request's
+        operands stacked twice, element 0 returned): ``lax.map`` compiles
+        ONE loop body for any trip count >= 2, but a trip count of 1 is
+        unrolled into the surrounding program where XLA's fusion may
+        contract the sweep's FMAs differently (measured: one-ulp gradient
+        drift vs the batched program on CPU).  Running the lone request
+        as a pair keeps every gradient execution on the SAME body codegen
+        — bit-identity across batching by construction, at one duplicated
+        element per singleton dispatch (docs/SERVING.md)."""
+        assert entry.hamil is not None, "not a gradient entry"
+        tag = ("grad_single_probed" if probes else "grad_single",
+               _state_sig(state))
+        n_par, n_terms = entry.num_params, len(entry.hamil)
+        one = self._grad_one(entry, probes)
+
+        def build():
+            def run(st, p, c):
+                outs = jax.lax.map(lambda xs: one(st, xs[0], xs[1]),
+                                   (jnp.stack([p, p]), jnp.stack([c, c])))
+                return jax.tree_util.tree_map(lambda x: x[0], outs)
+
+            pav = jax.ShapeDtypeStruct((n_par,), jnp.float64)
+            cav = jax.ShapeDtypeStruct((n_terms,), jnp.float64)
+            return jax.jit(run).lower(state, pav, cav).compile()
+
+        return self._get_program(entry, tag, build)
+
+    def grad_batch_program(self, entry: CacheEntry, state, batch: int, *,
+                           stacked: bool = False, mode: str = "map",
+                           probes: bool = False) -> _Program:
+        """The gradient microbatch executable: params AND coeffs stacked
+        on axis 0 (requests of one class share masks but may carry
+        different coefficients), initial state broadcast or per-request.
+        Same three-way lowering as :meth:`batch_program`: the default
+        ``lax.map`` compiles ONE loop body shared by every trip count
+        >= 2 (the singleton program is a duplicated-row pair for exactly
+        this reason — see :meth:`grad_single_program`), so batched
+        gradients are bit-identical to serial execution; ``mode='vmap'``
+        trades that for vectorized throughput."""
+        assert entry.hamil is not None, "not a gradient entry"
+        if mode not in ("map", "vmap"):
+            raise ValueError(f"batch mode must be 'map' or 'vmap', got {mode!r}")
+        if mode == "map" and batch < 2:
+            raise ValueError(
+                "gradient map-mode batches are >= 2 rows (a 1-trip "
+                "lax.map unrolls into a different fusion context; "
+                "execute_grad_group pads singletons)")
+        tag = ("grad_batch_probed" if probes else "grad_batch", int(batch),
+               bool(stacked), mode, _state_sig(state))
+        n_par, n_terms = entry.num_params, len(entry.hamil)
+        one = self._grad_one(entry, probes, barriers=(mode != "vmap"))
+
+        def build():
+            if mode == "vmap":
+                def run(st, pb, cb):
+                    return jax.vmap(one, in_axes=(0 if stacked else None,
+                                                  0, 0))(st, pb, cb)
+            elif stacked:
+                def run(sb, pb, cb):
+                    return jax.lax.map(lambda xs: one(*xs), (sb, pb, cb))
+            else:
+                def run(st, pb, cb):
+                    return jax.lax.map(lambda xs: one(st, xs[0], xs[1]),
+                                       (pb, cb))
+
+            pav = jax.ShapeDtypeStruct((batch, n_par), jnp.float64)
+            cav = jax.ShapeDtypeStruct((batch, n_terms), jnp.float64)
+            sav = (jax.ShapeDtypeStruct((batch,) + tuple(state.shape),
+                                        state.dtype) if stacked else state)
+            return jax.jit(run).lower(sav, pav, cav).compile()
+
+        return self._get_program(entry, tag, build)
 
     # -- execution front-ends -----------------------------------------------
     def execute(self, ops, state, params=None, *, num_qubits=None,

@@ -74,9 +74,198 @@ def _check(checks: dict, name: str, ok: bool, detail: str = "") -> bool:
     return bool(ok)
 
 
+def _expected_samples(state, seed: int, request_id: int,
+                      shots: int) -> np.ndarray:
+    """The per-request sampling oracle: what QuESTService._sample must
+    have drawn for this (service seed, request id) — ONE definition so
+    the forward and gradient selftest phases can never drift from each
+    other on the recipe."""
+    import jax.numpy as jnp
+
+    from ..ops import measure as _meas
+    from ..rng import MT19937
+
+    n = int(np.log2(np.asarray(state).shape[-1]))
+    probs = np.asarray(_meas.prob_all_outcomes(jnp.asarray(state),
+                                               tuple(range(n))))
+    cdf = np.cumsum(probs)
+    gen = MT19937()
+    gen.init_by_array([seed, request_id])
+    draws = gen.genrand_real1_batch(shots)
+    expect = np.searchsorted(cdf, draws * cdf[-1], side="right")
+    return np.minimum(expect,
+                      np.nonzero(probs > 0)[0][-1]).astype(np.int64)
+
+
+def _run_gradient_phase(checks: dict, echo) -> tuple:
+    """The gradient workload phase (``--gradients``; ci.yml
+    ``grad-selftest``): a mixed forward+gradient storm through ONE
+    service — 32 same-ansatz different-angle ``submit_gradient`` requests
+    (quest_tpu/grad) interleaved with 16 sampled forward requests — then
+    the gates:
+
+    - ``grad_bit_identity``: every batched gradient result is
+      BIT-IDENTICAL to the class's serial program on the same operands;
+    - ``grad_forward_isolation``: the interleaved forward requests stay
+      bit-identical to serial execution AND their per-request MT19937
+      sample streams match the oracle — gradient traffic on the same
+      service must not perturb forward batching or RNG isolation;
+    - ``grad_oracle``: energies/gradients agree with
+      ``jax.value_and_grad(expectation_fn(...))`` (taped reverse-mode
+      through an independent program);
+    - ``grad_hit_rate``: >= 0.9 over the phase's fresh cache (1 gradient
+      class + 1 forward class across 48 requests);
+    - ``grad_nan_trips``: a probed request whose Hamiltonian carries a
+      NaN coefficient (the backward pass' adjoint state is poisoned; the
+      forward |psi> round-trips clean) records ``O_NUMERIC_NAN`` on the
+      ledger, attaches it to the result and dumps the flight ring;
+    - ``grad_nan_quarantine``: on a 2-replica probed deployment, two
+      consecutive NaN gradient outcomes quarantine the (class, replica)
+      placement (deploy/router.py ``report_numeric``).
+
+    Returns ``(ok, doc_block)``."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..autodiff import expectation_fn
+    from ..grad import adjoint as _gradadj
+    from ..models import hardware_efficient_ansatz, tfim_hamiltonian
+    from ..obs import numerics as _num
+    from .cache import CompileCache
+    from .service import QuESTService
+
+    ok = True
+    n = 8
+    cache = CompileCache()
+    ledger = _num.NumericLedger()
+    svc = QuESTService(max_batch=16, max_delay_ms=10, seed=_SEED,
+                       cache=cache, numeric_ledger=ledger, start=False)
+    pc = hardware_efficient_ansatz(n, 2)
+    hamil = tfim_hamiltonian(n)
+    rng = np.random.default_rng(_SEED)
+    grad_params = [rng.uniform(-np.pi, np.pi, pc.num_params)
+                   for _ in range(32)]
+    fwd_circuits = [vqe_ansatz(n, 1, seed=s) for s in range(16)]
+    grad_futs, fwd_futs = [], []
+    for i in range(32):
+        grad_futs.append(svc.submit_gradient(pc, grad_params[i], hamil))
+        if i < len(fwd_circuits):
+            fwd_futs.append(svc.submit(fwd_circuits[i], shots=32))
+    svc.start()
+    ok &= _check(checks, "grad_drain", svc.drain(timeout=900),
+                 "mixed forward+gradient storm drained")
+    grads = [f.result(timeout=120) for f in grad_futs]
+    fwds = [f.result(timeout=120) for f in fwd_futs]
+    batch_sizes = sorted({g.batch_size for g in grads})
+
+    # batched == serial, bitwise (the gradient serving contract)
+    masks = _gradadj.hamil_masks(hamil)
+    entry = cache.grad_entry_for(tuple(pc.ops), n, pc.num_params, masks)
+    st = jnp.zeros((2, 1 << n), jnp.float64).at[0, 0].set(1.0)
+    cf = jnp.asarray(np.asarray(hamil.term_coeffs, np.float64))
+    serial = cache.grad_single_program(entry, st)
+    exact = True
+    for p, res in zip(grad_params, grads):
+        e, g = serial.call(st, jnp.asarray(p), cf)
+        if float(e) != res.energy or not np.array_equal(np.asarray(g),
+                                                        res.gradient):
+            exact = False
+            echo(f"FAIL gradient request {res.request_id}: batched "
+                 "(energy, grad) != serial program")
+    ok &= _check(checks, "grad_bit_identity", exact,
+                 f"32 gradients, batch sizes {batch_sizes}")
+
+    # interleaved forward requests: bit-identity + RNG isolation
+    fwd_ok = True
+    for circuit, res in zip(fwd_circuits, fwds):
+        want = np.asarray(cache.execute(circuit.key(), st,
+                                        num_qubits=n))
+        if not np.array_equal(res.state, want):
+            fwd_ok = False
+            echo("FAIL interleaved forward request: state != serial")
+        if not np.array_equal(res.samples,
+                              _expected_samples(want, _SEED,
+                                                res.request_id, 32)):
+            fwd_ok = False
+            echo("FAIL interleaved forward request: sample stream diverged")
+    ok &= _check(checks, "grad_forward_isolation", fwd_ok,
+                 f"{len(fwds)} sampled forward requests interleaved")
+
+    # independent taped-AD oracle on a few requests
+    oracle = jax.jit(jax.value_and_grad(expectation_fn(pc, hamil)))
+    worst = 0.0
+    for p, res in list(zip(grad_params, grads))[:4]:
+        v, g = oracle(jnp.asarray(p))
+        worst = max(worst, abs(float(v) - res.energy),
+                    float(np.abs(res.gradient - np.asarray(g)).max()))
+    ok &= _check(checks, "grad_oracle", worst < 1e-9,
+                 f"max |adjoint - jax.grad| = {worst:.3g}")
+
+    snap = cache.snapshot()
+    ok &= _check(checks, "grad_hit_rate", snap["hit_rate"] >= 0.9,
+                 f"hit rate {snap['hit_rate']:.3f} over "
+                 f"{snap['hits'] + snap['misses']} lookups "
+                 f"({snap['compiles']} compiles)")
+
+    # probed NaN injection: a NaN term coefficient poisons the ADJOINT
+    # state (lam = H|psi>), not the forward register — exactly the
+    # backward-pass corruption the probe's grad/energy fold exists for
+    dumps_before = svc.flight_recorder.dumps
+    bad = tfim_hamiltonian(n)
+    bad.term_coeffs[0] = float("nan")
+    nan_res = svc.submit_gradient(pc, grad_params[0], bad,
+                                  probes=True).result(timeout=300)
+    led = ledger.snapshot()
+    nan_ok = (nan_res.numeric_health is not None
+              and nan_res.numeric_health["nan_count"] > 0
+              and any(_num.NUMERIC_NAN in f
+                      for f in nan_res.numeric_health["findings"])
+              and led["nan_total"] >= 1
+              and svc.flight_recorder.dumps > dumps_before)
+    ok &= _check(checks, "grad_nan_trips", nan_ok,
+                 f"nan_count {nan_res.numeric_health['nan_count']}, ledger "
+                 f"nan_total {led['nan_total']}, flight dumps "
+                 f"{svc.flight_recorder.dumps - dumps_before}")
+    svc.shutdown()
+
+    # router quarantine on a probed 2-replica deployment (small class so
+    # the probed program compile stays cheap)
+    from ..deploy import ReplicaPool, RouterConfig
+    pc4 = hardware_efficient_ansatz(4, 1)
+    h4 = tfim_hamiltonian(4)
+    bad4 = tfim_hamiltonian(4)
+    bad4.term_coeffs[0] = float("nan")
+    p4 = rng.uniform(-1, 1, pc4.num_params)
+    pool = ReplicaPool(num_replicas=2, probes=True, max_delay_ms=0,
+                       seed=_SEED,
+                       router_config=RouterConfig(quarantine_nans=2))
+    with pool:
+        for _ in range(2):   # two CONSECUTIVE NaN outcomes on one class
+            pool.submit_gradient(pc4, p4, bad4).result(timeout=300)
+        quarantined = list(pool.router.snapshot()["quarantined"])
+        # the clean gradient class still serves while the pair sits out
+        clean = pool.submit_gradient(pc4, p4, h4).result(timeout=300)
+    ok &= _check(checks, "grad_nan_quarantine",
+                 len(quarantined) >= 1 and clean.numeric_health is not None
+                 and not clean.numeric_health["findings"],
+                 f"{len(quarantined)} quarantined placement(s); clean class "
+                 "served clean")
+
+    doc = {"requests": {"gradient": len(grads), "forward": len(fwds)},
+           "batch_sizes": batch_sizes,
+           "cache": snap,
+           "oracle_max_abs_diff": worst,
+           "nan_injection": {"health": nan_res.numeric_health,
+                             "ledger": led},
+           "quarantine": quarantined,
+           "ledger": ledger.snapshot()}
+    return ok, doc
+
+
 def run_selftest(as_json: bool = False, scale: int = 1,
                  trace: bool | None = None,
-                 probes: bool | None = None) -> int:
+                 probes: bool | None = None,
+                 gradients: bool | None = None) -> int:
     """Run the workload through fresh services sharing one fresh cache;
     print metrics (human text, or ONE JSON document with ``--json``).
     Returns the process exit status: 0 iff every check passed.
@@ -106,7 +295,14 @@ def run_selftest(as_json: bool = False, scale: int = 1,
     corruption trips the ledger) — the ci.yml ``numeric-selftest``
     contract.  The existing bit-identity check doubles as the
     instrumented-vs-uninstrumented proof: probed results are compared
-    against the UNPROBED serial oracle."""
+    against the UNPROBED serial oracle.
+
+    ``gradients=True`` (or ``QUEST_TPU_GRAD_SELFTEST=1``) additionally
+    runs the gradient workload phase (:func:`_run_gradient_phase`; the
+    ci.yml ``grad-selftest`` contract): a mixed forward+gradient storm
+    with bit-identity, forward-isolation, oracle, hit-rate, NaN-trip and
+    router-quarantine gates, reported under the document's
+    ``"gradient"`` block."""
     import os
 
     import jax
@@ -114,8 +310,6 @@ def run_selftest(as_json: bool = False, scale: int = 1,
 
     from .. import obs as _obs
     from ..circuit import _run_ops
-    from ..ops import measure as _meas
-    from ..rng import MT19937
     from .cache import CompileCache
     from .metrics import parse_prometheus
     from .service import QuESTService
@@ -131,6 +325,8 @@ def run_selftest(as_json: bool = False, scale: int = 1,
         _obs.reset_tracing()
     if probes is None:
         probes = os.environ.get("QUEST_TPU_NUMERIC_PROBES") == "1"
+    if gradients is None:
+        gradients = os.environ.get("QUEST_TPU_GRAD_SELFTEST") == "1"
 
     from ..obs import numerics as _num
     numeric_ledger = _num.NumericLedger() if probes else None
@@ -211,15 +407,9 @@ def run_selftest(as_json: bool = False, scale: int = 1,
         worst_ulp = max(worst_ulp, float(np.abs(res.state - eager).max()))
         n_checked += 1
         if shots:
-            probs = np.asarray(_meas.prob_all_outcomes(
-                jnp.asarray(serial), tuple(range(circuit.num_qubits))))
-            cdf = np.cumsum(probs)
-            gen = MT19937()
-            gen.init_by_array([_SEED, res.request_id])
-            draws = gen.genrand_real1_batch(shots)
-            expect = np.searchsorted(cdf, draws * cdf[-1], side="right")
-            expect = np.minimum(expect, np.nonzero(probs > 0)[0][-1])
-            if not np.array_equal(res.samples, expect.astype(np.int64)):
+            if not np.array_equal(res.samples,
+                                  _expected_samples(serial, _SEED,
+                                                    res.request_id, shots)):
                 exact = False
                 echo(f"FAIL {label}: sample stream diverged from the "
                      "per-request MT19937 oracle")
@@ -311,6 +501,11 @@ def run_selftest(as_json: bool = False, scale: int = 1,
                        "by_class": numeric_ledger.by_class(),
                        "corruption": trip}
 
+    gradient_doc = None
+    if gradients:
+        g_ok, gradient_doc = _run_gradient_phase(checks, echo)
+        ok &= g_ok
+
     trace_doc = None
     if trace:
         # export THROUGH the cross-process merge (obs/aggregate.py): the
@@ -338,6 +533,8 @@ def run_selftest(as_json: bool = False, scale: int = 1,
                "prometheus": prom, "flight_recorder": flight, "slo": slo}
         if numeric_doc is not None:
             doc["numeric"] = numeric_doc
+        if gradient_doc is not None:
+            doc["gradient"] = gradient_doc
         if trace_doc is not None:
             doc["trace"] = trace_doc
         print(json.dumps(doc, default=float))

@@ -38,6 +38,8 @@ import numpy as np
 
 from .. import circuit as _circ
 from .. import obs as _obs
+from ..grad import GradResult
+from ..grad import adjoint as _gradadj
 from ..obs import numerics as _numerics
 from ..obs.export import EXECUTION_SPAN
 from ..obs.flight import FlightRecorder
@@ -48,7 +50,7 @@ from . import batch as _batch
 from .cache import CacheOptions, CompileCache, global_cache
 from .metrics import BATCH_BUCKETS, Metrics
 
-__all__ = ["QuESTService", "ServeResult"]
+__all__ = ["QuESTService", "ServeResult", "GradResult"]
 
 _U32 = 0xFFFFFFFF
 
@@ -88,6 +90,13 @@ class _Request:
     class_key: str = ""             # obs.key_hash(structural part), for SLO/trace
     probes: bool = False            # numeric-probe-instrumented execution
     expected_norm: float = 1.0      # drift baseline: the input state's norm
+    # gradient requests (submit_gradient): ops is the ParamCircuit op
+    # tuple, params the parameter vector, and the three fields below carry
+    # the Hamiltonian side of the class (quest_tpu/grad)
+    grad: bool = False
+    coeffs: np.ndarray | None = None    # term coefficients (runtime operand)
+    masks: tuple | None = None          # packed term masks (structural)
+    grad_num_params: int = 0
 
 
 class QuESTService:
@@ -277,6 +286,13 @@ class QuESTService:
         ``numeric_health`` record on its result and flight record."""
         if not isinstance(circuit, _circ.Circuit):
             raise TypeError(f"submit takes a Circuit, got {type(circuit)!r}")
+        from ..autodiff import ParamCircuit, ParamOp
+        if (isinstance(circuit, ParamCircuit)
+                and any(isinstance(op, ParamOp) for op in circuit.ops)):
+            raise TypeError(
+                "submit takes a concrete Circuit; a ParamCircuit with "
+                "traced parameters is a gradient workload — use "
+                "submit_gradient(circuit, params, hamiltonian)")
         ops = circuit.key()
         expected = int(sum(_circ.op_param_count(op) for op in ops))
         if params is None:
@@ -300,8 +316,6 @@ class QuESTService:
         shots = int(shots)
         if shots < 0:
             raise ValueError("shots must be >= 0")
-        now = time.monotonic()
-        deadline = None if deadline_ms is None else now + float(deadline_ms) / 1000.0
         probed = self.default_probes if probes is None else bool(probes)
         # the probe flag is part of the BATCHING key (a probed and an
         # unprobed request run different compiled programs and must not
@@ -310,7 +324,109 @@ class QuESTService:
         # observability mode, not a different workload class
         group_key = (circuit.num_qubits, circuit.key(structural=True),
                      state0 is None, probed)
+        return self._enqueue(ops=ops, num_qubits=circuit.num_qubits,
+                             pvec=pvec, shots=shots, deadline_ms=deadline_ms,
+                             state0=state0, group_key=group_key,
+                             probed=probed)
+
+    def submit_gradient(self, circuit, params=None, hamiltonian=None,
+                        deadline_ms: float | None = None,
+                        initial_state=None,
+                        probes: bool | None = None) -> Future:
+        """Enqueue one ``(energy, gradient)`` request; the Future resolves
+        to a :class:`~quest_tpu.grad.GradResult` (quest_tpu/grad — the
+        adjoint-differentiation serving path).
+
+        ``circuit`` is a :class:`~quest_tpu.autodiff.ParamCircuit` (the
+        recorded ansatz — ONE object shared by every tenant of the class);
+        ``params`` its flat parameter vector for this request;
+        ``hamiltonian`` a :class:`~quest_tpu.matrices.PauliHamil` whose
+        packed term masks join the structural class (same Pauli structure
+        = one compiled program; coefficients are a runtime operand, so a
+        coefficient sweep stays on one executable).  Admission enforces
+        the adjoint method's contract with the gradient validation codes:
+        a noise channel or non-unitary payload raises
+        ``E_GRADIENT_NOT_UNITARY``, a density register
+        ``E_GRADIENT_DENSITY_MODE`` — rejected HERE, not on the worker.
+        Same-class requests microbatch exactly like forward traffic (the
+        gradient flag joins the batching key, so gradient and forward
+        groups never co-batch on one program), and batched gradients are
+        bit-identical to the serial loop under the default
+        ``batch_mode='map'``."""
+        from ..autodiff import ParamCircuit
+        if hamiltonian is None:
+            raise TypeError(
+                "submit_gradient(circuit, params, hamiltonian) requires a "
+                "PauliHamil: the energy head is <psi|H|psi>")
+        if not isinstance(circuit, ParamCircuit):
+            raise TypeError(
+                f"submit_gradient takes a ParamCircuit, got {type(circuit)!r}")
+        if self._options.overlap or (self._options.num_devices or 1) > 1:
+            raise QuESTError(
+                ErrorCode.INVALID_SCHEDULE_OPTION,
+                MESSAGES[ErrorCode.INVALID_SCHEDULE_OPTION]
+                + " Gradient serving is single-device: the adjoint sweep "
+                "is not scheduled through the mesh/overlap executors.",
+                "submit_gradient")
+        # admission-time validation (satellite: the error surface) — the
+        # same codes adjoint_gradient_fn raises, so a bad circuit fails
+        # the SUBMITTER, never the worker thread
+        _gradadj.validate_gradient_circuit(circuit, "submit_gradient")
+        if hamiltonian.num_qubits != circuit.num_qubits:
+            raise QuESTError(
+                ErrorCode.MISMATCHING_PAULI_HAMIL_QUREG_NUM_QUBITS,
+                MESSAGES[ErrorCode.MISMATCHING_PAULI_HAMIL_QUREG_NUM_QUBITS],
+                "submit_gradient")
+        masks = _gradadj.hamil_masks(hamiltonian)
+        coeffs = np.asarray(hamiltonian.term_coeffs, np.float64).ravel()
+        if coeffs.shape != (len(masks),):
+            raise ValueError(
+                f"hamiltonian has {len(masks)} terms but "
+                f"{coeffs.shape[0]} coefficients")
+        if params is None:
+            raise TypeError(
+                "submit_gradient requires the parameter vector (the "
+                "request's angles for the shared ansatz)")
+        pvec = np.asarray(params, np.float64).ravel()
+        if pvec.shape != (circuit.num_params,):
+            raise ValueError(
+                f"params has {pvec.shape[0]} scalars; this ansatz takes "
+                f"{circuit.num_params}")
+        state0 = None
+        if initial_state is not None:
+            state0 = np.asarray(initial_state)
+            if state0.shape != (2, 1 << circuit.num_qubits):
+                if state0.shape == (2, 1 << (2 * circuit.num_qubits)):
+                    # a Choi-doubled register: the density-mode rejection,
+                    # not a generic shape complaint
+                    raise QuESTError(
+                        ErrorCode.GRADIENT_DENSITY_MODE,
+                        MESSAGES[ErrorCode.GRADIENT_DENSITY_MODE],
+                        "submit_gradient")
+                raise ValueError(
+                    f"initial_state must be (2, 2^n) SoA, got {state0.shape}")
+        probed = self.default_probes if probes is None else bool(probes)
+        sig = _gradadj.grad_group_signature(circuit, masks)
+        group_key = (circuit.num_qubits, sig, state0 is None, probed)
+        return self._enqueue(ops=tuple(circuit.ops),
+                             num_qubits=circuit.num_qubits, pvec=pvec,
+                             shots=0, deadline_ms=deadline_ms, state0=state0,
+                             group_key=group_key, probed=probed, grad=True,
+                             coeffs=coeffs, masks=masks,
+                             grad_num_params=circuit.num_params,
+                             span="serve.submit_gradient")
+
+    def _enqueue(self, *, ops, num_qubits, pvec, shots, deadline_ms, state0,
+                 group_key, probed, grad=False, coeffs=None, masks=None,
+                 grad_num_params=0, span="serve.submit") -> Future:
+        """The shared admission tail of :meth:`submit` /
+        :meth:`submit_gradient`: bounded-queue entry, backpressure,
+        flight/SLO/span bookkeeping — one code path so the two front
+        doors can never drift on the backpressure contract."""
+        func = "submit_gradient" if grad else "submit"
         class_key = _obs.key_hash(group_key[:3])
+        now = time.monotonic()
+        deadline = None if deadline_ms is None else now + float(deadline_ms) / 1000.0
         # the numeric drift baseline is the REQUEST'S OWN input norm: a
         # caller-supplied initial state need not be unit-norm (only the
         # shape is validated above), and judging it against 1.0 would
@@ -327,7 +443,7 @@ class QuESTService:
             if not self._accepting or self._stop:
                 raise QuESTError(ErrorCode.SERVICE_SHUTDOWN,
                                  MESSAGES[ErrorCode.SERVICE_SHUTDOWN],
-                                 "submit")
+                                 func)
             if len(self._queue) >= self.max_queue:
                 self.metrics.inc("queue_rejected_total")
                 depth = len(self._queue)
@@ -340,12 +456,15 @@ class QuESTService:
             else:
                 rid = self._next_rid
                 self._next_rid += 1
-                self._queue.append(_Request(rid, ops, circuit.num_qubits,
+                self._queue.append(_Request(rid, ops, num_qubits,
                                             pvec, shots, deadline, state0,
                                             fut, now, group_key, class_key,
-                                            probed, expected_norm))
+                                            probed, expected_norm, grad,
+                                            coeffs, masks, grad_num_params))
                 depth = len(self._queue)
                 self.metrics.inc("requests_submitted_total")
+                if grad:
+                    self.metrics.inc("grad_requests_submitted_total")
                 self.metrics.set_gauge("queue_depth", depth)
                 self._cond.notify_all()
         # saturation is sampled on EVERY admission attempt, bounces
@@ -358,10 +477,10 @@ class QuESTService:
             self.flight_recorder.reject(rejected_rid, class_key, depth)
             self.flight_recorder.dump(ErrorCode.QUEUE_FULL)
             raise QuESTError(ErrorCode.QUEUE_FULL,
-                             MESSAGES[ErrorCode.QUEUE_FULL], "submit")
+                             MESSAGES[ErrorCode.QUEUE_FULL], func)
         self.flight_recorder.admit(rid, class_key, depth,
                                    deadline_ms=deadline_ms)
-        _obs.emit_span("serve.submit", t0=t0p, dur=time.perf_counter() - t0p,
+        _obs.emit_span(span, t0=t0p, dur=time.perf_counter() - t0p,
                        request_id=rid, class_key=class_key,
                        queue_depth=depth)
         return fut
@@ -463,17 +582,32 @@ class QuESTService:
                 # happen to batch.  Each lookup runs under its request's
                 # context so the cache's spans correlate, and reports its
                 # hit/miss outcome through the notes channel.
+                is_grad = live[0].grad    # group key includes the flag
                 outcomes: dict = {}
                 for req in live:
                     with _obs.request(req.rid), \
                             _obs.collect_notes() as notes:
-                        entry = self._cache.entry_for(req.ops,
-                                                      req.num_qubits,
-                                                      self._options)
+                        if is_grad:
+                            entry = self._cache.grad_entry_for(
+                                req.ops, req.num_qubits,
+                                req.grad_num_params, req.masks,
+                                self._options)
+                        else:
+                            entry = self._cache.entry_for(req.ops,
+                                                          req.num_qubits,
+                                                          self._options)
                     outcomes[req.rid] = notes.get("cache_outcome", "miss")
                 probed = live[0].probes   # group key includes the flag
                 t0 = time.perf_counter()
-                if entry.skeleton is None:
+                energies = grads = None
+                if is_grad:
+                    energies, grads, probe_vecs, padded = \
+                        _batch.execute_grad_group(
+                            self._cache, entry, live, self._state,
+                            self.max_batch, mode=self.batch_mode,
+                            probes=probed)
+                    jax.block_until_ready(grads[-1])
+                elif entry.skeleton is None:
                     # opaque overlapped class (PR 4): per-request programs.
                     # The program is opaque, so the probe runs as a
                     # separate pure reduction over the finished state —
@@ -484,15 +618,18 @@ class QuESTService:
                     padded = len(live)
                     probe_vecs = ([_numerics.state_probe_vector(st)
                                    for st in states] if probed else None)
+                    jax.block_until_ready(states[-1])
                 else:
                     states, probe_vecs, padded = _batch.execute_group(
                         self._cache, entry, live, self._state,
                         self.max_batch, mode=self.batch_mode, probes=probed)
-                jax.block_until_ready(states[-1])
+                    jax.block_until_ready(states[-1])
                 dt = time.perf_counter() - t0
                 class_key = _obs.key_hash(entry.skey)
                 parent = bsp.span_id if bsp is not None else None
             self.metrics.inc("batches_total")
+            if is_grad:
+                self.metrics.inc("grad_batches_total")
             self.metrics.observe("batch_size", len(live),
                                  buckets=BATCH_BUCKETS)
             self.metrics.observe("execute_seconds", dt)
@@ -505,7 +642,8 @@ class QuESTService:
             # one D2H sync per request on the latency-critical path
             probe_host = (np.asarray(jnp.stack(probe_vecs))
                           if probed else None)
-            for i, (req, st) in enumerate(zip(live, states)):
+            for i, req in enumerate(live):
+                st = grads[i] if is_grad else states[i]
                 # the per-request execution span: the trace's link from a
                 # request_id to what ran for it (class, engine, cache
                 # outcome, batch) — the correlation contract
@@ -522,11 +660,16 @@ class QuESTService:
                     # then drift vs the depth-derived ulp band) and keeps
                     # the per-class aggregation the scrape reports; the
                     # drift baseline was fixed at submit time (the
-                    # request's own input norm)
+                    # request's own input norm).  Gradient probes read the
+                    # ROUND-TRIPPED |psi> (forward + uncompute, so the
+                    # band covers ~3x the op count) with backward-pass
+                    # NaN/Inf folded in from the energy and gradient
+                    depth = (3 * len(req.ops) + len(req.masks)
+                             if is_grad else len(req.ops))
                     rec = self.numeric_ledger.record(
                         class_key, probe_host[i],
                         engine=entry.options.engine, dtype=str(st.dtype),
-                        num_qubits=req.num_qubits, num_ops=len(req.ops),
+                        num_qubits=req.num_qubits, num_ops=depth,
                         class_key=class_key,
                         expected_norm=req.expected_norm, warn=False)
                     health = rec.as_health()
@@ -540,12 +683,18 @@ class QuESTService:
                     if any(_numerics.NUMERIC_DRIFT in f
                            for f in rec.findings):
                         self.metrics.inc("numeric_drift_total")
-                samples = self._sample(st, req) if req.shots else None
+                if is_grad:
+                    result = GradResult(float(energies[i]), np.asarray(st),
+                                        len(live), req.rid,
+                                        outcomes[req.rid], health)
+                    self.metrics.inc("grad_requests_completed_total")
+                else:
+                    samples = self._sample(st, req) if req.shots else None
+                    result = ServeResult(np.asarray(st), samples,
+                                         len(live), req.rid,
+                                         outcomes[req.rid], health)
                 try:
-                    req.future.set_result(ServeResult(np.asarray(st), samples,
-                                                      len(live), req.rid,
-                                                      outcomes[req.rid],
-                                                      health))
+                    req.future.set_result(result)
                 except InvalidStateError:
                     self.flight_recorder.resolve(req.rid, "cancelled",
                                                  batch_id=batch_id)

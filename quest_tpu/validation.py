@@ -105,6 +105,12 @@ class ErrorCode:
     QUEUE_FULL = "E_QUEUE_FULL"
     DEADLINE_EXCEEDED = "E_DEADLINE_EXCEEDED"
     SERVICE_SHUTDOWN = "E_SERVICE_SHUTDOWN"
+    # gradient serving (quest_tpu/grad) — the adjoint method's admission
+    # contract: reverse gate replay uncomputes states by EXACT inverses,
+    # so the circuit must be unitary and the register a statevector
+    # (docs/SERVING.md "Gradient serving")
+    GRADIENT_NOT_UNITARY = "E_GRADIENT_NOT_UNITARY"
+    GRADIENT_DENSITY_MODE = "E_GRADIENT_DENSITY_MODE"
 
 
 # Human-readable messages; tests substring-match these, mirroring the
@@ -182,6 +188,8 @@ MESSAGES = {
     ErrorCode.QUEUE_FULL: "The serving queue holds max_queue pending requests; this request was rejected for backpressure. Retry after the queue drains, raise max_queue, or add capacity.",
     ErrorCode.DEADLINE_EXCEEDED: "The request's deadline expired before a batch slot was available; it was completed exceptionally without executing.",
     ErrorCode.SERVICE_SHUTDOWN: "The service is shut down (or shutting down): this request was not executed. Submit to a live replica, or restart the service.",
+    ErrorCode.GRADIENT_NOT_UNITARY: "Adjoint gradients require a unitary circuit: the backward sweep uncomputes states by exact gate inverses, which noise channels and non-unitary operators do not have. Use jax.grad(expectation_fn(..., density=True)) for noisy gradients.",
+    ErrorCode.GRADIENT_DENSITY_MODE: "Adjoint gradients are defined for statevector registers only; a density-matrix (Choi-doubled) state cannot be uncomputed by gate inverses. Use jax.grad(expectation_fn(..., density=True)).",
     ErrorCode.PLANE_ONLY: "This register uses plane-pair storage (the single-chip memory ceiling); the requested operation needs the stacked amplitude array, which cannot be materialised at this size. Supported in plane mode: init*, single-qubit gates, applyFullQFT, measure/collapse, probabilities, amplitude reads.",
 }
 
