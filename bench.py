@@ -477,11 +477,16 @@ def bench_density(n=14, depth=5, precision=2, seed=7):
     gate + shadow, then mixDamping and mixDepolarising per qubit pair
     (BASELINE config 4).
 
-    f32 runs the whole layer as one fused fori_loop program; f64 runs ONE
-    barriered donating program per layer (the barriers stop XLA from
-    overlapping two ops' state-sized temporaries, which is what pushed an
-    unbarriered 42-op f64 program over HBM; r04's per-op-program fallback
-    was dispatch-bound at ~0.24 s per tunnel round-trip)."""
+    f32 (PR 15) records the layer as a ``DensityCircuit`` and compiles it
+    through ``compile_circuit(engine="auto")``: on a TPU the epoch
+    executor fuses the 42-op mirrored layer + channels into ~3 aliased
+    superoperator passes (the row carries the plan breakdown and the
+    model-vs-measured ledger record); on CPU auto resolves to one fused
+    XLA program.  f64 runs ONE barriered donating program per layer (the
+    barriers stop XLA from overlapping two ops' state-sized temporaries,
+    which is what pushed an unbarriered 42-op f64 program over HBM; r04's
+    per-op-program fallback was dispatch-bound at ~0.24 s per tunnel
+    round-trip)."""
     import numpy as np
     import jax.numpy as jnp
     from quest_tpu.ops import apply as _ap
@@ -498,31 +503,6 @@ def bench_density(n=14, depth=5, precision=2, seed=7):
         gates.append((q, _ap.mat_pair(u), _ap.mat_pair(u.conj())))
 
     import jax
-
-    def channels(s):
-        for q in range(0, n, 2):
-            s = _deco.mix_damping(s, jnp.asarray(0.02, dtype=jnp.float64), q, n)
-        for q in range(1, n, 2):
-            s = _deco.mix_depolarising(s, jnp.asarray(0.02, dtype=jnp.float64), q, n)
-        return s
-
-    def layer(s):
-        for q, up, upc in gates:
-            s = _ap.apply_matrix(s, jnp.asarray(up, dtype=s.dtype), (q,))
-            s = _ap.apply_matrix(s, jnp.asarray(upc, dtype=s.dtype), (q + n,))
-        return channels(s)
-
-    def layer_packed(s):
-        """f32 form: ALL 2n single-qubit ops of the layer (gate U_q on
-        qubit q, shadow conj(U_q) on qubit q+n — distinct qubits, so their
-        product is one 2n-fold kron) via the in-place Pallas layer engine:
-        ~3 HBM passes replace 2n per-op passes."""
-        from quest_tpu.ops.pallas_layer import _layer_all_p
-        packed = jnp.asarray(np.stack([up for _, up, _ in gates]
-                                      + [upc for _, _, upc in gates]),
-                             dtype=s.dtype)
-        re, im = _layer_all_p(s[0], s[1], packed)
-        return channels(jnp.stack([re, im]))
 
     # rho = |0><0| flattened; donation consumes the buffer, so each timed
     # call gets a fresh state
@@ -544,20 +524,39 @@ def bench_density(n=14, depth=5, precision=2, seed=7):
     num_ops = 2 * n + n  # gate+shadow per qubit, channel per qubit
 
     if precision == 1:
-        from quest_tpu.ops.pallas_layer import layer_supported
+        # PR 15: the f32 layer is ONE compiled noisy-circuit program
+        # through compile_circuit(engine="auto") on the Choi-doubled
+        # register (circuit.DensityCircuit): the mirrored Haar layer AND
+        # the damping/depolarising channels lower together — on a TPU the
+        # epoch executor fuses the 42-op layer into ~3 aliased passes with
+        # the channels as superoperator stages; on CPU auto resolves to
+        # the XLA engine and the row documents the spec decision + plan
+        from quest_tpu.circuit import DensityCircuit, compile_circuit
+        from quest_tpu.parallel import planner as _planner
 
-        f32_layer = layer_packed if layer_supported(2 * n) else layer
+        dc = DensityCircuit(n)
+        for q, up, _ in gates:
+            dc.unitary(q, up[0] + 1j * up[1])
+        for q in range(0, n, 2):
+            dc.damp(q, 0.02)
+        for q in range(1, n, 2):
+            dc.depolarise(q, 0.02)
+
+        spec = _planner.select_engine(dc, 1, backend="tpu")
+        run_layer = compile_circuit(dc)         # engine="auto" default
 
         @partial(jax.jit, donate_argnums=(0,))
         def run(s, iters):
             def body(_, st):
-                return f32_layer(st)
+                return run_layer(st)
             return trace_of(jax.lax.fori_loop(0, iters, body, s))
 
-        # x64 off for the Mosaic layer pass (same constraint as
+        # x64 off for any Mosaic lowering (same constraint as
         # pallas_layer.apply_1q_layer); f32 operands are unaffected
         with _compat.enable_x64(False):
+            t0 = time.perf_counter()
             float(run(fresh(), 1))
+            compile_s = time.perf_counter() - t0
             t0 = time.perf_counter()
             base = float(run(fresh(), 0))
             overhead = time.perf_counter() - t0
@@ -620,7 +619,174 @@ def bench_density(n=14, depth=5, precision=2, seed=7):
     value = (1 << (2 * n)) * num_ops * depth / compute
     cfg = {"qubits": n, "depth": depth, "precision": precision,
            "ops_per_layer": num_ops, "seconds": dt}
+    if precision == 1:
+        import jax
+        from quest_tpu.obs import global_ledger, hbm_watermark
+        model = spec["model"] or {}
+        live_model = (model.get("pallas_seconds")
+                      if run_layer.engine == "pallas"
+                      else model.get("xla_seconds"))
+        wm = hbm_watermark()
+        drift = global_ledger().record(
+            f"densmatr_{n}q_layer", engine=run_layer.engine, num_devices=1,
+            platform=jax.devices()[0].platform,
+            predicted_seconds=(live_model * depth if live_model else None),
+            measured_seconds=compute,
+            predicted_hbm_passes=(model.get("pallas_hbm_passes")
+                                  if run_layer.engine == "pallas"
+                                  else model.get("xla_hbm_passes")),
+            predicted_collectives=0, measured_hlo_collectives=0,
+            compile_seconds=compile_s,
+            hbm_peak_bytes=(wm or {}).get("peak_bytes_in_use"))
+        cfg.update({
+            "density_qubits": n, "register_qubits": 2 * n,
+            "model_vs_measured": drift.as_dict(),
+            "engine_live": run_layer.engine,
+            "engine_live_reason": run_layer.engine_reason,
+            "engine_tpu_spec": spec["engine"],
+            "engine_tpu_spec_reason": spec["reason"],
+            "fused_passes_per_layer": model.get("pallas_hbm_passes"),
+            "superop_pass_breakdown": model.get("pallas_pass_breakdown"),
+            "model_engine_speedup": (
+                model["xla_seconds"] / model["pallas_seconds"]
+                if model.get("pallas_seconds") else None)})
+        _stamp_counters(cfg, compile_s)
     cfg.update(_roofline(1 << (2 * n), precision, num_ops * depth, compute))
+    return value, cfg
+
+
+def bench_density_kraus_auto(n_ceiling=16, n_measured=12, layers=2, iters=2,
+                             seed=19):
+    """``densmatr_16q_kraus_auto_engine``: the density-window CEILING row.
+
+    A 16-qubit density register is a 32-qubit Choi-doubled vector — one
+    past the epoch executor's int32-index ceiling (so ``engine="auto"``
+    resolves to XLA with the density-window reason) and, at 4^16 amps,
+    past any single chip's HBM regardless of engine.  The row RECORDS that
+    decision (the boundary documentation, the density twin of
+    vqe_16q_auto_engine's n >= 17 floor note) and MEASURES the largest
+    in-window Kraus workload instead: ``n_measured``-density-qubit mixed
+    unitary + per-qubit general Kraus channel layers under auto vs
+    forced-XLA, with the fused superoperator plan and the
+    model-vs-measured ledger record."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from functools import partial
+    from quest_tpu import _compat
+    from quest_tpu.circuit import DensityCircuit, compile_circuit
+    from quest_tpu.obs import global_ledger, hbm_watermark
+    from quest_tpu.parallel import planner as _planner
+
+    rng = np.random.default_rng(seed)
+
+    def haar():
+        g = rng.normal(size=(2, 2)) + 1j * rng.normal(size=(2, 2))
+        u, r = np.linalg.qr(g)
+        return u * (np.diag(r) / np.abs(np.diag(r)))
+
+    from quest_tpu.ops.decoherence import channel_kraus
+
+    def noisy(n, depth):
+        dc = DensityCircuit(n)
+        for layer in range(depth):
+            for q in range(n):
+                dc.unitary(q, haar())
+            for q in range(layer % 2, n, 2):
+                # the canonical damping Kraus pair (decoherence.py — the
+                # same definition the equivalence prover certifies)
+                dc.kraus((q,), channel_kraus("damp", 0.02 + 0.005 * layer))
+        return dc
+
+    # the ceiling decision: 16 density qubits = 32 register qubits
+    ceiling = noisy(n_ceiling, 1)
+    spec16 = _planner.select_engine(ceiling, 1, backend="tpu")
+    assert spec16["engine"] == "xla", spec16
+    assert "density" in spec16["reason"], spec16["reason"]
+
+    # the measured in-window workload
+    dc = noisy(n_measured, layers)
+    spec = _planner.select_engine(dc, 1, backend="tpu")
+    run_auto = compile_circuit(dc)
+    run_xla = compile_circuit(dc, engine="xla")
+
+    dim = 1 << n_measured
+
+    @jax.jit
+    def trace_of(s):
+        diag = jax.lax.slice(s[0], (0,), (dim * dim,), (dim + 1,))
+        return jnp.sum(diag.astype(jnp.float64))
+
+    def fresh():
+        return jnp.zeros((2, 1 << (2 * n_measured)),
+                         jnp.float32).at[0, 0].set(1.0)
+
+    def timed(run):
+        @partial(jax.jit, donate_argnums=(0,))
+        def body(s, k):
+            def one(_, st):
+                return run(st)
+            return trace_of(jax.lax.fori_loop(0, k, one, s))
+
+        with _compat.enable_x64(False):
+            t0 = time.perf_counter()
+            float(body(fresh(), 1))
+            compile_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            float(body(fresh(), 0))
+            overhead = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            trace = float(body(fresh(), iters))
+            dt = time.perf_counter() - t0
+        assert abs(trace - 1.0) < 1e-2, f"trace not preserved: {trace}"
+        return max(dt - overhead, 1e-9), dt, compile_s
+
+    compute_a, dt, compile_s = timed(run_auto)
+    compute_x, _, _ = timed(run_xla)
+
+    gates = len(dc.ops)
+    value = (1 << (2 * n_measured)) * gates * iters / compute_a
+    model = spec["model"] or {}
+    live_model = (model.get("pallas_seconds")
+                  if run_auto.engine == "pallas"
+                  else model.get("xla_seconds"))
+    wm = hbm_watermark()
+    drift = global_ledger().record(
+        f"densmatr_kraus_{n_measured}q", engine=run_auto.engine,
+        num_devices=1, platform=jax.devices()[0].platform,
+        predicted_seconds=(live_model * iters if live_model else None),
+        measured_seconds=compute_a,
+        predicted_hbm_passes=(model.get("pallas_hbm_passes")
+                              if run_auto.engine == "pallas"
+                              else model.get("xla_hbm_passes")),
+        predicted_collectives=0, measured_hlo_collectives=0,
+        compile_seconds=compile_s,
+        hbm_peak_bytes=(wm or {}).get("peak_bytes_in_use"))
+    cfg = {"qubits": n_measured, "density_qubits_measured": n_measured,
+           "density_qubits_ceiling": n_ceiling,
+           "register_qubits": 2 * n_measured,
+           "layers": layers, "iters": iters, "precision": 1,
+           "ops": gates, "seconds": dt,
+           "ceiling_decision": {"engine": spec16["engine"],
+                                "reason": spec16["reason"]},
+           "model_vs_measured": drift.as_dict(),
+           "engine_live": run_auto.engine,
+           "engine_live_reason": run_auto.engine_reason,
+           "engine_tpu_spec": spec["engine"],
+           "engine_tpu_spec_reason": spec["reason"],
+           "fused_passes": model.get("pallas_hbm_passes"),
+           "superop_pass_breakdown": model.get("pallas_pass_breakdown"),
+           "model_engine_speedup": (
+               model["xla_seconds"] / model["pallas_seconds"]
+               if model.get("pallas_seconds") else None),
+           "amps_per_sec_xla_engine":
+               (1 << (2 * n_measured)) * gates * iters / compute_x,
+           "vs_xla_engine": compute_x / compute_a}
+    passes = (model.get("pallas_hbm_passes") or gates) \
+        if run_auto.engine == "pallas" else gates
+    cfg.update(_roofline(1 << (2 * n_measured), 1, passes * iters,
+                         compute_a))
+    _stamp_counters(cfg, compile_s)
     return value, cfg
 
 
@@ -1542,6 +1708,11 @@ def main() -> None:
         # forced XLA, with the planner's spec-level decision recorded
         add("random24_f32_auto_engine", bench_random24_auto_engine)
         add("vqe_16q_auto_engine", bench_vqe16_auto_engine)
+        # density noise channels through the auto engine: the 16q-density
+        # CEILING decision (outside the [5, 15] density window — and its
+        # 4^16-amp state exceeds any single chip) plus a measured
+        # in-window Kraus workload (see the fn)
+        add("densmatr_16q_kraus_auto_engine", bench_density_kraus_auto)
         add("qft_28q_f32", bench_qft, 28, 1)
         if platform != "cpu":
             add("qft_28q_f32_inplace_ordered", bench_qft_inplace, 28, True)

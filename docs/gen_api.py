@@ -68,6 +68,12 @@ GROUPS = [
     ("TPU-native extensions", ["set_precision", "get_precision", "Circuit",
                                "compile_circuit", "apply_circuit", "random_circuit",
                                "qft_circuit"]),
+    ("Density noise circuits (Choi-doubled)",
+     ["DensityCircuit", "DensityCircuit.damp", "DensityCircuit.depolarise",
+      "DensityCircuit.dephase", "DensityCircuit.two_qubit_dephase",
+      "DensityCircuit.mix_pauli", "DensityCircuit.kraus",
+      "validate_density_operands",
+      "analysis.check_density_lowering", "analysis.check_density_plan"]),
     ("Differentiable simulation", ["Param", "ParamCircuit", "build_param_circuit",
                                    "state_fn", "expectation_fn",
                                    "adjoint_gradient_fn"]),

@@ -9,7 +9,17 @@ CI's ``--verify-schedule`` smoke runs every factory here.
 
 from __future__ import annotations
 
-from quest_tpu.circuit import Circuit, qft_circuit
+from quest_tpu.circuit import Circuit, DensityCircuit, qft_circuit
+
+
+def _haar(rng, k: int = 1):
+    """One Haar-random 2^k x 2^k unitary (the QR sampler every factory
+    here shares — phase-normalized so the distribution is exactly Haar)."""
+    import numpy as np
+    d = 1 << k
+    g = rng.normal(size=(d, d)) + 1j * rng.normal(size=(d, d))
+    u, r = np.linalg.qr(g)
+    return u * (np.diag(r) / np.abs(np.diag(r)))
 
 
 def distributed_qft() -> Circuit:
@@ -50,21 +60,46 @@ def mixed_envelope_16q() -> Circuit:
     IR-equivalent and probes the actual kernels in interpret mode."""
     import numpy as np
     rng = np.random.default_rng(16)
-
-    def haar(k: int) -> np.ndarray:
-        d = 1 << k
-        g = rng.normal(size=(d, d)) + 1j * rng.normal(size=(d, d))
-        u, r = np.linalg.qr(g)
-        return u * (np.diag(r) / np.abs(np.diag(r)))
-
     c = Circuit(16)
     c.h(0)
-    c.multi_qubit_unitary((3, 12), haar(2))      # lane x fiber: decomposed
-    c.multi_qubit_unitary((8, 14), haar(2))      # sublane x fiber
-    c.multi_qubit_unitary((5,), haar(1), controls=(11,))
+    c.multi_qubit_unitary((3, 12), _haar(rng, 2))  # lane x fiber: decomposed
+    c.multi_qubit_unitary((8, 14), _haar(rng, 2))  # sublane x fiber
+    c.multi_qubit_unitary((5,), _haar(rng), controls=(11,))
     c.cz(2, 9)
     c.multi_rotate_z((0, 4, 8, 12), 0.61)
     c.swap(1, 13)                                # deferred: zero passes
-    c.unitary(1, haar(1))
+    c.unitary(1, _haar(rng))
     c.phase_shift(15, 0.37, controls=(6,))
+    return c
+
+
+def density_noise_9q() -> DensityCircuit:
+    """A 9-qubit NOISY density-matrix circuit (Choi-doubled: an 18-qubit
+    register — full block geometry, pack passes included) exercising the
+    epoch executor's fused superoperator lowering (docs/SCHEDULER.md §6
+    density rows): two mixed layers of Haar 1q gates (each recorded with
+    its conjugate bra-side shadow) followed by amplitude damping,
+    depolarising, dephasing and a general 1-qubit Kraus channel — the
+    channels whose doubled pair (q, q+9) straddles the block/pack split
+    lower as widened-column pack superoperator stages, the rest as
+    block superoperator/dense stages.  CI's density verify-schedule step
+    proves the Choi-doubling against the Kraus oracle
+    (``check_density_lowering``), the fused plan IR-equivalent
+    (``check_epoch_plan``) and the actual kernels in interpret mode —
+    with ZERO V_* findings and zero XLA-fallback ops."""
+    import numpy as np
+    rng = np.random.default_rng(9)
+    n = 9
+    c = DensityCircuit(n)
+    for layer in range(2):
+        for q in range(n):
+            c.unitary(q, _haar(rng))
+        for q in range(layer, n, 2):
+            c.damp(q, 0.02 + 0.01 * layer)
+        for q in range(1 - layer, n, 2):
+            c.depolarise(q, 0.015)
+    c.dephase(4, 0.08)
+    c.two_qubit_dephase(0, 5, 0.06)
+    c.kraus((8,), [np.diag([1.0, np.sqrt(0.85)]),
+                   np.array([[0.0, np.sqrt(0.15)], [0.0, 0.0]])])
     return c

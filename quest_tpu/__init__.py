@@ -33,8 +33,9 @@ from .api import __all__ as _api_all
 from .api import (_amps_buffer, _hamil_buffers,  # C-shim helpers  # noqa: F401
                   _validate_create_qureg, _validate_create_diag,
                   _matrix_from_buffer)
-from .circuit import (Circuit, compile_circuit, apply_circuit,  # noqa: F401
-                      random_circuit, qft_circuit)
+from .circuit import (Circuit, DensityCircuit, compile_circuit,  # noqa: F401
+                      apply_circuit, random_circuit, qft_circuit,
+                      validate_density_operands)
 from .autodiff import (Param, ParamCircuit, build as build_param_circuit,  # noqa: F401
                        adjoint_gradient_fn, expectation_fn, state_fn)
 from .trajectories import (trajectory_expectation_fn,  # noqa: F401
@@ -61,8 +62,8 @@ from .obs import (TraceRecorder, FlightRecorder, Ledger,  # noqa: F401
 __version__ = "0.1.0"
 __all__ = list(_api_all) + [
     "set_precision", "get_precision", "real_eps",
-    "Circuit", "compile_circuit", "apply_circuit", "random_circuit",
-    "qft_circuit",
+    "Circuit", "DensityCircuit", "compile_circuit", "apply_circuit",
+    "random_circuit", "qft_circuit", "validate_density_operands",
     "Param", "ParamCircuit", "build_param_circuit", "expectation_fn",
     "state_fn", "adjoint_gradient_fn",
     "trajectory_state_fn", "trajectory_expectation_fn",

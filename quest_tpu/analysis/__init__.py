@@ -38,7 +38,9 @@ from .diagnostics import (AnalysisCode, Diagnostic, Severity,  # noqa: F401
 from .circuit_ir import analyze_circuit  # noqa: F401
 from .abstract_eval import check_abstract_eval  # noqa: F401
 from .purity import lint_package, lint_paths, lint_source  # noqa: F401
-from .equivalence import (check_epoch_plan, check_equivalence,  # noqa: F401
+from .equivalence import (check_density_lowering,  # noqa: F401
+                          check_density_plan,
+                          check_epoch_plan, check_equivalence,
                           check_overlap_plan, probe_epoch_execution,
                           verify_schedule)
 from .jaxpr_audit import (audit_dispatch, audit_epoch_donation,  # noqa: F401
@@ -61,6 +63,7 @@ __all__ = [
     "lint_source", "lint_paths", "lint_package",
     "check_equivalence", "check_overlap_plan", "verify_schedule",
     "check_epoch_plan", "probe_epoch_execution",
+    "check_density_lowering", "check_density_plan",
     "audit_dispatch", "audit_epoch_donation", "audit_overlap",
     "audit_schedule_pair",
     "count_jaxpr_collectives", "count_hlo_collectives",

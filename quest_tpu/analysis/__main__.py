@@ -195,6 +195,16 @@ def _verify_report(label: str, circuit, args, scheduled, echo) -> tuple:
     report["hlo_pair"] = {k: pair[k]
                           for k in ("unscheduled_hlo", "scheduled_hlo")}
     d5: list = []
+    if getattr(scheduled, "density_qubits", None) is not None:
+        # the density half of the rollout gate — the Choi-doubling itself
+        # (mirrored pairing, conjugate twist, channel superoperators vs
+        # the Kraus oracle) — is IR-level and ENGINE-INDEPENDENT: it runs
+        # for every density circuit, epoch envelope or not (an
+        # out-of-window wrong-conjugate shadow must not sail through)
+        from .equivalence import check_density_lowering
+        dproof = check_density_lowering(scheduled)
+        report["density_proven"] = not dproof
+        d5 += dproof
     if args.engine == "pallas" and args.devices <= 1:
         # the epoch-executor rollout gate (docs/ANALYSIS.md): the Pallas
         # lowering of the scheduled circuit is proven IR-equivalent
@@ -202,11 +212,12 @@ def _verify_report(label: str, circuit, args, scheduled, echo) -> tuple:
         # probed in interpret mode where the register fits
         from ..ops import epoch_pallas as _ep
         if _ep.epoch_supported(scheduled.num_qubits, args.precision):
-            from .equivalence import check_epoch_plan, probe_epoch_execution
+            from .equivalence import (check_epoch_plan,
+                                      probe_epoch_execution)
             plan_e = _ep.plan_circuit(scheduled.key(), scheduled.num_qubits)
             proof = check_epoch_plan(scheduled, plan_e)
             probe = probe_epoch_execution(scheduled)
-            d5 = proof + probe
+            d5 += proof + probe
             report["epoch_plan"] = plan_e.summary()
             # the IR proof stands alone; the probe's skip warning beyond
             # its register cap must not read as a failed proof

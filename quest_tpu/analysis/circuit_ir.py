@@ -74,6 +74,38 @@ def _check_wires(i: int, op, n: int, out: list) -> None:
                                 detail=f"state {b}"))
 
 
+def _check_channel_payload(i: int, op, eps: float, out: list) -> None:
+    """A density channel slot (DensityCircuit.channel_slots): the payload
+    is a SUPEROPERATOR on the doubled (q, q+n) wires — deliberately
+    non-unitary — so the validity condition is trace preservation
+    (the same invariant serve admission enforces, E_INVALID_KRAUS_OPS),
+    not unitarity."""
+    from ..ops.decoherence import superop_trace_preserving
+    if op.kind not in ("matrix", "diagonal") or op.matrix is None:
+        out.append(diag(ErrorCode.INVALID_KRAUS_OPS, Severity.ERROR,
+                        op_index=i,
+                        detail=f"channel slot holds a '{op.kind}' op"))
+        return
+    k = len(op.targets) // 2
+    payload = op.payload()
+    if op.kind == "diagonal":
+        if payload.shape != (2, 1 << len(op.targets)):
+            out.append(diag(ErrorCode.INVALID_UNITARY_SIZE, Severity.ERROR,
+                            op_index=i, detail=f"shape {payload.shape}"))
+            return
+        payload = np.stack([np.diag(payload[0]), np.diag(payload[1])])
+    dim = 1 << len(op.targets)
+    if payload.shape != (2, dim, dim):
+        out.append(diag(ErrorCode.INVALID_UNITARY_SIZE, Severity.ERROR,
+                        op_index=i, detail=f"shape {payload.shape}"))
+        return
+    if not superop_trace_preserving(payload, k, 10 * eps):
+        out.append(diag(ErrorCode.INVALID_KRAUS_OPS, Severity.ERROR,
+                        op_index=i,
+                        detail="channel superoperator does not preserve "
+                               "Tr(rho)"))
+
+
 def _check_payload(i: int, op, eps: float, out: list) -> None:
     if op.kind == "bitperm":
         # payload is the destination-wire list of a qubit permutation
@@ -210,6 +242,9 @@ def analyze_circuit(circuit, *, num_devices: int = 1, precision: int = 1,
     out: list[Diagnostic] = []
     eps = real_eps(None)
     n = circuit.num_qubits
+    # density channel slots (circuit.DensityCircuit) hold superoperators —
+    # validated trace-preserving, not unitary
+    channel_slots = getattr(circuit, "channel_slots", frozenset())
     plane_mode = _plane_mode_predicted(circuit, num_devices, precision)
     for i, op in enumerate(circuit.ops):
         if op.kind not in _KNOWN_KINDS:
@@ -217,7 +252,10 @@ def analyze_circuit(circuit, *, num_devices: int = 1, precision: int = 1,
                             op_index=i, detail=f"kind '{op.kind}'"))
             continue
         _check_wires(i, op, n, out)
-        _check_payload(i, op, eps, out)
+        if i in channel_slots:
+            _check_channel_payload(i, op, eps, out)
+        else:
+            _check_payload(i, op, eps, out)
         _check_shard_fit(i, op, circuit, num_devices, out)
         if plane_mode:
             _check_plane_compat(i, op, out)

@@ -57,7 +57,8 @@ import numpy as np
 
 from .diagnostics import AnalysisCode, Diagnostic, Severity, diag
 
-__all__ = ["check_equivalence", "check_overlap_plan", "verify_schedule"]
+__all__ = ["check_equivalence", "check_overlap_plan", "verify_schedule",
+           "check_density_lowering", "check_density_plan"]
 
 # dense windows: 2^10 x 2^10 complex is the largest matrix worth building
 _MAX_WINDOW_QUBITS = 10
@@ -874,6 +875,199 @@ def check_epoch_plan(circuit, plan=None) -> list[Diagnostic]:
         rec.ops.append(GateOp("bitperm", support, (), (),
                               tuple(float(mapping[w]) for w in support), None))
     return check_equivalence(circuit, rec)
+
+
+# ---------------------------------------------------------------------------
+# density (Choi-doubled) lowering: the superoperator window domain
+# ---------------------------------------------------------------------------
+
+def _vec_density(rho: np.ndarray) -> np.ndarray:
+    """vec of a w-qubit density matrix in the engine layout: flat index =
+    row_bits + (col_bits << w) (the getDensityAmp convention)."""
+    return rho.T.reshape(-1)   # column-major: index = row + col * 2^w
+
+
+def check_density_lowering(circuit, *, eps: float = 1e-8,
+                           probes: int = 2) -> list[Diagnostic]:
+    """Prove a :class:`~quest_tpu.circuit.DensityCircuit`'s Choi-doubled
+    recording faithful to its DENSITY-level semantics — the translation
+    step :func:`check_equivalence` cannot see, because both sides of that
+    proof are already doubled op lists.
+
+    Two obligations, both discharged on <= ``_MAX_WINDOW_QUBITS``-wire
+    doubled windows (never a 4^n state):
+
+    1. **Mirrored-pass pairing + conjugate twist.**  Every unitary op must
+       be immediately followed by its bra-side shadow — same kind on wires
+       shifted by n with the payload CONJUGATED — and the pair's doubled
+       window operator must equal ``conj(U) ⊗ U`` for the op's full
+       controlled unitary U (dense compare on random flattened window
+       density matrices).  A wrong-conjugate mutation (a shadow recorded
+       unconjugated) is refuted here with a witness.
+
+    2. **Channel superoperators against the Kraus oracle.**  Every channel
+       slot's recorded payload is applied two INDEPENDENT ways to random
+       window density matrices: as the recorded doubled-window operator,
+       and through ``ops/decoherence._superop_apply`` driving the
+       superoperator ``Σ conj(K)⊗K`` rebuilt from the channel's DEFINING
+       Kraus operators (``decoherence.channel_kraus`` — never the payload
+       builders, so a corrupted payload cannot self-certify).  Mismatch or
+       a non-trace-preserving map is ``V_SEMANTICS_CHANGED``.
+
+    Returns [] iff every pair and channel is proven; windows too wide for
+    the dense oracle report ``V_UNVERIFIED_REGION`` (the payload-level
+    conjugation check still applies)."""
+    import jax.numpy as jnp
+
+    from ..circuit import _shadow_op
+    from ..ops import decoherence as _deco
+    n = getattr(circuit, "density_qubits", None)
+    if n is None:
+        return [diag(AnalysisCode.SEMANTICS_CHANGED, Severity.ERROR,
+                     detail="not a DensityCircuit: no density_qubits "
+                            "marker / channel log to verify against")]
+    channels = {rec[0]: rec for rec in getattr(circuit, "channel_log", ())}
+    out: list[Diagnostic] = []
+    rng = np.random.RandomState(971)
+
+    def rand_rho(w: int) -> np.ndarray:
+        a = rng.randn(1 << w, 1 << w) + 1j * rng.randn(1 << w, 1 << w)
+        rho = a @ a.conj().T
+        return rho / np.trace(rho)
+
+    i = 0
+    ops = list(circuit.ops)
+    while i < len(ops):
+        op = ops[i]
+        rec = channels.get(i)
+        if rec is not None:
+            _, kind, targets = rec[:3]
+            args = rec[3:]
+            doubled = tuple(targets) + tuple(t + n for t in targets)
+            if tuple(op.targets) != doubled or op.controls:
+                out.append(diag(
+                    AnalysisCode.SEMANTICS_CHANGED, Severity.ERROR,
+                    op_index=i,
+                    detail=(f"channel op {i} ({kind}) on wires "
+                            f"{op.targets}: expected the doubled pair "
+                            f"{doubled}")))
+                i += 1
+                continue
+            k = len(targets)
+            if 2 * k > _MAX_WINDOW_QUBITS:
+                out.append(diag(
+                    AnalysisCode.UNVERIFIED_REGION, Severity.WARNING,
+                    op_index=i,
+                    detail=(f"channel op {i} ({kind}): {2 * k}-wire "
+                            "doubled window exceeds the dense oracle")))
+                i += 1
+                continue
+            from ..circuit import GateOp
+            kraus = _deco.channel_kraus(kind, *args)
+            sp = _deco.kraus_superoperator(kraus)
+            # recorded payload on window-local wires: matrix index bit j
+            # <-> op.targets[j], so the local twin just renumbers targets
+            local = GateOp(op.kind, tuple(range(2 * k)), (), (),
+                           op.matrix, op.shape)
+            got_m = _window_unitary([local], range(2 * k))
+            worst = 0.0
+            for _ in range(probes):
+                rho = rand_rho(k)
+                vec = _vec_density(rho)
+                state = jnp.stack([jnp.asarray(vec.real),
+                                   jnp.asarray(vec.imag)])
+                # the INDEPENDENT application engine: decoherence's
+                # gather/dense superoperator path on the flattened window
+                oracle = _deco._superop_apply(
+                    state, jnp.asarray(sp), tuple(range(2 * k)), None)
+                want = (np.asarray(oracle[0])
+                        + 1j * np.asarray(oracle[1]))
+                got = got_m @ vec
+                worst = max(worst, float(np.max(np.abs(got - want))))
+            if worst > eps:
+                out.append(diag(
+                    AnalysisCode.SEMANTICS_CHANGED, Severity.ERROR,
+                    op_index=i,
+                    detail=(f"channel op {i} ({kind} on {targets}): "
+                            "recorded superoperator disagrees with the "
+                            f"Kraus-defined channel by {worst:.3g} on "
+                            "random window density matrices")))
+            if not _deco.superop_trace_preserving(
+                    np.stack([got_m.real, got_m.imag]), k, eps):
+                out.append(diag(
+                    AnalysisCode.SEMANTICS_CHANGED, Severity.ERROR,
+                    op_index=i,
+                    detail=(f"channel op {i} ({kind} on {targets}): "
+                            "recorded superoperator does not preserve "
+                            "Tr(rho)")))
+            i += 1
+            continue
+        # unitary op: must be followed by its conjugate shadow
+        wires = op.targets + op.controls
+        if any(q >= n for q in wires):
+            out.append(diag(
+                AnalysisCode.SEMANTICS_CHANGED, Severity.ERROR, op_index=i,
+                detail=(f"op {i} ({op.kind} on {op.targets}) touches bra "
+                        "wires but is not a recorded channel slot or a "
+                        "ket-side op — the mirrored pairing is broken")))
+            i += 1
+            continue
+        if i + 1 >= len(ops):
+            out.append(diag(
+                AnalysisCode.SEMANTICS_CHANGED, Severity.ERROR, op_index=i,
+                detail=f"op {i} ({op.kind} on {op.targets}) has no "
+                       "bra-side shadow"))
+            break
+        shadow = ops[i + 1]
+        want_shadow = _shadow_op(op, n)
+        if not _op_identical(shadow, want_shadow, eps):
+            out.append(diag(
+                AnalysisCode.SEMANTICS_CHANGED, Severity.ERROR,
+                op_index=i + 1,
+                detail=(f"op {i + 1} is not the conjugate shadow of op "
+                        f"{i} ({op.kind} on {op.targets}): the conjugate "
+                        "twist is wrong (U ⊗ U instead of U ⊗ U*, or "
+                        "mismatched wires)")))
+            i += 2
+            continue
+        # dense window certificate: [op, shadow] == conj(U) ⊗ U
+        w = len(wires)
+        if 2 * w <= _MAX_WINDOW_QUBITS:
+            try:
+                support = sorted(wires) + [q + n for q in sorted(wires)]
+                pair_m = _window_unitary([op, shadow], support)
+                # _window_unitary positions ops by SORTED support: embed
+                # U onto the sorted ket order before taking conj(U) ⊗ U
+                pos = {q: j for j, q in enumerate(sorted(wires))}
+                perm_u = _embed_unitary(
+                    w, _op_base(op), [pos[t] for t in op.targets],
+                    [pos[c] for c in op.controls], op.control_states)
+            except _TooWide:
+                pass
+            else:
+                want_m = np.kron(perm_u.conj(), perm_u)
+                err = float(np.max(np.abs(pair_m - want_m)))
+                if err > eps:
+                    out.append(diag(
+                        AnalysisCode.SEMANTICS_CHANGED, Severity.ERROR,
+                        op_index=i,
+                        detail=(f"mirrored pair (ops {i}, {i + 1}) does "
+                                f"not implement U rho U†: |delta| = "
+                                f"{err:.3g} vs conj(U) ⊗ U")))
+        i += 2
+    return out
+
+
+def check_density_plan(circuit, plan=None) -> list[Diagnostic]:
+    """The density rollout gate: :func:`check_density_lowering` (the
+    Choi-doubling itself — mirrored pairing, conjugate twist, channel
+    superoperators vs the Kraus oracle) PLUS :func:`check_epoch_plan` (the
+    epoch executor's fused lowering of the doubled circuit, proven by the
+    same abstract domains that certify scheduler rewrites).  [] is a proof
+    that the fused superoperator passes execute the density circuit the
+    user recorded."""
+    return (check_density_lowering(circuit)
+            + check_epoch_plan(circuit, plan))
 
 
 def probe_epoch_execution(circuit, *, atol: float = 5e-5,

@@ -1472,7 +1472,8 @@ def mixTwoQubitDepolarising(qureg: Qureg, q1: int, q2: int, prob: float) -> None
             fac = math.sqrt(1 - p) if (i == 0 and j == 0) else math.sqrt(p / 15)
             ops.append(fac * np.kron(PAULI_MATRICES[j], PAULI_MATRICES[i]))
     qureg.amps = _deco.apply_kraus_map(qureg.amps, ops, (int(q1), int(q2)),
-                                       qureg.num_qubits_represented)
+                                       qureg.num_qubits_represented,
+                                       validate=False)  # CPTP by construction
     qureg.qasm.record_comment(
         f"Here, a two-qubit depolarising channel of probability {p:g} was applied.")
 
@@ -1488,7 +1489,8 @@ def mixPauli(qureg: Qureg, target: int, prob_x: float, prob_y: float,
             math.sqrt(prob_x), math.sqrt(prob_y), math.sqrt(prob_z)]
     ops = [facs[i] * PAULI_MATRICES[i] for i in range(4)]
     qureg.amps = _deco.apply_kraus_map(qureg.amps, ops, (int(target),),
-                                       qureg.num_qubits_represented)
+                                       qureg.num_qubits_represented,
+                                       validate=False)  # CPTP by construction
     qureg.qasm.record_comment(
         f"Here, a Pauli noise channel was applied to qubit {int(target)}")
 
@@ -1505,7 +1507,8 @@ def _mix_kraus(qureg: Qureg, targets, ops, num_ops, func: str) -> None:
     V.validate_kraus_cptp(ops, func, eps=real_eps(qureg.dtype))
     V.validate_multi_qubit_matrix_fits_in_shard(qureg, 2 * len(targets), func)
     qureg.amps = _deco.apply_kraus_map(qureg.amps, ops, targets,
-                                       qureg.num_qubits_represented)
+                                       qureg.num_qubits_represented,
+                                       validate=False)  # validate_kraus_cptp ran above
     qureg.qasm.record_comment(
         f"Here, an undisclosed Kraus map was applied to {len(targets)} qubit(s)")
 

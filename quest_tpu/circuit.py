@@ -31,9 +31,10 @@ from . import _compat
 from . import obs as _obs
 from .ops import apply as _ap
 
-__all__ = ["Circuit", "GateOp", "compile_circuit", "apply_circuit",
-           "op_operands", "op_param_count", "structural_op", "param_vector",
-           "lifted_operands", "random_circuit", "qft_circuit"]
+__all__ = ["Circuit", "DensityCircuit", "GateOp", "compile_circuit",
+           "apply_circuit", "op_operands", "op_param_count", "structural_op",
+           "param_vector", "lifted_operands", "validate_density_operands",
+           "random_circuit", "qft_circuit"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -61,19 +62,25 @@ class Circuit:
         self.ops: list[GateOp] = []
 
     # --- recording ---------------------------------------------------------
+    def _record(self, op: GateOp) -> None:
+        """The one append point every builder method funnels through, so a
+        subclass can transform recorded ops uniformly (DensityCircuit
+        doubles each unitary with its conjugate shadow)."""
+        self.ops.append(op)
+
     def _mat(self, u, targets, controls=(), control_states=()):
         up = _ap.mat_pair(u)
-        self.ops.append(GateOp("matrix", tuple(targets), tuple(controls),
-                               tuple(control_states),
-                               tuple(up.ravel()), up.shape))
+        self._record(GateOp("matrix", tuple(targets), tuple(controls),
+                            tuple(control_states),
+                            tuple(up.ravel()), up.shape))
         return self
 
     def _diag(self, d, targets, controls=(), control_states=()):
         d = np.asarray(d, dtype=np.complex128)
         dp = np.stack([d.real, d.imag])
-        self.ops.append(GateOp("diagonal", tuple(targets), tuple(controls),
-                               tuple(control_states),
-                               tuple(dp.ravel()), dp.shape))
+        self._record(GateOp("diagonal", tuple(targets), tuple(controls),
+                            tuple(control_states),
+                            tuple(dp.ravel()), dp.shape))
         return self
 
     def unitary(self, target, u):
@@ -90,11 +97,11 @@ class Circuit:
         return self._mat([[s, s], [s, -s]], (target,))
 
     def x(self, target, controls=()):
-        self.ops.append(GateOp("x", (target,), tuple(controls)))
+        self._record(GateOp("x", (target,), tuple(controls)))
         return self
 
     def y(self, target, controls=()):
-        self.ops.append(GateOp("y", (target,), tuple(controls)))
+        self._record(GateOp("y", (target,), tuple(controls)))
         return self
 
     def z(self, target, controls=()):
@@ -127,7 +134,7 @@ class Circuit:
         return self._diag([np.exp(-1j * angle / 2), np.exp(1j * angle / 2)], (target,))
 
     def swap(self, q1, q2):
-        self.ops.append(GateOp("swap", (q1, q2)))
+        self._record(GateOp("swap", (q1, q2)))
         return self
 
     def multi_rotate_z(self, targets, angle):
@@ -143,7 +150,7 @@ class Circuit:
             par = np.array([bin(b).count("1") & 1
                             for b in range(1 << len(targets))])
             return self._diag(np.exp(-0.5j * angle * (1 - 2 * par)), targets)
-        self.ops.append(GateOp("mrz", targets, (), (), (float(angle),), None))
+        self._record(GateOp("mrz", targets, (), (), (float(angle),), None))
         return self
 
     def multi_rotate_pauli(self, targets, paulis, angle):
@@ -246,6 +253,215 @@ class Circuit:
         docs/SCHEDULER.md."""
         from .parallel import scheduler as _sched
         return _sched.schedule(self, num_devices, **kwargs)
+
+
+class DensityCircuit(Circuit):
+    """Density-matrix circuit on ``num_qubits`` qubits, recorded DIRECTLY as
+    its Choi-doubled ``2n``-qubit program (PAPER.md L4: U rho U† runs as
+    U ⊗ U* on a flattened 2n-qubit statevector; row/ket index in qubits
+    0..n-1, column/bra index in n..2n-1 — the getDensityAmp convention of
+    ops/decoherence.py).
+
+    Every inherited unitary builder records the op AND its conjugate shadow
+    on the bra wires (the ``_record`` hook), so the recorded op list is an
+    ORDINARY 2n-qubit circuit: ``compile_circuit(engine="auto")``, the
+    Pallas epoch executor, the comm-aware scheduler, the serve cache's
+    parameter lift and the translation validator all apply unchanged.
+
+    Channel methods (:meth:`damp`, :meth:`depolarise`, :meth:`dephase`,
+    :meth:`two_qubit_dephase`, :meth:`mix_pauli`, :meth:`kraus`) record the
+    channel's SUPEROPERATOR as a plain matrix/diagonal op on the doubled
+    ``(q, q+n)`` wires (ops/decoherence.py static builders).  The channel
+    payload is continuous, so a probability sweep shares ONE structural
+    class — one compiled program per (skeleton, channel mask) in the serve
+    cache, probabilities riding in the operand vector.  ``channel_slots``
+    records which op indices are channels: the analyzer validates those as
+    trace-preserving superoperators instead of unitaries, and serve
+    admission re-validates the operand slices (``E_INVALID_KRAUS_OPS``).
+    ``channel_log`` carries (op_index, kind, density targets, args) — the
+    oracle record ``analysis.check_density_lowering`` proves the recorded
+    superoperators against the channels' defining Kraus operators."""
+
+    def __init__(self, num_qubits: int):
+        super().__init__(2 * num_qubits)
+        self.density_qubits = int(num_qubits)
+        self.channel_slots: set[int] = set()
+        self.channel_log: list[tuple] = []
+
+    def _record(self, op: GateOp) -> None:
+        n = self.density_qubits
+        for q in op.targets + op.controls:
+            if not 0 <= q < n:
+                from .validation import MESSAGES, ErrorCode, QuESTError
+                raise QuESTError(ErrorCode.INVALID_TARGET_QUBIT,
+                                 MESSAGES[ErrorCode.INVALID_TARGET_QUBIT]
+                                 + f" (density wire {q} of {n}.)",
+                                 "DensityCircuit")
+        self.ops.append(op)
+        self.ops.append(_shadow_op(op, n))
+
+    def optimize(self, max_pack: int = 7) -> "Circuit":
+        """REFUSED on a density circuit: the native fusion engine rewrites
+        the op list in place, which would leave ``channel_slots`` /
+        ``channel_log`` indexing the pre-fusion list (serve admission and
+        the analyzer would then validate the wrong operand slices) and
+        break the (op, shadow) pairing the density prover certifies.  The
+        epoch executor already fuses the doubled program at compile time —
+        there is nothing for record-time fusion to win here."""
+        from .validation import MESSAGES, ErrorCode, QuESTError
+        raise QuESTError(
+            ErrorCode.INVALID_SCHEDULE_OPTION,
+            MESSAGES[ErrorCode.INVALID_SCHEDULE_OPTION]
+            + " DensityCircuit.optimize() is unsupported: record-time "
+            "fusion would orphan the channel metadata and the mirrored "
+            "pairing; the epoch executor fuses the doubled program at "
+            "compile time instead.", "DensityCircuit.optimize")
+
+    # --- decoherence channels ---------------------------------------------
+    def _channel(self, kind: str, targets: tuple, op: GateOp, *args):
+        self.channel_slots.add(len(self.ops))
+        self.channel_log.append((len(self.ops), kind, targets) + args)
+        self.ops.append(op)
+        return self
+
+    def _doubled(self, targets) -> tuple:
+        """Validated doubled wire tuple of a channel's density targets —
+        the same record-time contract the unitary builders get from
+        ``_record`` (range) plus uniqueness, with the eager API's codes."""
+        from .validation import MESSAGES, ErrorCode, QuESTError
+        n = self.density_qubits
+        ts = tuple(int(t) for t in targets)
+        for t in ts:
+            if not 0 <= t < n:
+                raise QuESTError(ErrorCode.INVALID_TARGET_QUBIT,
+                                 MESSAGES[ErrorCode.INVALID_TARGET_QUBIT]
+                                 + f" (density wire {t} of {n}.)",
+                                 "DensityCircuit")
+        if len(set(ts)) != len(ts):
+            raise QuESTError(ErrorCode.TARGETS_NOT_UNIQUE,
+                             MESSAGES[ErrorCode.TARGETS_NOT_UNIQUE],
+                             "DensityCircuit")
+        return ts + tuple(t + n for t in ts)
+
+    def dephase(self, target: int, prob: float):
+        """rho -> (1-p) rho + p Z rho Z: a DIAGONAL superoperator on the
+        doubled pair (ref: densmatr_mixDephasing, QuEST_cpu.c:79)."""
+        from .ops import decoherence as _deco
+        from .validation import validate_one_qubit_dephase_prob
+        validate_one_qubit_dephase_prob(prob, "DensityCircuit.dephase")
+        dp = _deco.dephasing_diag(prob)
+        return self._channel(
+            "dephase", (int(target),),
+            GateOp("diagonal", self._doubled((target,)), (), (),
+                   tuple(dp.ravel()), dp.shape), float(prob))
+
+    def two_qubit_dephase(self, q1: int, q2: int, prob: float):
+        """Two-qubit dephasing (ref: densmatr_mixTwoQubitDephasing)."""
+        from .ops import decoherence as _deco
+        from .validation import validate_two_qubit_dephase_prob
+        validate_two_qubit_dephase_prob(prob, "DensityCircuit.two_qubit_dephase")
+        dp = _deco.two_qubit_dephasing_diag(prob)
+        return self._channel(
+            "dephase2", (int(q1), int(q2)),
+            GateOp("diagonal", self._doubled((q1, q2)), (), (),
+                   tuple(dp.ravel()), dp.shape), float(prob))
+
+    def depolarise(self, target: int, prob: float):
+        """One-qubit depolarising: a dense 4x4 superoperator on (q, q+n)
+        (ref: densmatr_mixDepolarisingLocal, QuEST_cpu.c:125)."""
+        from .ops import decoherence as _deco
+        from .validation import validate_one_qubit_depol_prob
+        validate_one_qubit_depol_prob(prob, "DensityCircuit.depolarise")
+        sp = _deco.depolarising_superop(prob)
+        return self._channel(
+            "depol", (int(target),),
+            GateOp("matrix", self._doubled((target,)), (), (),
+                   tuple(sp.ravel()), sp.shape), float(prob))
+
+    def damp(self, target: int, prob: float):
+        """Amplitude damping |1><1| -> |0><0| with probability p
+        (ref: densmatr_mixDampingLocal, QuEST_cpu.c:174)."""
+        from .ops import decoherence as _deco
+        from .validation import validate_one_qubit_damping_prob
+        validate_one_qubit_damping_prob(prob, "DensityCircuit.damp")
+        sp = _deco.damping_superop(prob)
+        return self._channel(
+            "damp", (int(target),),
+            GateOp("matrix", self._doubled((target,)), (), (),
+                   tuple(sp.ravel()), sp.shape), float(prob))
+
+    def mix_pauli(self, target: int, prob_x: float, prob_y: float,
+                  prob_z: float):
+        """Pauli channel {sqrt(1-px-py-pz) I, sqrt(px) X, sqrt(py) Y,
+        sqrt(pz) Z} as one Kraus superoperator (ref: densmatr_mixPauli)."""
+        from .validation import validate_pauli_probs
+        validate_pauli_probs(prob_x, prob_y, prob_z,
+                             "DensityCircuit.mix_pauli")
+        s = math.sqrt(max(0.0, 1.0 - prob_x - prob_y - prob_z))
+        ops = [s * np.eye(2),
+               math.sqrt(prob_x) * np.array([[0.0, 1.0], [1.0, 0.0]]),
+               math.sqrt(prob_y) * np.array([[0.0, -1.0j], [1.0j, 0.0]]),
+               math.sqrt(prob_z) * np.diag([1.0, -1.0])]
+        return self.kraus((target,), ops)
+
+    def kraus(self, targets, ops):
+        """General Kraus map: ONE dense superoperator matrix on the doubled
+        targets (ref: densmatr_applyKrausSuperoperator path).  The operator
+        list is validated trace-preserving at RECORD time — a malformed map
+        raises ``E_INVALID_KRAUS_OPS`` here instead of surfacing as silent
+        trace drift at execution."""
+        from .ops import decoherence as _deco
+        from .validation import (validate_kraus_cptp, validate_kraus_sizes,
+                                 validate_num_kraus_ops)
+        targets = tuple(int(t) for t in targets)
+        ops = [np.asarray(k, np.complex128) for k in ops]
+        validate_num_kraus_ops(len(targets), len(ops), "DensityCircuit.kraus")
+        validate_kraus_sizes(ops, len(targets), "DensityCircuit.kraus")
+        validate_kraus_cptp(ops, "DensityCircuit.kraus", eps=1e-10)
+        sp = _deco.kraus_superoperator(ops)
+        return self._channel(
+            "kraus", targets,
+            GateOp("matrix", self._doubled(targets), (), (),
+                   tuple(sp.ravel()), sp.shape),
+            tuple(tuple(tuple(map(complex, row)) for row in k)
+                  for k in ops))
+
+
+def validate_density_operands(circuit, params=None, func: str = "submit") -> None:
+    """Admission-time channel validation of a :class:`DensityCircuit`: every
+    channel slot's superoperator operand (from ``params`` when given — the
+    parameter-lifted sweep — else the recorded payload) must preserve
+    Tr(rho); a non-trace-preserving map raises ``E_INVALID_KRAUS_OPS``
+    (the serve-submit half of the Kraus validation satellite)."""
+    from .ops import decoherence as _deco
+    from .precision import real_eps
+    from .validation import MESSAGES, ErrorCode, QuESTError
+    slots = getattr(circuit, "channel_slots", None)
+    if not slots:
+        return
+    pvec = (np.asarray(params, np.float64).ravel()
+            if params is not None else None)
+    # tolerance at the LOOSEST precision the compiled executables consume:
+    # a tenant's probability sweep may round-trip through float32 (the
+    # epoch engine's plane dtype), and a map that is trace-preserving to
+    # f32 working precision must not bounce at the front door
+    eps = 10 * real_eps(jnp.float32)
+    off = 0
+    for i, op in enumerate(circuit.ops):
+        c = op_param_count(op)
+        if i in slots:
+            payload = (pvec[off:off + c].reshape(op.shape)
+                       if pvec is not None else op.payload())
+            k = len(op.targets) // 2
+            if op.kind == "diagonal":
+                payload = np.stack([np.diag(payload[0]),
+                                    np.diag(payload[1])])
+            if not _deco.superop_trace_preserving(payload, k, eps):
+                raise QuESTError(
+                    ErrorCode.INVALID_KRAUS_OPS,
+                    MESSAGES[ErrorCode.INVALID_KRAUS_OPS]
+                    + f" (channel op {i} on wires {op.targets}.)", func)
+        off += c
 
 
 def op_operands(op: GateOp, state_dtype) -> dict:
@@ -614,7 +830,23 @@ def compile_circuit(circuit: Circuit, donate: bool = False,
 
 def apply_circuit(qureg, circuit: Circuit) -> None:
     """Apply a compiled circuit to a Qureg (statevector path; density quregs
-    get the conjugated shadow ops, cached per (circuit, n))."""
+    get the conjugated shadow ops, cached per (circuit, n)).  A
+    :class:`DensityCircuit` is ALREADY Choi-doubled (shadows and channel
+    superoperators recorded inline), so it runs as-is on a density qureg of
+    the matching width — the path noise channels ride."""
+    density_n = getattr(circuit, "density_qubits", None)
+    if density_n is not None:
+        from .validation import MESSAGES, ErrorCode, QuESTError
+        if (not qureg.is_density_matrix
+                or qureg.num_qubits_represented != density_n):
+            raise QuESTError(
+                ErrorCode.MISMATCHING_QUREG_DIMENSIONS,
+                MESSAGES[ErrorCode.MISMATCHING_QUREG_DIMENSIONS]
+                + f" (DensityCircuit of {density_n} density "
+                "qubits needs a density qureg of the same width.)",
+                "apply_circuit")
+        qureg.amps = _run_ops(qureg.amps, circuit.key())
+        return
     if qureg.is_density_matrix:
         n = qureg.num_qubits_represented
         src = circuit.key()

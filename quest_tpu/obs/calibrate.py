@@ -587,6 +587,20 @@ def _measure_pallas(n: int, repeats: int, iters: int, rows: dict,
         for q in range(0, 7):
             c.unitary(q, _haar_unitary(rng))
         measure("block_small", "pallas_epoch_small", c)
+    # fused superoperator stages (density noise channels): a mirrored
+    # damping+depolarising layer on a small Choi-doubled register, every
+    # channel a flip/select stage (``pallas_epoch_super`` — the class
+    # engine_time_model prices super-carrying passes at)
+    from ..circuit import DensityCircuit
+    dn = _SMALL_CAL_QUBITS // 2
+    dc = DensityCircuit(dn)
+    for q in range(dn):
+        dc.unitary(q, _haar_unitary(rng))
+    for q in range(0, dn, 2):
+        dc.damp(q, 0.05)
+    for q in range(1, dn, 2):
+        dc.depolarise(q, 0.05)
+    measure("super_block", "pallas_epoch_super", dc)
     return values
 
 

@@ -164,15 +164,23 @@ def densmatr_probe_vector(state: jax.Array, num_qubits: int) -> jax.Array:
     return jnp.stack([trace, jnp.max(mag2), nan, inf, herm])
 
 
-def grafted_probe(state: jax.Array) -> jax.Array:
+def grafted_probe(state: jax.Array,
+                  density_qubits: int | None = None) -> jax.Array:
     """:func:`state_probe_vector` behind an ``optimization_barrier`` — THE
     graft point for instrumented programs.  The barrier stops XLA from
     fusing the probe reduction into the kernels producing ``state`` (a
     fused magnitude-sum inside a ``lax.map`` body was observed to perturb
     the final gate's FMA contraction by one ulp), so the primary output
     compiles exactly as if the probe were absent: the bit-identity
-    contract by construction, not by luck."""
-    return state_probe_vector(jax.lax.optimization_barrier(state))
+    contract by construction, not by luck.
+
+    ``density_qubits`` grafts the DENSITY probe instead
+    (:func:`densmatr_probe_vector`: trace + Hermiticity deviation) — the
+    per-batch acceptance harness of served noisy-circuit classes."""
+    barriered = jax.lax.optimization_barrier(state)
+    if density_qubits is not None:
+        return densmatr_probe_vector(barriered, int(density_qubits))
+    return state_probe_vector(barriered)
 
 
 def probe_dict(vec) -> dict:
@@ -229,7 +237,28 @@ def _xla_segment_planes(re: jax.Array, im: jax.Array, ops: tuple):
     return st[0], st[1]
 
 
-def epoch_pass_probes(ops: tuple, num_qubits: int, state: jax.Array):
+def _plane_probe_density(re: jax.Array, im: jax.Array, n: int) -> dict:
+    """Density twin of :func:`_plane_probe`: trace of rho and the
+    Hermiticity deviation on the Choi-flattened planes (plus the NaN/Inf
+    counts).  Trace and Hermiticity read the row/column bit pairing, so —
+    unlike the norm probe — they are only layout-valid when the deferred
+    qubit map is the identity; ``epoch_pass_probes`` gates on
+    ``plan.deferred_ops == 0`` before using this probe per pass."""
+    dim = 1 << n
+    mr = re.astype(_ACC).reshape(dim, dim)
+    mi = im.astype(_ACC).reshape(dim, dim)
+    nan = int(jnp.sum((jnp.isnan(re) | jnp.isnan(im)).astype(jnp.int32)))
+    inf = int(jnp.sum((jnp.isinf(re) | jnp.isinf(im)).astype(jnp.int32)))
+    mag2 = mr * mr + mi * mi
+    herm = jnp.maximum(jnp.max(jnp.abs(mr - mr.T)),
+                       jnp.max(jnp.abs(mi + mi.T)))
+    return {"trace": float(jnp.sum(jnp.diagonal(mr))),
+            "max_amp2": float(jnp.max(mag2)),
+            "herm_dev": float(herm), "nan_count": nan, "inf_count": inf}
+
+
+def epoch_pass_probes(ops: tuple, num_qubits: int, state: jax.Array,
+                      density_qubits: int | None = None):
     """Run the epoch plan (ops/epoch_pallas.py) pass by pass with a probe
     at every fused-pass boundary: one probe point per Pallas pass (block or
     pack) and one per XLA fallback segment.  Returns ``(final_state,
@@ -241,12 +270,29 @@ def epoch_pass_probes(ops: tuple, num_qubits: int, state: jax.Array):
     fused-pass boundaries (the plan said N HBM passes; N probes observed
     N intermediate states).  The final state is bit-identical to the
     uninstrumented ``jit_program`` run: the passes are the same aliased
-    kernels, probes only read the planes between them."""
+    kernels, probes only read the planes between them.
+
+    ``density_qubits`` probes a Choi-doubled register with the DENSITY
+    invariants instead — trace of rho and the Hermiticity deviation at
+    every fused-pass boundary, the per-pass acceptance harness for the
+    fused superoperator stages (a channel that breaks trace preservation
+    or Hermiticity is caught at ITS pass, not at the end of the program).
+    Trace/Hermiticity read the row/column bit pairing, so when the plan
+    carries a deferred permutation the per-pass points fall back to the
+    layout-invariant norm probe and the density probe runs once after the
+    final reconcile."""
     from .. import _compat
     from ..ops import epoch_pallas as _ep
     from ..ops.apply import reconcile_perm_planes
     ops = tuple(ops)
     plan = _ep.plan_circuit(ops, num_qubits)
+    density_per_pass = density_qubits is not None and plan.deferred_ops == 0
+
+    def probe(re, im):
+        if density_per_pass:
+            return _plane_probe_density(re, im, int(density_qubits))
+        return _plane_probe(re, im)
+
     re, im = state[0], state[1]
     points: list = []
     idx = 0
@@ -259,7 +305,7 @@ def epoch_pass_probes(ops: tuple, num_qubits: int, state: jax.Array):
                     else:
                         re, im = _ep._run_pack_pass(re, im, p)
                 points.append({"pass": idx, "kind": p.kind,
-                               **_plane_probe(re, im)})
+                               **probe(re, im)})
                 idx += 1
         else:
             # whole segment as ONE jitted program, traced x64-off like
@@ -268,10 +314,13 @@ def epoch_pass_probes(ops: tuple, num_qubits: int, state: jax.Array):
             with _compat.enable_x64(False):
                 re, im = _xla_segment_planes(re, im, tuple(segment.ops))
             points.append({"pass": idx, "kind": "xla",
-                           **_plane_probe(re, im)})
+                           **probe(re, im)})
             idx += 1
     with _compat.enable_x64(False):
         re, im = reconcile_perm_planes(re, im, plan.residual_perm)
+    if density_qubits is not None and not density_per_pass:
+        points.append({"pass": "final", "kind": "reconciled",
+                       **_plane_probe_density(re, im, int(density_qubits))})
     return jnp.stack([re, im]), points, plan.summary()
 
 
@@ -308,7 +357,8 @@ class NumericRecord:
     def as_health(self) -> dict:
         """The compact ``numeric_health`` payload a ServeResult / flight
         record carries: the numbers plus the findings, no provenance."""
-        return {"norm": self.norm, "norm_drift": self.norm_drift,
+        return {"kind": self.kind, "norm": self.norm,
+                "norm_drift": self.norm_drift,
                 "band": self.band, "max_amp2": self.max_amp2,
                 "nan_count": self.nan_count, "inf_count": self.inf_count,
                 "herm_dev": self.herm_dev, "findings": list(self.findings)}

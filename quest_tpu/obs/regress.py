@@ -66,6 +66,12 @@ DEFAULT_ROW_TOLERANCES = {
     "serve_vqe_16q_batch64": 0.40,
     "vqe_grad_16q_batch64": 0.40,
     "densmatr_14q_damping_depol_f64": 0.30,
+    # density rows share the f64 row's shared-chip spread; the f32 row
+    # additionally changed meaning in PR 15 (it now compiles the whole
+    # noisy layer through engine="auto" on the Choi-doubled register —
+    # the first comparable round under the new path sets the new floor)
+    "densmatr_14q_damping_depol_f32": 0.30,
+    "densmatr_16q_kraus_auto_engine": 0.30,
 }
 
 _NAME_ROW = re.compile(r'\{"name":')

@@ -19,6 +19,7 @@ Superoperator index convention: for targets (q, q+N) the 4-dim gate index is
 
 from __future__ import annotations
 
+import math
 from functools import partial
 
 import jax
@@ -135,16 +136,134 @@ def kraus_superoperator(ops) -> np.ndarray:
     return mat_pair(s)
 
 
-def apply_kraus_map(state: jax.Array, ops, targets, num_qubits: int) -> jax.Array:
+# ---------------------------------------------------------------------------
+# host-side superoperator builders — the STATIC twins of the traced mix_*
+# channels above, consumed by the circuit IR (circuit.DensityCircuit records
+# channels as concrete superoperator payloads so the Pallas epoch executor
+# and the serve cache's parameter lift see ordinary matrix/diagonal ops).
+# The formulas are byte-for-byte the same expressions as the jitted
+# channels; tests/test_density_epoch.py pins host == traced.
+# ---------------------------------------------------------------------------
+
+def dephasing_diag(prob: float) -> np.ndarray:
+    """(2, 4) real pair of the dephasing channel's DIAGONAL superoperator on
+    the doubled pair (q, q+N): off-diagonals (index bits differ) scale by
+    1 - 2p (the static twin of :func:`mix_dephasing`)."""
+    f = 1.0 - 2.0 * float(prob)
+    d = np.ones(4, np.float64)
+    d[1] = d[2] = f
+    return np.stack([d, np.zeros_like(d)])
+
+
+def two_qubit_dephasing_diag(prob: float) -> np.ndarray:
+    """(2, 16) diagonal superoperator of the two-qubit dephasing channel on
+    (q1, q2, q1+N, q2+N) (static twin of :func:`mix_two_qubit_dephasing`)."""
+    d = 1.0 - (4.0 * float(prob) / 3.0) * _OFF2
+    return np.stack([d, np.zeros_like(d)])
+
+
+def depolarising_superop(prob: float) -> np.ndarray:
+    """(2, 4, 4) dense superoperator of the one-qubit depolarising channel
+    on (q, q+N) (static twin of :func:`mix_depolarising`)."""
+    p = float(prob)
+    mix = 2.0 * p / 3.0
+    off = 1.0 - 4.0 * p / 3.0
+    s = np.zeros((4, 4), np.float64)
+    s[0, 0] = s[3, 3] = 1.0 - mix
+    s[0, 3] = s[3, 0] = mix
+    s[1, 1] = s[2, 2] = off
+    return np.stack([s, np.zeros_like(s)])
+
+
+def damping_superop(prob: float) -> np.ndarray:
+    """(2, 4, 4) dense superoperator of amplitude damping on (q, q+N)
+    (static twin of :func:`mix_damping`)."""
+    p = float(prob)
+    keep = math.sqrt(max(0.0, 1.0 - p))
+    s = np.zeros((4, 4), np.float64)
+    s[0, 0] = 1.0
+    s[0, 3] = p
+    s[3, 3] = 1.0 - p
+    s[1, 1] = s[2, 2] = keep
+    return np.stack([s, np.zeros_like(s)])
+
+
+def channel_kraus(kind: str, *args) -> list:
+    """The defining Kraus operators of a named channel — the INDEPENDENT
+    oracle ``analysis.check_density_lowering`` verifies recorded
+    superoperator payloads against (it never reads the superop builders
+    above, so a corrupted payload cannot self-certify)."""
+    if kind == "dephase":
+        (p,) = args
+        return [math.sqrt(1.0 - p) * np.eye(2),
+                math.sqrt(p) * np.diag([1.0, -1.0])]
+    if kind == "dephase2":
+        (p,) = args
+        z = np.diag([1.0, -1.0])
+        i2 = np.eye(2)
+        f = math.sqrt(p / 3.0)
+        return [math.sqrt(1.0 - p) * np.eye(4), f * np.kron(i2, z),
+                f * np.kron(z, i2), f * np.kron(z, z)]
+    if kind == "depol":
+        (p,) = args
+        f = math.sqrt(p / 3.0)
+        return [math.sqrt(1.0 - p) * np.eye(2),
+                f * np.array([[0.0, 1.0], [1.0, 0.0]]),
+                f * np.array([[0.0, -1.0j], [1.0j, 0.0]]),
+                f * np.diag([1.0, -1.0])]
+    if kind == "damp":
+        (p,) = args
+        return [np.diag([1.0, math.sqrt(1.0 - p)]),
+                np.array([[0.0, math.sqrt(p)], [0.0, 0.0]])]
+    if kind == "kraus":
+        return [np.asarray(k, np.complex128) for k in args[0]]
+    raise ValueError(f"unknown channel kind {kind!r}")
+
+
+def superop_trace_preserving(sp, num_targets: int, eps: float = 1e-8) -> bool:
+    """Whether a (2, 4^k, 4^k) superoperator pair preserves Tr(rho): with
+    the flat index = row_bits + (col_bits << k), summing the rows whose row
+    and column target bits agree must reproduce the identity's vec — the
+    admission check serve submit runs on channel operand slices (a lifted
+    probability sweep must not be able to smuggle in a non-trace-preserving
+    map the record-time Kraus validation never saw)."""
+    sp = np.asarray(sp, np.float64)
+    dim = sp.shape[1]
+    k = num_targets
+    diag_rows = np.array([r for r in range(dim)
+                          if (r & ((1 << k) - 1)) == (r >> k)])
+    want = np.zeros(dim)
+    want[diag_rows] = 1.0
+    got_r = sp[0][diag_rows].sum(axis=0)
+    got_i = sp[1][diag_rows].sum(axis=0)
+    return bool(np.all(np.abs(got_r - want) < eps)
+                and np.all(np.abs(got_i) < eps))
+
+
+def apply_kraus_map(state: jax.Array, ops, targets, num_qubits: int,
+                    validate: bool = True) -> jax.Array:
     """Apply a Kraus channel by one dense superoperator matrix on the doubled
     targets (ts..., ts+N...) — the same engine path as a 2k-qubit gate, which
     is exactly how the reference routes Kraus maps
     (ref: densmatr_applyKrausSuperoperator, QuEST_common.c:576-605).
 
+    The operator list is validated trace-preserving HERE (sum Kᵢ†Kᵢ = I
+    within the state dtype's tolerance, ``E_INVALID_KRAUS_OPS``) — direct
+    callers used to get silent trace drift from a malformed map, which no
+    downstream check ever attributed back.  API entry points that already
+    validated (``_mix_kraus``) or construct provably-CPTP maps
+    (``mixPauli``, ``mixTwoQubitDepolarising``) pass ``validate=False``
+    so the check runs once, in one place.
+
     The superoperator is built host-side, so its XOR sparsity pattern is
     detected numerically and handed to the gather engine: structured channels
     (Pauli mixtures, two-qubit depolarising) shrink from a dense 4^k
     contraction to their few nonzero shift patterns automatically."""
+    if validate:
+        from ..precision import real_eps
+        from ..validation import validate_kraus_cptp
+        validate_kraus_cptp(ops, "apply_kraus_map",
+                            eps=real_eps(state.dtype))
     s = kraus_superoperator(ops)
     doubled = tuple(targets) + tuple(t + num_qubits for t in targets)
     dim = s.shape[1]

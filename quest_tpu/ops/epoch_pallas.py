@@ -45,7 +45,23 @@ implementations behind one dispatch layer; ROADMAP item 2):
    host-side against the original payload and falls back to the XLA gate
    engine if reconstruction fails (exotic degenerate payloads).
 
-4. **Deferred qubit map.**  ``swap``/``bitperm`` ops never move data: they
+4. **Fused superoperator stages (density noise channels).**  A density
+   matrix runs as its Choi-doubled 2n-qubit vector (circuit.DensityCircuit)
+   and a decoherence channel is an arbitrary — NON-unitary — dense op on
+   the paired wires (q, q+n), which straddle axis groups by construction
+   and which the odd-bit decomposition (unitary-only) cannot reach.  Such
+   ops lower as elementwise ``super`` stages: the four partner amplitudes
+   are reconstructed by structured bit-flips of the resident block
+   (``_apply_super_spec``) and combined with payload entries selected off
+   the global amplitude index — any 4x4 matrix, one VPU stage, zero extra
+   HBM passes.  Block passes reach any bit pair below the block span; pack
+   passes reach (low bit, W-axis bit) by widening their column block to
+   cover the low partner (``PackPass.min_cols``).  A 14-density-qubit
+   damping+depolarising layer (42 ops on the doubled register) lowers to
+   3 fused passes.  Dephasing channels are DIAGONAL superoperators and ride
+   the existing diag machinery untouched.
+
+5. **Deferred qubit map.**  ``swap``/``bitperm`` ops never move data: they
    update a logical->physical wire permutation that later ops absorb into
    their wiring (the residual permutation is carried across epoch
    boundaries and materialized once, by ``reconcile_perm``, at the end of
@@ -124,6 +140,13 @@ _FIBER_Q = (10, 17)
 # set that fails to rebuild the payload falls back to the XLA engine
 _CSD_TOL = 1e-9
 
+# superoperator stages (arbitrary — non-unitary — 2-target dense ops lowered
+# as elementwise bit-flip/select stages, the density-channel lowering): the
+# widest column block a pack pass will widen to so a low target bit stays
+# in-block.  w * cols * 4 B stays <= 16 MiB per plane at the widest group
+# (w = 128), inside the v5e/v5p VMEM budget with double buffering.
+_SUPER_COLS_CAP = 1 << 15
+
 _X_PAIR = np.stack([np.array([[0.0, 1.0], [1.0, 0.0]]), np.zeros((2, 2))])
 _Y_PAIR = np.stack([np.zeros((2, 2)), np.array([[0.0, -1.0], [1.0, 0.0]])])
 _YC_PAIR = np.stack([np.zeros((2, 2)), np.array([[0.0, 1.0], [-1.0, 0.0]])])
@@ -198,21 +221,33 @@ class BlockPass:
     def kind(self) -> str:
         return "block"
 
+    @property
+    def super_stages(self) -> int:
+        return sum(1 for s in self.specs if s[0] == "super")
+
 
 @dataclasses.dataclass(frozen=True, eq=False)
 class PackPass:
     """One aliased staged pack pass over the (left, W, right) view of a
     high-qubit group [base, base+log2(W)): ``specs`` is the static stage
     program (dense contractions of the W axis — controlled or not — plus
-    diagonal/mrz elementwise stages), ``mats`` the composed packs."""
+    diagonal/mrz/superoperator elementwise stages), ``mats`` the composed
+    packs.  ``min_cols`` widens the column block when a superoperator
+    stage couples a low target bit: the bit must be in-block for the
+    stage's flip access (0 = the default _FIBER_COLS geometry)."""
     base: int
     width: int
     specs: tuple
     mats: tuple          # of np (2, W, W) float32
+    min_cols: int = 0
 
     @property
     def kind(self) -> str:
         return "pack"
+
+    @property
+    def super_stages(self) -> int:
+        return sum(1 for s in self.specs if s[0] == "super")
 
 
 @dataclasses.dataclass
@@ -250,6 +285,33 @@ class EnginePlan:
                    for p in s.passes if p.kind == "pack")
 
     @property
+    def super_block_passes(self) -> int:
+        """Block passes containing >=1 superoperator stage (priced at the
+        ``pallas_epoch_super`` efficiency class — flip/select elementwise
+        stages, not matmuls)."""
+        return sum(1 for s in self.segments if s.engine == "pallas"
+                   for p in s.passes
+                   if p.kind == "block" and p.super_stages)
+
+    @property
+    def super_pack_passes(self) -> int:
+        return sum(1 for s in self.segments if s.engine == "pallas"
+                   for p in s.passes
+                   if p.kind == "pack" and p.super_stages)
+
+    @property
+    def super_passes(self) -> int:
+        return self.super_block_passes + self.super_pack_passes
+
+    @property
+    def super_stages(self) -> int:
+        """Total fused superoperator stages across every pass — the number
+        of density channels (or other non-unitary 2-target ops) the plan
+        lowered WITHOUT an XLA fallback or an extra HBM pass."""
+        return sum(p.super_stages for s in self.segments
+                   if s.engine == "pallas" for p in s.passes)
+
+    @property
     def pallas_ops(self) -> int:
         return sum(len(s.ops) for s in self.segments if s.engine == "pallas")
 
@@ -276,6 +338,8 @@ class EnginePlan:
             "pallas_passes": self.pallas_passes,
             "block_passes": self.block_passes,
             "pack_passes": self.pack_passes,
+            "super_passes": self.super_passes,
+            "super_stages": self.super_stages,
             "pallas_ops": self.pallas_ops,
             "xla_ops": self.xla_ops,
             "deferred_ops": self.deferred_ops,
@@ -489,6 +553,57 @@ def _cross2q_factors(op) -> list | None:
 
 
 # ---------------------------------------------------------------------------
+# superoperator stages: arbitrary 2-target dense ops as elementwise flips
+# ---------------------------------------------------------------------------
+
+def _super_spec(op) -> tuple:
+    """Kernel spec of a 2-target dense op applied ELEMENTWISE: the (2, 4, 4)
+    payload is baked as float32 tuples (matrix index bit j <-> targets[j],
+    the engine-wide convention, which for a density channel recorded on
+    (q, q+n) is exactly ops/decoherence.py's row_bit + 2*col_bit layout).
+    Unlike the dense matmul and odd-bit decomposition paths this stage
+    never requires unitarity: the kernels reconstruct the four partner
+    amplitudes by structured bit-flips of the block and combine them with
+    payload entries selected off the global amplitude index — any 4x4
+    matrix, one VPU stage, zero extra HBM passes."""
+    up = _dense_pair(op)
+    return ("super", tuple(op.targets),
+            tuple(tuple(np.float32(x) for x in row) for row in up[0]),
+            tuple(tuple(np.float32(x) for x in row) for row in up[1]),
+            op.controls, _cstates(op))
+
+
+def _super_route(op, n: int):
+    """Where a 2-target dense op the odd-bit decomposition rejected can
+    still run as a fused superoperator stage:
+
+    - ``("super_block",)`` — both targets inside the block span
+      (bits < min(n, HIGH_BASE)): the (F, S, L) block holds every partner
+      amplitude, any bit pair works.
+    - ``("pack_dense", base, hi)`` — both targets on ONE high group's W
+      axis (possible in groups widened below HIGH_BASE): the ordinary
+      embedded W-axis contraction applies, non-unitary payloads included.
+    - ``("super_pack", base, hi)`` — high target on a W axis, low target
+      below the group base: the pass widens its column block to cover the
+      low bit (bounded by ``_SUPER_COLS_CAP``) — the density-channel case,
+      ket bit q paired with bra bit q+n.
+    - ``None`` — no fused form: the op falls back to the XLA gate engine.
+    """
+    t_lo, t_hi = sorted(op.targets)
+    span = min(n, HIGH_BASE)
+    if t_hi < span:
+        return ("super_block",)
+    if t_hi >= HIGH_BASE:
+        base, hi = _fiber_group(t_hi, n)
+        if t_hi < hi:
+            if t_lo >= base:
+                return ("pack_dense", base, hi)
+            if (2 << t_lo) <= min(_SUPER_COLS_CAP, 1 << base):
+                return ("super_pack", base, hi)
+    return None
+
+
+# ---------------------------------------------------------------------------
 # stream builders
 # ---------------------------------------------------------------------------
 
@@ -535,6 +650,13 @@ class _BlockBuilder:
         self.specs.append(("dense", axis, self._intern(m), op.controls,
                            _cstates(op)))
 
+    def add_super(self, op) -> None:
+        """A 2-target dense op on ARBITRARY in-block bits (cross-group, and
+        non-unitary superoperators the odd-bit decomposition cannot reach)
+        as one elementwise flip/select stage — see ``_apply_super_spec``."""
+        self.ops.append(op)
+        self.specs.append(_super_spec(op))
+
     def flush(self) -> tuple:
         if not self.specs:
             return None, []
@@ -557,6 +679,7 @@ class _PackBuilder:
         self.specs: list = []
         self.mats: list = []     # f64 until flush
         self.ops: list = []
+        self.min_cols = 0        # widened column block for super stages
 
     def add(self, op) -> None:
         self.ops.append(op)
@@ -584,13 +707,25 @@ class _PackBuilder:
         self.specs.append(("dense", len(self.mats) - 1, op.controls,
                            _cstates(op)))
 
+    def add_super(self, op) -> None:
+        """Superoperator stage coupling one W-axis bit with one low bit:
+        the low bit must be inside the column block, so the pass widens
+        ``min_cols`` to cover it (``_run_pack_pass``)."""
+        self.ops.append(op)
+        lo = min(op.targets)
+        if lo < self.base:
+            self.min_cols = max(self.min_cols, 2 << lo)
+        self.specs.append(_super_spec(op))
+
     def flush(self) -> tuple:
         if not self.specs:
             return None, []
         out = PackPass(self.base, self.width, tuple(self.specs),
-                       tuple(m.astype(np.float32) for m in self.mats))
+                       tuple(m.astype(np.float32) for m in self.mats),
+                       self.min_cols)
         ops = self.ops
         self.specs, self.mats, self.ops = [], [], []
+        self.min_cols = 0
         return out, ops
 
 
@@ -601,7 +736,9 @@ def epoch_supported(num_qubits: int, precision: int = 1) -> bool:
     registers below the 10-qubit degenerate-block floor or above the
     30-qubit int32-index ceiling — and multi-device meshes, which
     ``select_engine`` pins to XLA (the deferred qubit map must materialize
-    before sharded collectives)."""
+    before sharded collectives).  A DENSITY circuit's register is its
+    Choi-doubled 2n-qubit vector, so the same [10, 30] window reads as
+    density n in [5, 15]."""
     return precision == 1 and MIN_QUBITS <= num_qubits <= MAX_QUBITS
 
 
@@ -629,7 +766,13 @@ def _plan_circuit_impl(ops: tuple, num_qubits: int) -> EnginePlan:
     perm = list(range(n))
     segments: list = []
     block = _BlockBuilder(n)
-    pack: _PackBuilder | None = None
+    # ONE pending pack builder PER high group (insertion-ordered): a
+    # mirrored density layer touches every bra group in turn, and a single
+    # pack slot would flush the whole window on each group switch — 10
+    # passes/layer where three suffice.  Emission order is block first,
+    # then packs in creation order; every cross-stream reorder that
+    # emission implies is proven by _stream_commutes at routing time.
+    packs: dict[int, _PackBuilder] = {}
     deferred = 0
 
     def seg(engine: str) -> Segment:
@@ -638,49 +781,91 @@ def _plan_circuit_impl(ops: tuple, num_qubits: int) -> EnginePlan:
         return segments[-1]
 
     def flush_streams() -> None:
-        # emission order: block pass FIRST, then pack pass — ops were only
-        # reordered between the streams where _stream_commutes proved it
-        nonlocal pack
         bp, bops = block.flush()
-        pp, pops = pack.flush() if pack is not None else (None, [])
-        pack = None
-        if bp is None and pp is None:
+        flushed = [(bp, bops)] if bp is not None else []
+        for pb in packs.values():
+            pp, pops = pb.flush()
+            if pp is not None:
+                flushed.append((pp, pops))
+        packs.clear()
+        if not flushed:
             return
         s = seg("pallas")
-        if bp is not None:
-            s.passes.append(bp)
-            s.ops.extend(bops)
-        if pp is not None:
-            s.passes.append(pp)
+        for p, pops in flushed:
+            s.passes.append(p)
             s.ops.extend(pops)
 
-    def commutes_with_pack(op) -> bool:
-        return pack is None or all(_stream_commutes(op, q)
-                                   for q in pack.ops)
+    def commutes_with_packs(op, skip: int | None = None) -> bool:
+        """Adding ``op`` to the block stream (or to pack ``skip``) emits it
+        before every other pending pack's ops: sound only when it commutes
+        with all of them."""
+        return all(_stream_commutes(op, q)
+                   for b, pb in packs.items() if b != skip
+                   for q in pb.ops)
+
+    def pack_for(pop, base: int, hi: int) -> "_PackBuilder | None":
+        """The pending pack builder for [base, hi), or None when adding
+        ``pop`` there cannot be proven sound (the caller flushes)."""
+        if not commutes_with_packs(pop, skip=base):
+            return None
+        pb = packs.get(base)
+        if pb is None:
+            pb = packs[base] = _PackBuilder(base, hi)
+        return pb
+
+    def route_super(pop, sup: tuple) -> None:
+        if sup[0] == "super_block":
+            # same soundness condition as any block op: it executes before
+            # every pending pack pass
+            if not commutes_with_packs(pop):
+                flush_streams()
+            block.add_super(pop)
+            return
+        base, hi = sup[1], sup[2]
+        pb = pack_for(pop, base, hi)
+        if pb is None:
+            flush_streams()
+            pb = packs[base] = _PackBuilder(base, hi)
+        if sup[0] == "pack_dense":
+            pb.add(pop)
+        else:
+            pb.add_super(pop)
 
     def route(pop, cls: str) -> None:
-        nonlocal pack
         if cls == "block":
-            # a block op executes BEFORE the pending pack pass: sound only
-            # when it commutes with everything already in the pack stream
-            if not commutes_with_pack(pop):
+            # a block op executes BEFORE the pending pack passes: sound
+            # only when it commutes with everything already in them
+            if not commutes_with_packs(pop):
                 flush_streams()
             block.add(pop)
             return
         if cls == "either":
             # diagonal family: block-executable in both streams — prefer
-            # the block stream, fall to the pack stream when order pins it
-            if commutes_with_pack(pop):
+            # the block stream, fall to a pack stream when order pins it
+            if commutes_with_packs(pop):
                 block.add(pop)
+                return
+            # pinned behind exactly the packs it conflicts with: join the
+            # LAST conflicting pack when the later ones tolerate the
+            # reorder, else flush everything
+            conflict = [b for b, pb in packs.items()
+                        if not all(_stream_commutes(pop, q) for q in pb.ops)]
+            order = list(packs)
+            last = conflict[-1]
+            after = order[order.index(last) + 1:]
+            if all(_stream_commutes(pop, q)
+                   for b in after for q in packs[b].ops):
+                packs[last].add(pop)
             else:
-                pack.add(pop)
+                flush_streams()
+                block.add(pop)
             return
         base, hi = _fiber_group(min(pop.targets), n)
-        if pack is not None and pack.base != base:
+        pb = pack_for(pop, base, hi)
+        if pb is None:
             flush_streams()
-        if pack is None:
-            pack = _PackBuilder(base, hi)
-        pack.add(pop)
+            pb = packs[base] = _PackBuilder(base, hi)
+        pb.add(pop)
 
     for op in ops:
         pop = _phys_op(op, perm)
@@ -691,12 +876,20 @@ def _plan_circuit_impl(ops: tuple, num_qubits: int) -> EnginePlan:
             continue
         if cls == "cross2q":
             factors = _cross2q_factors(pop)
-            if factors is None:
-                cls = "xla"
-            else:
+            if factors is not None:
                 for f in factors:
                     route(f, _classify(f, n))
                 continue
+            # the odd-bit decomposition needs a unitary payload; a density
+            # channel's superoperator (or any degenerate dense payload)
+            # lowers as ONE elementwise superoperator stage instead — same
+            # pass, zero extra HBM traffic — wherever both partner bits
+            # are reachable inside a block
+            sup = _super_route(pop, n)
+            if sup is not None:
+                route_super(pop, sup)
+                continue
+            cls = "xla"
         if cls == "xla":
             flush_streams()
             seg("xla").ops.append(pop)
@@ -748,6 +941,92 @@ def _apply_mrz_spec(spec, k, xr, xi):
     cc = jnp.float32(c_)
     sn = jnp.where(par == 1, jnp.float32(s_), jnp.float32(-s_))
     return xr * cc - xi * sn, xr * sn + xi * cc
+
+
+def _flip_block_bit(x, j: int):
+    """``y[k] = x[k ^ (1 << j)]`` on an (F, S, L) block array: split the
+    axis holding global bit ``j`` at its stride and reverse the 2-wide
+    factor — pure VPU data movement, no HBM traffic."""
+    f, s, l = x.shape
+    if j < _SUB_Q[0]:
+        y = x.reshape(f, s, l >> (j + 1), 2, 1 << j)
+        return jnp.flip(y, 3).reshape(f, s, l)
+    if j < _FIBER_Q[0]:
+        m = j - _SUB_Q[0]
+        y = x.reshape(f, s >> (m + 1), 2, 1 << m, l)
+        return jnp.flip(y, 2).reshape(f, s, l)
+    m = j - _FIBER_Q[0]
+    y = x.reshape(f >> (m + 1), 2, 1 << m, s, l)
+    return jnp.flip(y, 1).reshape(f, s, l)
+
+
+def _flip_pack_bit(x, j: int, base: int):
+    """``_flip_block_bit`` twin for the (W, cols) pack view: W-axis bits
+    live at [base, hi), column bits at [0, log2 cols) — the pass geometry
+    guarantees a superoperator stage's bits are one of each."""
+    w, cols = x.shape
+    if j >= base:
+        m = j - base
+        y = x.reshape(w >> (m + 1), 2, 1 << m, cols)
+        return jnp.flip(y, 1).reshape(w, cols)
+    y = x.reshape(w, cols >> (j + 1), 2, 1 << j)
+    return jnp.flip(y, 2).reshape(w, cols)
+
+
+def _apply_super_spec(spec, k, xr, xi, flip):
+    """Arbitrary 2-target dense op as ONE elementwise stage.  For element
+    k with target bits (b0, b1) the output is
+    ``sum_{a,b} S[(b0,b1),(a,b)] * x[k with bits set to (a, b)]``: the four
+    partner amplitudes come from structured bit-flips (``flip``), the
+    coefficient row is selected off the global amplitude index like a
+    diagonal stage.  All-zero payload columns are skipped host-side, so a
+    damping/depolarising superoperator (diagonal plus ONE coupling column)
+    costs two flip/select terms, not four.  This is the stage that makes a
+    density noise channel block-local: its targets (q, q+n) straddle axis
+    groups by construction, where the matmul paths cannot reach and the
+    odd-bit decomposition requires unitarity."""
+    _, targets, srr, sri, controls, cstates = spec
+    t0, t1 = targets
+    b0 = (k >> t0) & 1
+    b1 = (k >> t1) & 1
+    row = b0 + 2 * b1
+    f0r, f0i = flip(xr, t0), flip(xi, t0)
+    # x with bit t0 forced to 0 / 1
+    forced = ((jnp.where(b0 == 0, xr, f0r), jnp.where(b0 == 0, xi, f0i)),
+              (jnp.where(b0 == 0, f0r, xr), jnp.where(b0 == 0, f0i, xi)))
+    flipped1: dict = {}
+    nr = jnp.zeros_like(xr)
+    ni = jnp.zeros_like(xi)
+    zero = np.float32(0.0)
+    for col in range(4):
+        ca, cb = col & 1, col >> 1
+        colr = tuple(srr[r][col] for r in range(4))
+        coli = tuple(sri[r][col] for r in range(4))
+        if all(v == zero for v in colr + coli):
+            continue
+        ar, ai = forced[ca]
+        if ca not in flipped1:
+            flipped1[ca] = (flip(ar, t1), flip(ai, t1))
+        g1r, g1i = flipped1[ca]
+        yr = jnp.where(b1 == cb, ar, g1r)
+        yi = jnp.where(b1 == cb, ai, g1i)
+        cr = jnp.zeros_like(xr)
+        ci = jnp.zeros_like(xr)
+        for r in range(4):
+            if colr[r] == zero and coli[r] == zero:
+                continue
+            sel = row == r
+            if colr[r] != zero:
+                cr = jnp.where(sel, jnp.float32(colr[r]), cr)
+            if coli[r] != zero:
+                ci = jnp.where(sel, jnp.float32(coli[r]), ci)
+        nr = nr + cr * yr - ci * yi
+        ni = ni + cr * yi + ci * yr
+    if controls:
+        m = _ctrl_mask(k, controls, cstates)
+        nr = jnp.where(m, nr, xr)
+        ni = jnp.where(m, ni, xi)
+    return nr, ni
 
 
 # ---------------------------------------------------------------------------
@@ -821,6 +1100,8 @@ def _epoch_block_kernel(specs: tuple, block_amps: int, *refs):
             xr, xi = nr, ni
         elif tag == "diag":
             xr, xi = _apply_diag_spec(spec, k, xr, xi)
+        elif tag == "super":
+            xr, xi = _apply_super_spec(spec, k, xr, xi, _flip_block_bit)
         else:
             xr, xi = _apply_mrz_spec(spec, k, xr, xi)
     ore_ref[...] = xr
@@ -858,7 +1139,8 @@ def _run_block_pass(re, im, bp: BlockPass):
 # the staged pack kernel (high-qubit groups)
 # ---------------------------------------------------------------------------
 
-def _epoch_pack_kernel(specs: tuple, w: int, right: int, cols: int, *refs):
+def _epoch_pack_kernel(specs: tuple, w: int, right: int, cols: int,
+                       base: int, *refs):
     """Apply a static stage program to one (W, cols) block of the
     (left, W, right) high-group view.  The global amplitude index of
     element (f, c) of grid block (i, j) is
@@ -900,6 +1182,9 @@ def _epoch_pack_kernel(specs: tuple, w: int, right: int, cols: int, *refs):
             xr, xi = nr, ni
         elif tag == "diag":
             xr, xi = _apply_diag_spec(spec, k, xr, xi)
+        elif tag == "super":
+            xr, xi = _apply_super_spec(spec, k, xr, xi,
+                                       partial(_flip_pack_bit, base=base))
         else:
             xr, xi = _apply_mrz_spec(spec, k, xr, xi)
     ore_ref[...] = xr
@@ -911,7 +1196,10 @@ def _run_pack_pass(re, im, pp: PackPass):
     right = 1 << pp.base
     w = pp.width
     left = n_amps // (right * w)
-    cols = min(_FIBER_COLS, right)
+    # superoperator stages widen the column block so their low partner bit
+    # stays inside one grid block (PackPass.min_cols; bounded by the
+    # _SUPER_COLS_CAP VMEM budget at plan time)
+    cols = min(max(_FIBER_COLS, pp.min_cols), right)
     shape = (left * w, right)  # rank-2: rows a*w+f, block rows = one group
     ins = []
     in_specs = []
@@ -920,7 +1208,7 @@ def _run_pack_pass(re, im, pp: PackPass):
         in_specs += [pl.BlockSpec((w, w), lambda i, j: (0, 0))] * 2
     state_spec = pl.BlockSpec((w, cols), lambda i, j: (i, j))
     run = pl.pallas_call(
-        partial(_epoch_pack_kernel, pp.specs, w, right, cols),
+        partial(_epoch_pack_kernel, pp.specs, w, right, cols, pp.base),
         interpret=_interpret(),
         grid=(left, right // cols),
         in_specs=in_specs + [state_spec, state_spec],

@@ -223,6 +223,14 @@ MEASURED_EFFICIENCY = {
     # is one VMEM tile, so passes are launch-latency- not bandwidth-bound;
     # the default is deliberately conservative until a profile fits it
     "pallas_epoch_small": 0.20,
+    # passes containing fused SUPEROPERATOR stages (density noise channels
+    # lowered as elementwise bit-flip/select stages, ops/epoch_pallas.py
+    # _apply_super_spec): still one aliased HBM read+write, but the stage
+    # arithmetic is VPU flips/selects rather than MXU contractions, so the
+    # default is priced below the matmul classes until a calibration
+    # profile fits the real cost (obs/calibrate.py measures a
+    # damping-layer block pass as the ``super_block`` row)
+    "pallas_epoch_super": 0.22,
 }
 
 
@@ -491,6 +499,8 @@ def engine_time_model(circuit, chip: ChipSpec = V5E, precision: int = 1,
         chip.hbm_bytes_per_sec * efficiency_for(block_class, chip))
     pass_s_pack = 2.0 * state_bytes / (
         chip.hbm_bytes_per_sec * efficiency_for("pallas_epoch_pack", chip))
+    pass_s_super = 2.0 * state_bytes / (
+        chip.hbm_bytes_per_sec * efficiency_for("pallas_epoch_super", chip))
     out = {
         "num_qubits": n,
         "ops": len(circuit.ops),
@@ -505,13 +515,26 @@ def engine_time_model(circuit, chip: ChipSpec = V5E, precision: int = 1,
     if plan is None:
         plan = _ep.plan_circuit(circuit.key(), n)
     out["pallas_hbm_passes"] = plan.hbm_passes
-    out["pallas_seconds"] = (plan.block_passes * pass_s_block
-                             + plan.pack_passes * pass_s_pack
-                             + plan.xla_ops * pass_s_xla)
+    # a pass carrying >= 1 fused superoperator stage (density noise
+    # channels) is priced at the super class — but never BELOW its kind's
+    # class (the degenerate single-block geometry is latency-bound at the
+    # small class whatever the stage mix): the HBM traffic is the same one
+    # aliased read+write, the stage arithmetic is the slower flip/select
+    # form
+    plain_block = plan.block_passes - plan.super_block_passes
+    plain_pack = plan.pack_passes - plan.super_pack_passes
+    out["pallas_seconds"] = (
+        plain_block * pass_s_block
+        + plain_pack * pass_s_pack
+        + plan.super_block_passes * max(pass_s_block, pass_s_super)
+        + plan.super_pack_passes * max(pass_s_pack, pass_s_super)
+        + plan.xla_ops * pass_s_xla)
     out["pallas_pass_breakdown"] = {
         "pallas_passes": plan.pallas_passes,
         "block_passes": plan.block_passes,
         "pack_passes": plan.pack_passes,
+        "super_passes": plan.super_passes,
+        "super_stages": plan.super_stages,
         "block_efficiency_class": block_class,
         "xla_fallback_ops": plan.xla_ops,
         "deferred_perm_ops": plan.deferred_ops,
@@ -584,12 +607,21 @@ def _select_engine_impl(circuit, num_devices: int | None = None,
         # in-envelope, and >= 3-target cross-group dense gates / wide
         # diagonals fall back PER OP inside the plan, never rejecting the
         # circuit
+        dq = getattr(circuit, "density_qubits", None)
         if multi:
             reason = ("multi-device mesh: the deferred qubit map must "
                       "materialize before sharded collectives")
         elif precision != 1:
             reason = ("f64 state: the epoch engines are f32 plane kernels "
                       "(use engine='xla' for f64)")
+        elif dq is not None:
+            # a density circuit's register is the Choi-doubled 2n-qubit
+            # vector, so the [MIN_QUBITS, MAX_QUBITS] envelope reads as a
+            # density window of [ceil(MIN/2), MAX/2] qubits
+            reason = (f"density register outside {-(-_ep.MIN_QUBITS // 2)} "
+                      f"<= n <= {_ep.MAX_QUBITS // 2}: the Choi-doubled "
+                      f"vector is 2n = {circuit.num_qubits} register "
+                      f"qubits, outside [{_ep.MIN_QUBITS}, {_ep.MAX_QUBITS}]")
         else:
             reason = (f"register outside {_ep.MIN_QUBITS} <= n <= "
                       f"{_ep.MAX_QUBITS}: no degenerate block geometry "

@@ -562,6 +562,41 @@ def greedy_placement(circuit, num_devices: int, chip=None,
 # entry points
 # ---------------------------------------------------------------------------
 
+def _carry_density_meta(circuit, out) -> None:
+    """Propagate a DensityCircuit's metadata (density_qubits +
+    channel_slots/channel_log) onto the scheduled copy, remapping the
+    index-based channel records through payload-tuple identity — the same
+    provenance the serve cache's operand-offset map rides (the scheduler
+    preserves payload tuples through reorder and relabel).  Downstream
+    consumers (select_engine's density window reason, the analyzer's
+    channel-aware payload validation, serve admission) all read the
+    attributes via ``getattr``, so a plain Circuit carrying them is
+    equivalent."""
+    recs = getattr(circuit, "channel_log", None)
+    if getattr(circuit, "density_qubits", None) is None:
+        return
+    by_payload = {id(circuit.ops[rec[0]].matrix): rec for rec in (recs or ())}
+    log = []
+    slots = set()
+    for j, op in enumerate(out.ops):
+        rec = by_payload.pop(id(op.matrix), None)
+        if rec is not None:
+            slots.add(j)
+            log.append((j,) + tuple(rec[1:]))
+    if by_payload:
+        # a channel op did not survive the rewrite identically: carry NO
+        # density metadata rather than a wrong (or half-carried) view —
+        # density_qubits without the channel map would make the analyzer
+        # validate surviving superoperators as unitaries and the density
+        # prover report phantom pairing breaks.  The scheduled copy still
+        # runs correctly; only density-specific validation and reporting
+        # degrade.
+        return
+    out.density_qubits = circuit.density_qubits
+    out.channel_slots = slots
+    out.channel_log = log
+
+
 def schedule(circuit, num_devices: int, *, chip=None, precision: int = 1,
              placement: bool = True, reorder: bool = True,
              overlap: bool = False, pipeline_chunks: int | None = None,
@@ -633,6 +668,7 @@ def schedule(circuit, num_devices: int, *, chip=None, precision: int = 1,
         ops = _lower_epochs(ops, n, num_devices)
         out = Circuit(n)
         out.ops = ops
+        _carry_density_meta(circuit, out)
         if overlap:
             out._overlap_plan = _exec.plan_overlap(out, num_devices,
                                                    pipeline_chunks)

@@ -49,6 +49,13 @@ def main(argv=None) -> int:
                              "bit-identity, oracle, hit-rate, NaN-trip "
                              "and router-quarantine gates; "
                              "QUEST_TPU_GRAD_SELFTEST=1 does the same")
+    parser.add_argument("--density", action="store_true",
+                        help="run the noisy density-matrix phase: a probed "
+                             "probability-sweep storm of one noisy "
+                             "structural class with hit-rate, bit-identity,"
+                             " trace/Hermiticity health, fused-superop-plan"
+                             " and Kraus-admission gates; "
+                             "QUEST_TPU_DENSITY_SELFTEST=1 does the same")
     args = parser.parse_args(argv)
     if not args.selftest:
         parser.print_usage()
@@ -57,7 +64,8 @@ def main(argv=None) -> int:
     return run_selftest(as_json=args.as_json, scale=max(1, args.scale),
                         trace=True if args.trace else None,
                         probes=True if args.probes else None,
-                        gradients=True if args.gradients else None)
+                        gradients=True if args.gradients else None,
+                        density=True if args.density else None)
 
 
 if __name__ == "__main__":

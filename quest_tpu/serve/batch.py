@@ -49,7 +49,8 @@ def group_ready(queue, key, max_batch: int) -> list:
 
 
 def execute_group(cache, entry, requests, state_factory, max_batch: int,
-                  mode: str = "map", probes: bool = False):
+                  mode: str = "map", probes: bool = False,
+                  density: "int | None" = None):
     """Run one same-class microbatch; returns ``(states, probes, batch)``
     where ``states`` is a list of per-request (2, 2^n) device arrays in
     request order, ``probes`` the matching list of numeric probe vectors
@@ -62,7 +63,9 @@ def execute_group(cache, entry, requests, state_factory, max_batch: int,
     stacked depending on whether any request carries its own initial
     state.  ``probes=True`` routes through the probe-instrumented program
     variants (cache.py ``*_probed_program``): same lowering, one auxiliary
-    probe output, primary outputs bit-identical."""
+    probe output, primary outputs bit-identical.  ``density`` (the density
+    qubit count of a Choi-doubled class) selects the density-probe twins —
+    trace + Hermiticity instead of the statevector norm."""
     m = len(requests)
     assert m >= 1
     if m == 1:
@@ -70,7 +73,8 @@ def execute_group(cache, entry, requests, state_factory, max_batch: int,
         state = state_factory(req)
         params = cache._check_params(entry, req.params)
         if probes:
-            out, pv = cache.single_probed_program(entry, state).call(
+            out, pv = cache.single_program(
+                entry, state, probes=True, density=density).call(
                 state, params)
             return [out], [pv], 1
         out = cache.single_program(entry, state).call(state, params)
@@ -80,16 +84,17 @@ def execute_group(cache, entry, requests, state_factory, max_batch: int,
     pvec += [pvec[-1]] * (batch - m)
     pb = jnp.asarray(np.stack(pvec))
     stacked = any(r.initial_state is not None for r in requests)
-    compile_prog = cache.batch_probed_program if probes else cache.batch_program
     if stacked:
         states = [state_factory(r) for r in requests]
         states += [states[-1]] * (batch - m)
         sb = jnp.stack(states)
-        prog = compile_prog(entry, states[0], batch, stacked=True, mode=mode)
+        prog = cache.batch_program(entry, states[0], batch, stacked=True,
+                                   mode=mode, probes=probes, density=density)
         outs = prog.call(sb, pb)
     else:
         state = state_factory(requests[0])
-        prog = compile_prog(entry, state, batch, stacked=False, mode=mode)
+        prog = cache.batch_program(entry, state, batch, stacked=False,
+                                   mode=mode, probes=probes, density=density)
         outs = prog.call(state, pb)
     if probes:
         outs, pvs = outs

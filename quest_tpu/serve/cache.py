@@ -390,40 +390,51 @@ class CompileCache:
         return p
 
     @staticmethod
-    def _lifted_one(entry: CacheEntry, probes: bool):
+    def _lifted_one(entry: CacheEntry, probes: bool,
+                    density: int | None = None):
         """The per-request ``(state, params) -> out`` body every lifted
         program variant lowers: ONE definition, so the probed and plain
         twins can never desynchronize on the gate chain.  ``probes=True``
         adds the numeric probe (obs/numerics.py) as an auxiliary output
         behind its optimization barrier — a pure reduction grafted beside
         the main dataflow, so the primary output is bit-identical to the
-        unprobed lowering (pinned in tier-1 for every engine path)."""
+        unprobed lowering (pinned in tier-1 for every engine path).
+        ``density`` (the density qubit count of a Choi-doubled request)
+        grafts the DENSITY probe instead: trace + Hermiticity deviation,
+        the per-batch health contract of served noisy-circuit classes."""
         skeleton, offsets = entry.skeleton, entry.offsets
 
         def one(st, params):
             out = _circ._run_ops_routed(st, skeleton, params, offsets)
             if probes:
                 from ..obs import numerics as _num
-                return out, _num.grafted_probe(out)
+                return out, _num.grafted_probe(out, density_qubits=density)
             return out
 
         return one
 
     def single_program(self, entry: CacheEntry, state, *,
                        donate: bool = False,
-                       probes: bool = False) -> _Program:
+                       probes: bool = False,
+                       density: int | None = None) -> _Program:
         """The class's ``(state, params) -> state`` executable for this
         state signature; ``probes=True`` compiles the probe-instrumented
         variant ``-> (state, probe_vec)`` under its own tag (byte budget
         and persistent store govern it like any other signature).
         Probed programs are never donating (the serving path that probes
-        does not donate)."""
+        does not donate).  ``density`` selects the density-probe twin —
+        the UNPROBED lowering is identical either way, so only probed
+        tags split on it."""
         assert entry.skeleton is not None, "opaque (overlap) entries have no lifted program"
         assert not (donate and probes), "probed programs are not donating"
-        tag = (("single_probed", _state_sig(state)) if probes
-               else ("single", bool(donate), _state_sig(state)))
+        if probes and density is not None:
+            tag = ("single_probed_dm", int(density), _state_sig(state))
+        elif probes:
+            tag = ("single_probed", _state_sig(state))
+        else:
+            tag = ("single", bool(donate), _state_sig(state))
         n_par = entry.num_params
-        one = self._lifted_one(entry, probes)
+        one = self._lifted_one(entry, probes, density if probes else None)
 
         def build():
             jfn = jax.jit(one, donate_argnums=(0,) if donate else ())
@@ -439,7 +450,8 @@ class CompileCache:
 
     def batch_program(self, entry: CacheEntry, state, batch: int, *,
                       stacked: bool = False, mode: str = "map",
-                      probes: bool = False) -> _Program:
+                      probes: bool = False,
+                      density: int | None = None) -> _Program:
         """The microbatch executable: params stacked on axis 0, initial
         state broadcast (``stacked=False``, the shared-|0..0> fast path) or
         per-request (``stacked=True``).  ``state`` is the UNBATCHED
@@ -460,10 +472,13 @@ class CompileCache:
         assert entry.skeleton is not None
         if mode not in ("map", "vmap"):
             raise ValueError(f"batch mode must be 'map' or 'vmap', got {mode!r}")
-        tag = ("batch_probed" if probes else "batch", int(batch),
-               bool(stacked), mode, _state_sig(state))
+        if probes and density is not None:
+            head: tuple = ("batch_probed_dm", int(density))
+        else:
+            head = ("batch_probed" if probes else "batch",)
+        tag = head + (int(batch), bool(stacked), mode, _state_sig(state))
         n_par = entry.num_params
-        one = self._lifted_one(entry, probes)
+        one = self._lifted_one(entry, probes, density if probes else None)
 
         def build():
             if mode == "vmap":

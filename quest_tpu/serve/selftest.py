@@ -262,10 +262,145 @@ def _run_gradient_phase(checks: dict, echo) -> tuple:
     return ok, doc
 
 
+def _run_density_phase(checks: dict, echo) -> tuple:
+    """The noisy density-matrix workload phase (``--density``; ci.yml
+    ``numeric-selftest``): a probed 24-request probability sweep of ONE
+    noisy structural class — same skeleton (mirrored Haar layer + damping
+    + depolarising + dephasing on a 6-qubit density register), per-tenant
+    channel probabilities — through a fresh service, then the gates:
+
+    - ``density_hit_rate``: >= 0.9 — probabilities live in the operand
+      vector, so the whole sweep is ONE compiled class;
+    - ``density_bit_identity``: every batched result equals the serial
+      ``_run_ops`` execution of its own doubled circuit, bitwise;
+    - ``density_health``: every result carries a clean ``densmatr``
+      numeric_health record — trace within the ulp band of 1, Hermiticity
+      deviation within the band, zero findings;
+    - ``density_plan_fused``: the class's epoch plan (the TPU lowering of
+      the same op tuple) carries fused superoperator passes and ZERO
+      XLA-fallback ops;
+    - ``density_kraus_rejected``: a params override carrying a
+      non-trace-preserving channel slice bounces with
+      ``E_INVALID_KRAUS_OPS`` at admission.
+
+    Returns ``(ok, doc_block)``."""
+    import jax.numpy as jnp
+
+    from ..circuit import (DensityCircuit, _run_ops, op_param_count,
+                           param_vector)
+    from ..obs import numerics as _num
+    from ..ops import epoch_pallas as _ep
+    from ..validation import ErrorCode, QuESTError
+    from .cache import CompileCache
+    from .service import QuESTService
+
+    ok = True
+    n = 6
+    rng = np.random.default_rng(_SEED)
+
+    def haar() -> np.ndarray:
+        g = rng.normal(size=(2, 2)) + 1j * rng.normal(size=(2, 2))
+        u, r = np.linalg.qr(g)
+        return u * (np.diag(r) / np.abs(np.diag(r)))
+
+    gates = [haar() for _ in range(n)]
+
+    def noisy(p_damp: float, p_depol: float, p_deph: float) -> DensityCircuit:
+        dc = DensityCircuit(n)
+        for q in range(n):
+            dc.unitary(q, gates[q])
+        for q in range(0, n, 2):
+            dc.damp(q, p_damp)
+        for q in range(1, n, 2):
+            dc.depolarise(q, p_depol)
+        dc.dephase(0, p_deph)
+        return dc
+
+    cache = CompileCache()
+    ledger = _num.NumericLedger()
+    svc = QuESTService(max_batch=8, max_delay_ms=10, seed=_SEED,
+                       cache=cache, numeric_ledger=ledger, probes=True,
+                       start=False)
+    sweep = [(0.002 * i, 0.003 * i, 0.004 * i) for i in range(1, 25)]
+    circuits = [noisy(*p) for p in sweep]
+    futs = [svc.submit(c, shots=16) for c in circuits]
+    svc.start()
+    ok &= _check(checks, "density_drain", svc.drain(timeout=900),
+                 "24-request probability sweep drained")
+    results = [f.result(timeout=120) for f in futs]
+
+    snap = cache.snapshot()
+    ok &= _check(checks, "density_hit_rate", snap["hit_rate"] >= 0.9,
+                 f"hit rate {snap['hit_rate']:.3f} over "
+                 f"{snap['hits'] + snap['misses']} lookups "
+                 f"({snap['compiles']} compiles — 1 noisy class across "
+                 "the sweep)")
+
+    exact = True
+    st = jnp.zeros((2, 1 << (2 * n)), jnp.float64).at[0, 0].set(1.0)
+    for c, r in zip(circuits, results):
+        if not np.array_equal(np.asarray(_run_ops(st, c.key())), r.state):
+            exact = False
+            echo(f"FAIL density request {r.request_id}: batched state "
+                 "!= serial doubled-circuit execution")
+    ok &= _check(checks, "density_bit_identity", exact,
+                 f"{len(results)} probed results vs serial execution")
+
+    healths = [r.numeric_health for r in results
+               if r.numeric_health is not None]
+    healthy = (len(healths) == len(results)
+               and all(h["kind"] == "densmatr" and not h["findings"]
+                       for h in healths))
+    # guard the aggregates: a probe regression (missing health record)
+    # must FAIL the check below, not crash the selftest before its JSON
+    worst_tr = max((abs(h["norm"] - 1.0) for h in healths),
+                   default=float("nan"))
+    worst_h = max((h["herm_dev"] for h in healths), default=float("nan"))
+    ok &= _check(checks, "density_health", healthy,
+                 f"max |trace - 1| = {worst_tr:.3g}, max herm_dev = "
+                 f"{worst_h:.3g}, zero findings")
+
+    # zero XLA fallbacks, the whole noisy window in <= 2 fused passes,
+    # and the cross-group channels as superoperator stages (channels whose
+    # doubled pair happens to land inside ONE axis group lower as plain
+    # dense stages — equally fused, just not counted here)
+    plan = _ep.plan_circuit(circuits[0].key(), 2 * n)
+    ok &= _check(checks, "density_plan_fused",
+                 plan.xla_ops == 0 and plan.super_stages >= 3
+                 and plan.pallas_passes <= 2,
+                 f"{plan.pallas_passes} fused pass(es), "
+                 f"{plan.super_stages} superoperator stage(s), "
+                 f"{plan.xla_ops} XLA fallback op(s)")
+
+    bad = param_vector(circuits[0].ops).copy()
+    off = 0
+    for i, op in enumerate(circuits[0].ops):
+        if i in circuits[0].channel_slots and op.kind == "matrix":
+            bad[off] = 7.0      # breaks trace preservation of the slice
+            break
+        off += op_param_count(op)
+    rejected = False
+    try:
+        svc.submit(circuits[0], params=bad)
+    except QuESTError as e:
+        rejected = e.code == ErrorCode.INVALID_KRAUS_OPS
+    ok &= _check(checks, "density_kraus_rejected", rejected,
+                 "non-trace-preserving operand slice bounced with "
+                 "E_INVALID_KRAUS_OPS")
+    svc.shutdown()
+
+    doc = {"requests": len(results), "cache": snap,
+           "plan": plan.summary(),
+           "max_trace_drift": worst_tr, "max_herm_dev": worst_h,
+           "ledger": ledger.snapshot()}
+    return ok, doc
+
+
 def run_selftest(as_json: bool = False, scale: int = 1,
                  trace: bool | None = None,
                  probes: bool | None = None,
-                 gradients: bool | None = None) -> int:
+                 gradients: bool | None = None,
+                 density: bool | None = None) -> int:
     """Run the workload through fresh services sharing one fresh cache;
     print metrics (human text, or ONE JSON document with ``--json``).
     Returns the process exit status: 0 iff every check passed.
@@ -302,7 +437,14 @@ def run_selftest(as_json: bool = False, scale: int = 1,
     ci.yml ``grad-selftest`` contract): a mixed forward+gradient storm
     with bit-identity, forward-isolation, oracle, hit-rate, NaN-trip and
     router-quarantine gates, reported under the document's
-    ``"gradient"`` block."""
+    ``"gradient"`` block.
+
+    ``density=True`` (or ``QUEST_TPU_DENSITY_SELFTEST=1``) additionally
+    runs the noisy density-matrix phase (:func:`_run_density_phase`; part
+    of the ci.yml ``numeric-selftest`` contract): a probed
+    probability-sweep storm of ONE noisy structural class with hit-rate,
+    bit-identity, trace/Hermiticity-health, fused-superoperator-plan and
+    Kraus-admission gates, reported under ``"density"``."""
     import os
 
     import jax
@@ -327,6 +469,8 @@ def run_selftest(as_json: bool = False, scale: int = 1,
         probes = os.environ.get("QUEST_TPU_NUMERIC_PROBES") == "1"
     if gradients is None:
         gradients = os.environ.get("QUEST_TPU_GRAD_SELFTEST") == "1"
+    if density is None:
+        density = os.environ.get("QUEST_TPU_DENSITY_SELFTEST") == "1"
 
     from ..obs import numerics as _num
     numeric_ledger = _num.NumericLedger() if probes else None
@@ -506,6 +650,11 @@ def run_selftest(as_json: bool = False, scale: int = 1,
         g_ok, gradient_doc = _run_gradient_phase(checks, echo)
         ok &= g_ok
 
+    density_doc = None
+    if density:
+        d_ok, density_doc = _run_density_phase(checks, echo)
+        ok &= d_ok
+
     trace_doc = None
     if trace:
         # export THROUGH the cross-process merge (obs/aggregate.py): the
@@ -535,6 +684,8 @@ def run_selftest(as_json: bool = False, scale: int = 1,
             doc["numeric"] = numeric_doc
         if gradient_doc is not None:
             doc["gradient"] = gradient_doc
+        if density_doc is not None:
+            doc["density"] = density_doc
         if trace_doc is not None:
             doc["trace"] = trace_doc
         print(json.dumps(doc, default=float))

@@ -97,6 +97,10 @@ class _Request:
     coeffs: np.ndarray | None = None    # term coefficients (runtime operand)
     masks: tuple | None = None          # packed term masks (structural)
     grad_num_params: int = 0
+    # density requests (a DensityCircuit submitted through submit()): the
+    # density qubit count n of the Choi-doubled 2n-qubit register — selects
+    # the densmatr probe/ledger kind and rho-diagonal sampling
+    density: int | None = None
 
 
 class QuESTService:
@@ -283,7 +287,20 @@ class QuESTService:
         ``probes`` overrides the service's numeric-probe default for this
         request: a probed request runs the probe-instrumented program
         variant (primary output bit-identical) and carries a
-        ``numeric_health`` record on its result and flight record."""
+        ``numeric_health`` record on its result and flight record.
+
+        A :class:`~quest_tpu.circuit.DensityCircuit` submits a NOISY
+        density-matrix workload: the recorded ops are already the
+        Choi-doubled 2n-qubit program (mirrored unitaries + channel
+        superoperators), so the class lifts, batches and routes like any
+        other — one compiled program per (skeleton, channel mask), channel
+        probabilities riding in the operand vector.  Admission validates
+        every channel operand slice trace-preserving
+        (``E_INVALID_KRAUS_OPS`` — a params override cannot smuggle in a
+        malformed map), probed requests graft the DENSITY probe (trace +
+        Hermiticity, judged as ``densmatr`` by the numeric ledger), the
+        drift baseline is the initial state's TRACE, and ``shots`` sample
+        from rho's diagonal."""
         if not isinstance(circuit, _circ.Circuit):
             raise TypeError(f"submit takes a Circuit, got {type(circuit)!r}")
         from ..autodiff import ParamCircuit, ParamOp
@@ -316,18 +333,28 @@ class QuESTService:
         shots = int(shots)
         if shots < 0:
             raise ValueError("shots must be >= 0")
+        density = getattr(circuit, "density_qubits", None)
+        if density is not None:
+            # channel admission: every channel slot's superoperator operand
+            # (recorded payload OR the params override's slice) must
+            # preserve Tr(rho) — E_INVALID_KRAUS_OPS at the front door,
+            # never silent trace drift on the worker
+            _circ.validate_density_operands(
+                circuit, pvec if params is not None else None, "submit")
         probed = self.default_probes if probes is None else bool(probes)
         # the probe flag is part of the BATCHING key (a probed and an
         # unprobed request run different compiled programs and must not
         # co-batch) but NOT of the class identity the SLO monitor, the
         # flight ring and the router aggregate on — probing is an
-        # observability mode, not a different workload class
+        # observability mode, not a different workload class.  The density
+        # marker joins it for the same reason: the probed density twin is a
+        # different executable.
         group_key = (circuit.num_qubits, circuit.key(structural=True),
-                     state0 is None, probed)
+                     state0 is None, probed, density)
         return self._enqueue(ops=ops, num_qubits=circuit.num_qubits,
                              pvec=pvec, shots=shots, deadline_ms=deadline_ms,
                              state0=state0, group_key=group_key,
-                             probed=probed)
+                             probed=probed, density=density)
 
     def submit_gradient(self, circuit, params=None, hamiltonian=None,
                         deadline_ms: float | None = None,
@@ -418,7 +445,8 @@ class QuESTService:
 
     def _enqueue(self, *, ops, num_qubits, pvec, shots, deadline_ms, state0,
                  group_key, probed, grad=False, coeffs=None, masks=None,
-                 grad_num_params=0, span="serve.submit") -> Future:
+                 grad_num_params=0, density=None,
+                 span="serve.submit") -> Future:
         """The shared admission tail of :meth:`submit` /
         :meth:`submit_gradient`: bounded-queue entry, backpressure,
         flight/SLO/span bookkeeping — one code path so the two front
@@ -436,7 +464,13 @@ class QuESTService:
         expected_norm = 1.0
         if probed and state0 is not None:
             s0 = state0.astype(np.float64, copy=False)
-            expected_norm = float(np.sum(s0[0] * s0[0] + s0[1] * s0[1]))
+            if density is not None:
+                # the density probe's first field is Tr(rho), so the drift
+                # baseline is the INPUT's trace, not its L2 norm
+                dim = 1 << int(density)
+                expected_norm = float(np.trace(s0[0].reshape(dim, dim)))
+            else:
+                expected_norm = float(np.sum(s0[0] * s0[0] + s0[1] * s0[1]))
         t0p = time.perf_counter()
         fut: Future = Future()
         with self._cond:
@@ -460,7 +494,8 @@ class QuESTService:
                                             pvec, shots, deadline, state0,
                                             fut, now, group_key, class_key,
                                             probed, expected_norm, grad,
-                                            coeffs, masks, grad_num_params))
+                                            coeffs, masks, grad_num_params,
+                                            density))
                 depth = len(self._queue)
                 self.metrics.inc("requests_submitted_total")
                 if grad:
@@ -622,7 +657,8 @@ class QuESTService:
                 else:
                     states, probe_vecs, padded = _batch.execute_group(
                         self._cache, entry, live, self._state,
-                        self.max_batch, mode=self.batch_mode, probes=probed)
+                        self.max_batch, mode=self.batch_mode, probes=probed,
+                        density=live[0].density)   # group key includes it
                     jax.block_until_ready(states[-1])
                 dt = time.perf_counter() - t0
                 class_key = _obs.key_hash(entry.skey)
@@ -668,8 +704,11 @@ class QuESTService:
                              if is_grad else len(req.ops))
                     rec = self.numeric_ledger.record(
                         class_key, probe_host[i],
+                        kind=("densmatr" if req.density is not None
+                              else "statevec"),
                         engine=entry.options.engine, dtype=str(st.dtype),
-                        num_qubits=req.num_qubits, num_ops=depth,
+                        num_qubits=(req.density if req.density is not None
+                                    else req.num_qubits), num_ops=depth,
                         class_key=class_key,
                         expected_norm=req.expected_norm, warn=False)
                     health = rec.as_health()
@@ -754,10 +793,18 @@ class QuESTService:
         """``req.shots`` joint outcomes over all qubits from the request's
         PRIVATE MT19937 stream seeded (service_seed, request_id): the same
         inverse-CDF draw as the API's sampleOutcomes, but isolated so
-        batching order can never change any request's outcomes."""
+        batching order can never change any request's outcomes.  Density
+        requests sample from rho's DIAGONAL (the outcome distribution of a
+        mixed state — the NISQ-emulation serving scenario), negative
+        rounding dust clipped to zero."""
         from ..ops import measure as _meas
-        probs = np.asarray(_meas.prob_all_outcomes(
-            state, tuple(range(req.num_qubits))))
+        if req.density is not None:
+            diag = np.asarray(_meas.densmatr_diagonal(
+                jnp.asarray(state), req.density)[0], np.float64)
+            probs = np.maximum(diag, 0.0)
+        else:
+            probs = np.asarray(_meas.prob_all_outcomes(
+                state, tuple(range(req.num_qubits))))
         cdf = np.cumsum(probs)
         total = cdf[-1]
         if not np.isfinite(total) or total <= 0:

@@ -52,15 +52,22 @@ class CircuitStats:
     permutation_ops: int = 0     # swap/bitperm: data movement, not MXU work
     engine: str = "xla"          # backend the pass count describes
     deferred_perm_ops: int = 0   # perms the epoch engine absorbs (0 passes)
+    super_passes: int = 0        # fused passes carrying superoperator stages
+    super_stages: int = 0        # density channels fused with zero extra passes
+    density_qubits: int | None = None  # Choi-doubled register: n density qubits
 
     def __str__(self):
         gb = self.bytes_per_pass / 1e9
+        dens = (f" [density {self.density_qubits}q doubled]"
+                if self.density_qubits is not None else "")
+        sup = (f", {self.super_stages} superop stages in "
+               f"{self.super_passes} passes" if self.super_stages else "")
         return (f"{self.num_ops} ops: {self.mxu_contractions} dense (MXU), "
                 f"{self.diagonal_ops} diagonal (VPU), "
                 f"{self.permutation_ops} permutation, "
                 f"{self.cross_shard_ops} cross-shard; "
                 f"~{self.hbm_passes} HBM passes x {gb:.3g} GB "
-                f"({self.engine} engine)")
+                f"({self.engine} engine{sup}){dens}")
 
 
 def circuit_stats(circuit, num_qubits: int | None = None,
@@ -100,6 +107,7 @@ def circuit_stats(circuit, num_qubits: int | None = None,
     hbm_passes = num_ops  # one read+write sweep per un-fused op
     engine = "xla"
     deferred = 0
+    super_passes = super_stages = 0
     if fused and num_ranks <= 1 and circuit.ops:
         # spec-level engine decision (backend pinned to "tpu" so the stats
         # are deployment stats, not dev-box stats): the epoch plan's fused
@@ -122,6 +130,8 @@ def circuit_stats(circuit, num_qubits: int | None = None,
             engine = "pallas"
             hbm_passes = choice["plan"].hbm_passes
             deferred = choice["plan"].deferred_ops
+            super_passes = choice["plan"].super_passes
+            super_stages = choice["plan"].super_stages
     return CircuitStats(
         num_ops=num_ops,
         hbm_passes=hbm_passes,
@@ -132,6 +142,9 @@ def circuit_stats(circuit, num_qubits: int | None = None,
         permutation_ops=perm,
         engine=engine,
         deferred_perm_ops=deferred,
+        super_passes=super_passes,
+        super_stages=super_stages,
+        density_qubits=getattr(circuit, "density_qubits", None),
     )
 
 

@@ -1,0 +1,575 @@
+"""Density-matrix noise channels on the Pallas epoch engine (PR 15).
+
+The DensityCircuit IR records a density circuit DIRECTLY as its
+Choi-doubled 2n-qubit program (mirrored unitary + conjugate shadow;
+channels as superoperator ops on the paired (q, q+n) wires), and the epoch
+executor lowers the channels as fused elementwise superoperator stages
+(ops/epoch_pallas.py ``_apply_super_spec``) — kernels run in interpret
+mode here, Mosaic-compiled on a chip.
+
+Covers: the doubled IR against the eager decoherence oracle (bitwise),
+host superop builders against the traced channels, the epoch engine
+against the XLA engine on noisy circuits across the geometry regimes
+(degenerate block / full block+pack incl. widened-column pack superops),
+the O(1)-passes-per-layer pin for the headline 14q damping+depol layer,
+arbitrary non-unitary 2-target payloads through the superop stage, the
+density window of select_engine, the superoperator window domain of
+check_density_lowering/check_density_plan (clean + two adversarial
+mutations), Kraus admission (E_INVALID_KRAUS_OPS from apply_kraus_map,
+record time and serve submit), the probed density serving path (trace +
+Hermiticity health, probability-sweep class sharing, rho-diagonal
+sampling), per-pass density probes, the analyzer's channel-aware payload
+validation, circuit_stats density reporting, and scheduler metadata
+carry-through.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from quest_tpu.circuit import (DensityCircuit, GateOp, _run_ops,
+                               compile_circuit, op_param_count, param_vector,
+                               validate_density_operands)
+from quest_tpu.ops import decoherence as deco
+from quest_tpu.ops import epoch_pallas as ep
+from quest_tpu.parallel import planner
+from quest_tpu.validation import ErrorCode, QuESTError
+
+
+def _haar(rng, k: int = 1) -> np.ndarray:
+    d = 1 << k
+    g = rng.normal(size=(d, d)) + 1j * rng.normal(size=(d, d))
+    u, r = np.linalg.qr(g)
+    return u * (np.diag(r) / np.abs(np.diag(r)))
+
+
+def _kraus_damp(p: float) -> list:
+    return [np.diag([1.0, np.sqrt(1.0 - p)]),
+            np.array([[0.0, np.sqrt(p)], [0.0, 0.0]])]
+
+
+def _noisy(n: int, seed: int = 0, kraus: bool = True) -> DensityCircuit:
+    rng = np.random.default_rng(seed)
+    dc = DensityCircuit(n)
+    for q in range(n):
+        dc.unitary(q, _haar(rng))
+    for q in range(0, n, 2):
+        dc.damp(q, 0.04 + 0.01 * q)
+    for q in range(1, n, 2):
+        dc.depolarise(q, 0.03)
+    dc.dephase(0, 0.1)
+    if n >= 4:
+        dc.two_qubit_dephase(1, 3, 0.05)
+    if kraus:
+        dc.kraus((n - 1,), _kraus_damp(0.2))
+    return dc
+
+
+def _rand_state(n_register: int, seed: int = 7) -> jnp.ndarray:
+    rng = np.random.default_rng(seed)
+    st = rng.normal(size=(2, 1 << n_register)).astype(np.float32)
+    st /= np.sqrt(np.sum(st * st))
+    return jnp.asarray(st)
+
+
+# ---------------------------------------------------------------------------
+# IR: the Choi-doubling against the eager decoherence oracle
+# ---------------------------------------------------------------------------
+
+def test_doubled_ir_matches_eager_oracle():
+    """DensityCircuit's recorded op list reproduces the eager decoherence
+    path (mix_damping / mix_depolarising / mix_dephasing /
+    mix_two_qubit_dephasing / apply_kraus_map) to f64 rounding — the same
+    engine kernels in one fused program vs per-op dispatches, so anything
+    beyond last-ulp FMA-contraction drift is a doubling bug."""
+    from quest_tpu.ops import apply as ap
+    n = 5
+    rng = np.random.default_rng(11)
+    us = [_haar(rng) for _ in range(n)]
+    dc = DensityCircuit(n)
+    for q, u in enumerate(us):
+        dc.unitary(q, u)
+    dc.damp(0, 0.1)
+    dc.depolarise(1, 0.07)
+    dc.dephase(2, 0.2)
+    dc.two_qubit_dephase(3, 4, 0.12)
+    dc.kraus((2,), _kraus_damp(0.3))
+
+    st = jnp.zeros((2, 1 << (2 * n)), jnp.float64).at[0, 0].set(1.0)
+    s = st
+    for q, u in enumerate(us):
+        s = ap.apply_matrix(s, jnp.asarray(ap.mat_pair(u)), (q,))
+        s = ap.apply_matrix(s, jnp.asarray(ap.mat_pair(u.conj())), (q + n,))
+    s = deco.mix_damping(s, jnp.asarray(0.1), 0, n)
+    s = deco.mix_depolarising(s, jnp.asarray(0.07), 1, n)
+    s = deco.mix_dephasing(s, jnp.asarray(0.2), 2, n)
+    s = deco.mix_two_qubit_dephasing(s, jnp.asarray(0.12), 3, 4, n)
+    s = deco.apply_kraus_map(s, _kraus_damp(0.3), (2,), n)
+
+    got = _run_ops(st, dc.key())
+    np.testing.assert_allclose(np.asarray(got), np.asarray(s), atol=1e-12)
+    dim = 1 << n
+    trace = float(np.sum(np.asarray(got[0]).reshape(dim, dim).diagonal()))
+    assert abs(trace - 1.0) < 1e-12
+
+
+def test_host_superop_builders_match_traced_channels():
+    """The static builders DensityCircuit records are the same maps the
+    traced mix_* channels apply (drift between the twins would split the
+    doubled-circuit path from the eager API)."""
+    n, q, p = 3, 1, 0.23
+    st = _rand_state(2 * n, 3).astype(jnp.float64)
+    pairs = [
+        (deco.damping_superop(p), deco.mix_damping),
+        (deco.depolarising_superop(p), deco.mix_depolarising),
+    ]
+    for sp, fn in pairs:
+        want = fn(st, jnp.asarray(p), q, n)
+        got = deco._superop_apply(st, jnp.asarray(sp), (q, q + n), None)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-12)
+    from quest_tpu.ops.apply import apply_diagonal
+    dd = deco.dephasing_diag(p)
+    want = deco.mix_dephasing(st, jnp.asarray(p), q, n)
+    got = apply_diagonal(st, jnp.asarray(dd), (q, q + n))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-12)
+
+
+def test_density_circuit_rejects_out_of_range_wires_and_bad_probs():
+    dc = DensityCircuit(4)
+    with pytest.raises(QuESTError):
+        dc.unitary(4, np.eye(2))          # bra wires are not addressable
+    with pytest.raises(QuESTError):
+        dc.damp(0, 1.5)
+    with pytest.raises(QuESTError):
+        dc.depolarise(0, 0.9)             # > 3/4
+    # channel targets get the same record-time contract as unitary wires
+    with pytest.raises(QuESTError) as e:
+        dc.damp(4, 0.1)                   # density wire out of range
+    assert e.value.code == ErrorCode.INVALID_TARGET_QUBIT
+    with pytest.raises(QuESTError) as e:
+        dc.two_qubit_dephase(1, 1, 0.05)  # duplicate density targets
+    assert e.value.code == ErrorCode.TARGETS_NOT_UNIQUE
+    with pytest.raises(QuESTError):
+        dc.kraus((4,), _kraus_damp(0.1))
+    assert dc.ops == [] and dc.channel_slots == set()  # nothing recorded
+
+
+# ---------------------------------------------------------------------------
+# epoch engine: fused superoperator passes vs the XLA engine
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n", [5, 6, 8, 9])
+def test_epoch_engine_matches_xla_on_noisy_circuits(n):
+    """Forced-pallas (interpret) vs the XLA engine on mixed noisy density
+    circuits: n=5..8 exercise the degenerate single-block geometry (whole
+    window incl. every channel in ONE fused pass), n=9 (18 register
+    qubits) the full block+pack geometry with widened-column pack
+    superoperator stages."""
+    dc = _noisy(n, seed=n)
+    plan = ep.plan_circuit(dc.key(), 2 * n)
+    assert plan.xla_ops == 0, plan.summary()
+    assert plan.super_stages >= 3
+    st = _rand_state(2 * n, seed=n)
+    want = np.asarray(compile_circuit(dc, engine="xla")(st))
+    got = np.asarray(compile_circuit(dc, engine="pallas")(st))
+    assert np.abs(got - want).max() < 5e-5
+
+
+def test_degenerate_geometry_one_pass_per_noisy_window():
+    dc = _noisy(7, seed=2)
+    plan = ep.plan_circuit(dc.key(), 14)
+    assert plan.pallas_passes == 1
+    assert plan.xla_ops == 0
+    s = plan.summary()
+    assert s["super_passes"] == 1 and s["super_stages"] >= 5
+
+
+def test_headline_14q_damping_depol_layer_is_o1_passes():
+    """The acceptance pin: a depth-5 damping+depolarising layer on a
+    14-density-qubit register (the densmatr_14q_damping_depol_f32 bench
+    workload — 42 ops/layer on the doubled register) compiles to THREE
+    fused passes per layer, zero XLA fallbacks, and models faster than
+    the per-gate XLA engine."""
+    rng = np.random.default_rng(7)
+    n, depth = 14, 5
+    dc = DensityCircuit(n)
+    for _ in range(depth):
+        for q in range(n):
+            dc.unitary(q, _haar(rng))
+        for q in range(0, n, 2):
+            dc.damp(q, 0.02)
+        for q in range(1, n, 2):
+            dc.depolarise(q, 0.02)
+    assert len(dc.ops) == depth * (2 * n + n)
+    plan = ep.plan_circuit(dc.key(), 2 * n)
+    assert plan.xla_ops == 0, plan.summary()
+    assert plan.pallas_passes == 3 * depth, plan.summary()
+    assert plan.super_stages == depth * n  # every channel fused
+    model = planner.engine_time_model(dc)
+    assert model["pallas_seconds"] < model["xla_seconds"] / 3
+
+
+def test_superop_stage_handles_arbitrary_nonunitary_payloads():
+    """The superop stage is a general 2-target dense lowering: random
+    NON-unitary (and non-trace-preserving) 4x4 payloads on cross-group
+    pairs run through the block and pack superop paths and match XLA."""
+    from quest_tpu.circuit import Circuit
+    rng = np.random.default_rng(5)
+    n = 18
+    c = Circuit(n)
+    for pair in [(0, 14), (3, 17), (9, 15)]:   # lane-fiber, cols-pack, ...
+        m = (rng.normal(size=(4, 4)) + 1j * rng.normal(size=(4, 4))) * 0.4
+        mp = np.stack([m.real, m.imag])
+        c.ops.append(GateOp("matrix", pair, (), (), tuple(mp.ravel()),
+                            mp.shape))
+    plan = ep.plan_circuit(c.key(), n)
+    assert plan.xla_ops == 0, plan.summary()
+    assert plan.super_stages == 3
+    st = _rand_state(n, 9)
+    want = np.asarray(compile_circuit(c, engine="xla")(st))
+    got = np.asarray(compile_circuit(c, engine="pallas")(st))
+    assert np.abs(got - want).max() < 5e-5
+
+
+def test_unitary_cross_group_plans_unchanged_by_super_stage():
+    """The superop route only fires where the odd-bit (CSD) decomposition
+    cannot: a unitary cross-group window plans exactly as before (no
+    super stages)."""
+    from quest_tpu.circuit import Circuit
+    rng = np.random.default_rng(6)
+    c = Circuit(18)
+    c.multi_qubit_unitary((2, 12), _haar(rng, 2))
+    plan = ep.plan_circuit(c.key(), 18)
+    assert plan.super_stages == 0
+    assert plan.xla_ops == 0
+
+
+def test_select_engine_density_window_reason():
+    dc = DensityCircuit(16)       # 32 register qubits: one past the ceiling
+    dc.unitary(0, np.eye(2))
+    choice = planner.select_engine(dc, 1, backend="tpu")
+    assert choice["engine"] == "xla"
+    assert "density register outside 5 <= n <= 15" in choice["reason"]
+    with pytest.raises(QuESTError):
+        planner.select_engine(dc, 1, requested="pallas")
+
+
+def test_engine_time_model_prices_super_passes():
+    """Full-geometry (n >= 17 register) super-carrying block passes are
+    priced at the slower ``pallas_epoch_super`` class — strictly more
+    modeled seconds than the same pass count at the plain block class —
+    and the breakdown reports the split."""
+    dc = _noisy(9, seed=4)
+    model = planner.engine_time_model(dc)
+    bd = model["pallas_pass_breakdown"]
+    assert bd["super_passes"] >= 1 and bd["super_stages"] >= 5
+    state_bytes = (1 << model["num_qubits"]) * 8
+    plain_all = (
+        bd["block_passes"] * 2.0 * state_bytes
+        / (planner.V5E.hbm_bytes_per_sec
+           * planner.MEASURED_EFFICIENCY["pallas_epoch"])
+        + bd["pack_passes"] * 2.0 * state_bytes
+        / (planner.V5E.hbm_bytes_per_sec
+           * planner.MEASURED_EFFICIENCY["pallas_epoch_pack"]))
+    assert model["pallas_seconds"] > plain_all
+    # ...while still modeling far below the per-gate XLA engine
+    assert model["pallas_seconds"] < model["xla_seconds"] / 2
+
+
+# ---------------------------------------------------------------------------
+# the superoperator window domain (analysis/equivalence.py)
+# ---------------------------------------------------------------------------
+
+def test_check_density_plan_clean():
+    from quest_tpu.analysis import check_density_plan
+    dc = _noisy(6, seed=8)
+    assert check_density_plan(dc) == []
+
+
+def test_density_lowering_proof_is_engine_independent():
+    """The Choi-doubling proof runs OUTSIDE the epoch envelope too: a
+    4-density-qubit circuit (8 register qubits — below the [10, 30]
+    window) still verifies, and a planted wrong-conjugate mutation in it
+    is still refuted (review-found: the CLI used to skip the density half
+    for out-of-window registers)."""
+    from quest_tpu.analysis import check_density_lowering
+    dc = _noisy(4, seed=12, kraus=False)
+    assert not ep.epoch_supported(8)
+    assert check_density_lowering(dc) == []
+    mut = DensityCircuit(4)
+    mut.ops = list(dc.ops)
+    mut.channel_slots = set(dc.channel_slots)
+    mut.channel_log = list(dc.channel_log)
+    for i, op in enumerate(mut.ops):
+        if (op.kind == "matrix" and i not in mut.channel_slots
+                and op.targets[0] >= 4):
+            p = op.payload()
+            mut.ops[i] = GateOp(op.kind, op.targets, op.controls,
+                                op.control_states,
+                                tuple(np.stack([p[0], -p[1]]).ravel()),
+                                op.shape)
+            break
+    assert any(d.code == "V_SEMANTICS_CHANGED"
+               for d in check_density_lowering(mut))
+
+
+def test_density_circuit_optimize_refused():
+    """Record-time fusion would orphan the channel metadata and the
+    mirrored pairing — DensityCircuit refuses it with a clean error."""
+    dc = _noisy(5, seed=2)
+    with pytest.raises(QuESTError) as e:
+        dc.optimize()
+    assert e.value.code == ErrorCode.INVALID_SCHEDULE_OPTION
+    assert "DensityCircuit.optimize" in str(e.value)
+
+
+def test_check_density_lowering_catches_wrong_conjugate():
+    from quest_tpu.analysis import check_density_lowering
+    dc = _noisy(6, seed=8)
+    mut = DensityCircuit(6)
+    mut.ops = list(dc.ops)
+    mut.channel_slots = set(dc.channel_slots)
+    mut.channel_log = list(dc.channel_log)
+    for i, op in enumerate(mut.ops):
+        if (op.kind == "matrix" and i not in mut.channel_slots
+                and op.targets[0] >= 6):
+            p = op.payload()          # un-conjugate the shadow: U ⊗ U
+            mut.ops[i] = GateOp(op.kind, op.targets, op.controls,
+                                op.control_states,
+                                tuple(np.stack([p[0], -p[1]]).ravel()),
+                                op.shape)
+            break
+    found = check_density_lowering(mut)
+    assert any(d.code == "V_SEMANTICS_CHANGED" for d in found)
+
+
+def test_check_density_lowering_catches_corrupted_channel():
+    from quest_tpu.analysis import check_density_lowering
+    dc = _noisy(6, seed=8)
+    mut = DensityCircuit(6)
+    mut.ops = list(dc.ops)
+    mut.channel_slots = set(dc.channel_slots)
+    mut.channel_log = list(dc.channel_log)
+    ci = next(i for i in sorted(mut.channel_slots)
+              if mut.ops[i].kind == "matrix")
+    op = mut.ops[ci]
+    p = op.payload()
+    p[0, 0, 3] *= 2.0                 # wrong coupling: not the Kraus map
+    mut.ops[ci] = GateOp(op.kind, op.targets, op.controls,
+                         op.control_states, tuple(p.ravel()), op.shape)
+    found = check_density_lowering(mut)
+    assert any(d.code == "V_SEMANTICS_CHANGED" for d in found)
+
+
+def test_examples_density_factory_proves_and_probes():
+    import sys
+    sys.path.insert(0, "examples")
+    try:
+        from circuits import density_noise_9q
+    finally:
+        sys.path.pop(0)
+    from quest_tpu.analysis import check_density_plan, probe_epoch_execution
+    dc = density_noise_9q()
+    assert check_density_plan(dc) == []
+    assert probe_epoch_execution(dc) == []
+    plan = ep.plan_circuit(dc.key(), 18)
+    assert plan.xla_ops == 0 and plan.super_stages >= 10
+
+
+# ---------------------------------------------------------------------------
+# Kraus admission (E_INVALID_KRAUS_OPS)
+# ---------------------------------------------------------------------------
+
+def test_apply_kraus_map_rejects_non_trace_preserving():
+    st = jnp.zeros((2, 1 << 6), jnp.float64).at[0, 0].set(1.0)
+    with pytest.raises(QuESTError) as e:
+        deco.apply_kraus_map(st, [np.eye(2) * 1.2], (0,), 3)
+    assert e.value.code == ErrorCode.INVALID_KRAUS_OPS
+    # a valid map still applies
+    out = deco.apply_kraus_map(st, _kraus_damp(0.25), (0,), 3)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_density_circuit_kraus_rejects_at_record_time():
+    dc = DensityCircuit(3)
+    with pytest.raises(QuESTError) as e:
+        dc.kraus((0,), [np.diag([1.0, 0.5])])
+    assert e.value.code == ErrorCode.INVALID_KRAUS_OPS
+
+
+def test_validate_density_operands_accepts_f32_roundtripped_params():
+    """An operand vector rounded through float32 — exactly the precision
+    the compiled f32 plane executables consume — must pass admission: the
+    trace-preservation tolerance is scaled to the loosest working
+    precision, not f64 (review-found: a 1e-8 tolerance bounced valid
+    f32-rounded probability sweeps)."""
+    dc = _noisy(5, seed=1)
+    pv = param_vector(dc.ops).astype(np.float32).astype(np.float64)
+    validate_density_operands(dc, pv)     # must not raise
+
+
+def test_validate_density_operands_catches_corrupted_slice():
+    dc = _noisy(5, seed=1)
+    validate_density_operands(dc)     # recorded payloads are clean
+    pv = param_vector(dc.ops).copy()
+    off = 0
+    for i, op in enumerate(dc.ops):
+        if i in dc.channel_slots and op.kind == "matrix":
+            pv[off] = 3.0
+            break
+        off += op_param_count(op)
+    with pytest.raises(QuESTError) as e:
+        validate_density_operands(dc, pv)
+    assert e.value.code == ErrorCode.INVALID_KRAUS_OPS
+
+
+# ---------------------------------------------------------------------------
+# serving: noisy structural classes
+# ---------------------------------------------------------------------------
+
+def test_serve_density_probability_sweep_one_class():
+    """A probability sweep of one noisy skeleton serves as ONE structural
+    class (probabilities ride the operand vector): hit rate >= 0.9, every
+    probed batch carries a clean densmatr health record (trace ~ 1,
+    Hermiticity within band), results bit-identical to serial, samples
+    drawn from rho's diagonal, and a non-trace-preserving params override
+    bounces at admission."""
+    from quest_tpu.serve import QuESTService
+    from quest_tpu.serve.cache import CompileCache
+    rng = np.random.default_rng(21)
+    n = 5
+    gates = [_haar(rng) for _ in range(n)]
+
+    def noisy(pd, pp, pz):
+        dc = DensityCircuit(n)
+        for q in range(n):
+            dc.unitary(q, gates[q])
+        for q in range(0, n, 2):
+            dc.damp(q, pd)
+        for q in range(1, n, 2):
+            dc.depolarise(q, pp)
+        dc.dephase(0, pz)
+        return dc
+
+    svc = QuESTService(max_batch=8, max_delay_ms=5.0, probes=True,
+                       cache=CompileCache())
+    sweep = [(0.01 * i, 0.004 * i, 0.02 * i) for i in range(1, 21)]
+    circs = [noisy(*p) for p in sweep]
+    futs = [svc.submit(c, shots=8) for c in circs]
+    res = [f.result(timeout=300) for f in futs]
+    svc.drain(timeout=300)
+    snap = svc._cache.snapshot()
+    assert snap["hit_rate"] >= 0.9, snap
+    st = jnp.zeros((2, 1 << (2 * n)), jnp.float64).at[0, 0].set(1.0)
+    dim = 1 << n
+    for c, r in zip(circs, res):
+        assert r.numeric_health is not None
+        assert r.numeric_health["kind"] == "densmatr"
+        assert not r.numeric_health["findings"], r.numeric_health
+        assert abs(r.numeric_health["norm"] - 1.0) < 1e-6
+        assert np.array_equal(np.asarray(_run_ops(st, c.key())), r.state)
+        # samples come from rho's diagonal
+        diag = np.asarray(r.state[0]).reshape(dim, dim).diagonal()
+        assert all(diag[o] > 0 for o in r.samples)
+    bad = param_vector(circs[0].ops).copy()
+    off = 0
+    for i, op in enumerate(circs[0].ops):
+        if i in circs[0].channel_slots and op.kind == "matrix":
+            bad[off] = 9.0
+            break
+        off += op_param_count(op)
+    with pytest.raises(QuESTError) as e:
+        svc.submit(circs[0], params=bad)
+    assert e.value.code == ErrorCode.INVALID_KRAUS_OPS
+    svc.shutdown()
+
+
+def test_grafted_probe_density_matches_densmatr_probe():
+    from quest_tpu.obs import numerics as num
+    st = _rand_state(8, 13).astype(jnp.float64)
+    got = np.asarray(num.grafted_probe(st, density_qubits=4))
+    want = np.asarray(num.densmatr_probe_vector(st, 4))
+    np.testing.assert_allclose(got, want, atol=0)
+
+
+def test_epoch_pass_probes_density_per_pass_trace():
+    """Per-pass density probes: every fused-pass boundary reports trace +
+    Hermiticity (the plan has no deferred perms), the point count equals
+    the plan's pass count, and the final state matches the uninstrumented
+    program bit-for-bit."""
+    from quest_tpu.obs import numerics as num
+    dc = _noisy(6, seed=3, kraus=False)
+    st = jnp.zeros((2, 1 << 12), jnp.float32).at[0, 0].set(1.0)
+    out, points, summary = num.epoch_pass_probes(dc.key(), 12, st,
+                                                 density_qubits=6)
+    assert len(points) == summary["pallas_passes"]
+    assert all("trace" in p and "herm_dev" in p for p in points)
+    assert abs(points[-1]["trace"] - 1.0) < 1e-5
+    assert points[-1]["herm_dev"] < 1e-5
+    want = np.asarray(compile_circuit(dc, engine="pallas")(st))
+    assert np.array_equal(np.asarray(out), want)
+
+
+# ---------------------------------------------------------------------------
+# analysis / profiling / scheduling surfaces
+# ---------------------------------------------------------------------------
+
+def test_analyzer_accepts_channels_and_catches_corruption():
+    from quest_tpu.analysis import analyze_circuit
+    dc = _noisy(6, seed=8)
+    found = analyze_circuit(dc, hints=False)
+    errors = [d for d in found if d.severity.name == "ERROR"]
+    assert errors == [], [str(d) for d in errors]
+    # a corrupted channel payload is E_INVALID_KRAUS_OPS, not NON_UNITARY
+    mut = DensityCircuit(6)
+    mut.ops = list(dc.ops)
+    mut.channel_slots = set(dc.channel_slots)
+    mut.channel_log = list(dc.channel_log)
+    ci = next(i for i in sorted(mut.channel_slots)
+              if mut.ops[i].kind == "matrix")
+    op = mut.ops[ci]
+    p = op.payload()
+    p[0, 0, 0] = 0.2
+    mut.ops[ci] = GateOp(op.kind, op.targets, op.controls,
+                         op.control_states, tuple(p.ravel()), op.shape)
+    found = analyze_circuit(mut, hints=False)
+    assert any(d.code == ErrorCode.INVALID_KRAUS_OPS for d in found)
+
+
+def test_circuit_stats_reports_density_super_passes():
+    from quest_tpu.utils.profiling import circuit_stats
+    dc = _noisy(7, seed=5)
+    stats = circuit_stats(dc)
+    assert stats.engine == "pallas"
+    assert stats.density_qubits == 7
+    assert stats.super_stages >= 5 and stats.super_passes >= 1
+    assert stats.hbm_passes == ep.plan_circuit(dc.key(), 14).hbm_passes
+    assert stats.bytes_per_pass == 2 * (1 << 14) * 4
+    assert "density 7q doubled" in str(stats)
+
+
+def test_schedule_carries_density_metadata():
+    dc = _noisy(5, seed=6)
+    sched = dc.schedule(1)
+    assert getattr(sched, "density_qubits", None) == 5
+    assert len(sched.channel_slots) == len(dc.channel_slots)
+    kinds = sorted(rec[1] for rec in sched.channel_log)
+    assert kinds == sorted(rec[1] for rec in dc.channel_log)
+
+
+def test_apply_circuit_density_path():
+    import quest_tpu as qt
+    n = 4
+    dc = _noisy(n, seed=9, kraus=False)
+    env = qt.createQuESTEnv()
+    rho = qt.createDensityQureg(n, env)
+    qt.apply_circuit(rho, dc)
+    tr = float(np.asarray(qt.calcTotalProb(rho)))
+    assert abs(tr - 1.0) < 1e-10
+    psi = qt.createQureg(n, env)
+    with pytest.raises(QuESTError):
+        qt.apply_circuit(psi, dc)     # statevector qureg: wrong register
