@@ -66,9 +66,10 @@ def mixed_envelope_16q() -> Circuit:
     c.multi_qubit_unitary((8, 14), _haar(rng, 2))  # sublane x fiber
     c.multi_qubit_unitary((5,), _haar(rng), controls=(11,))
     c.cz(2, 9)
-    c.multi_rotate_z((0, 4, 8, 12), 0.61)
+    c.multi_rotate_z((0, 4, 8, 12), 0.61)       # unlifted-ok: fixed demo angle
     c.swap(1, 13)                                # deferred: zero passes
     c.unitary(1, _haar(rng))
+    # unlifted-ok: fixed demo angle — this showcase class compiles once
     c.phase_shift(15, 0.37, controls=(6,))
     return c
 
@@ -97,9 +98,9 @@ def density_noise_9q() -> DensityCircuit:
         for q in range(layer, n, 2):
             c.damp(q, 0.02 + 0.01 * layer)
         for q in range(1 - layer, n, 2):
-            c.depolarise(q, 0.015)
-    c.dephase(4, 0.08)
-    c.two_qubit_dephase(0, 5, 0.06)
+            c.depolarise(q, 0.015)      # unlifted-ok: fixed demo noise model
+    c.dephase(4, 0.08)                  # unlifted-ok: fixed demo noise model
+    c.two_qubit_dephase(0, 5, 0.06)     # unlifted-ok: fixed demo noise model
     c.kraus((8,), [np.diag([1.0, np.sqrt(0.85)]),
                    np.array([[0.0, np.sqrt(0.15)], [0.0, 0.0]])])
     return c

@@ -1,6 +1,6 @@
 """quest_tpu.analysis — static analysis for circuits and the codebase.
 
-Five cooperating passes, all pure host work (no device allocation; the
+Cooperating passes, all pure host work (no device allocation; the
 jaxpr audit optionally compiles but never executes), mirroring the role
 QuEST_validation.c plays in the reference but *ahead* of run time:
 
@@ -26,10 +26,18 @@ QuEST_validation.c plays in the reference but *ahead* of run time:
    annotations, lock-order graph, blocking-under-lock; ``T_*`` codes)
    with :func:`run_schedule_fuzz_smoke` as its dynamic twin: forced
    thread interleavings stress-proving the lock-free read surfaces.
+7. :func:`audit_staticcheck_package` /
+   :func:`audit_served_classes` — compile-economics static checker
+   (``S_*`` codes, analysis/staticcheck.py): AST rules for unlifted
+   literal gate parameters, recompile-keyed jit boundaries, hot-path
+   host syncs and f64-forcing flows, plus a jaxpr diff proving every
+   served structural class is closed over its operand vector (one XLA
+   program per class, not per request).
 
 CLI: ``python -m quest_tpu.analysis --self-lint`` (the tier-1 CI gate),
-``--verify-schedule`` (the scheduler translation-validation smoke) and
-``--concurrency [--fuzz-smoke]`` (the lock-discipline gate), see
+``--verify-schedule`` (the scheduler translation-validation smoke),
+``--concurrency [--fuzz-smoke]`` (the lock-discipline gate) and
+``--staticcheck`` (the compile-economics gate), see
 ``python -m quest_tpu.analysis --help`` and docs/ANALYSIS.md.
 """
 
@@ -56,6 +64,12 @@ from .concurrency import (  # noqa: F401
 from .schedfuzz import (  # noqa: F401
     Interleaver,
     run_smoke as run_schedule_fuzz_smoke)
+from .staticcheck import (  # noqa: F401
+    audit_package as audit_staticcheck_package,
+    audit_paths as audit_staticcheck_paths,
+    audit_source as audit_staticcheck_source,
+    audit_served_classes,
+    corpus_report as staticcheck_corpus_report)
 
 __all__ = [
     "AnalysisCode", "Diagnostic", "Severity", "max_severity", "message_for",
@@ -71,4 +85,7 @@ __all__ = [
     "audit_concurrency_package", "audit_concurrency_paths",
     "audit_concurrency_source", "strip_first_lock_scope",
     "Interleaver", "run_schedule_fuzz_smoke",
+    "audit_staticcheck_package", "audit_staticcheck_paths",
+    "audit_staticcheck_source", "audit_served_classes",
+    "staticcheck_corpus_report",
 ]

@@ -18,6 +18,23 @@ Modes (combinable; exit status is 1 iff any ERROR-severity diagnostic):
   ``"concurrency"`` section (classes, lock graph, fuzz rows) with
   severities in the shared ``diagnostics``/``summary`` sections the CI
   gate already parses.
+- ``--staticcheck``: compile-economics static audit
+  (analysis/staticcheck.py; ``S_*`` codes).  Layer 1: AST rules over the
+  whole quest_tpu tree plus examples/ — literal gate parameters the
+  param_vector lift should carry (``S_UNLIFTED_LITERAL``),
+  recompile-keyed jit boundaries (``S_RECOMPILE_HAZARD``), host syncs
+  reachable from submission roots (``S_HOST_SYNC_IN_HOT_PATH``),
+  f64-forcing flows inside traced functions (``S_X64_PROMOTION``);
+  waivers ``# unlifted-ok:`` / ``# recompile-ok:`` / ``# host-sync-ok:``
+  / ``# x64-ok:`` with REQUIRED reasons.  Layer 2: per serve-selftest
+  structural class, the per-request program is re-traced under an
+  operand perturbation and diffed constant by constant — any difference
+  is a per-request recompile proven at trace time
+  (``S_CLASS_NOT_CLOSED``) — and a weak-type scan of the f32 trace pins
+  ``S_X64_PROMOTION`` on the program actually served.
+  ``--staticcheck-paths PATH ...`` audits arbitrary trees (AST layer
+  only); ``--no-served-classes`` skips the jaxpr layer.  Under
+  ``--json`` everything lands in the ``"staticcheck"`` section.
 - ``--qft N`` / ``--random N DEPTH``: analyze a generated benchmark circuit.
 - ``--circuit module:attr``: import and analyze a user circuit — ``attr``
   may be a :class:`quest_tpu.Circuit` or a zero-argument factory.
@@ -524,6 +541,19 @@ def main(argv=None) -> int:
                         dest="fuzz_seeds", metavar="N",
                         help="interleaving seeds per fuzz scenario "
                              "(default %(default)s)")
+    parser.add_argument("--staticcheck", action="store_true",
+                        help="compile-economics static audit (S_* codes): "
+                             "AST rules over quest_tpu + examples plus the "
+                             "traced-served-class jaxpr diff "
+                             "(analysis/staticcheck.py)")
+    parser.add_argument("--staticcheck-paths", nargs="+", metavar="PATH",
+                        dest="staticcheck_paths",
+                        help="audit these files/trees with the S_* AST "
+                             "rules only (implies --staticcheck, skips the "
+                             "served-class audit)")
+    parser.add_argument("--no-served-classes", action="store_true",
+                        help="with --staticcheck: skip the Layer-2 traced "
+                             "served-class audit (AST rules only)")
     parser.add_argument("--qft", type=int, metavar="N",
                         help="analyze an N-qubit QFT circuit")
     parser.add_argument("--random", nargs=2, type=int, metavar=("N", "DEPTH"),
@@ -613,7 +643,8 @@ def main(argv=None) -> int:
 
     doc: dict = {"circuits": [], "schedule": [], "verify": [],
                  "serve_audit": [], "trace_report": [], "numeric_report": [],
-                 "concurrency": None, "diagnostics": [], "summary": {}}
+                 "concurrency": None, "staticcheck": None,
+                 "diagnostics": [], "summary": {}}
 
     def echo(line: str) -> None:
         if not args.as_json:
@@ -658,6 +689,35 @@ def main(argv=None) -> int:
                      f"{row['violations']} violation(s), "
                      f"{row['errors']} error(s)")
         doc["concurrency"] = report
+        diagnostics += found
+
+    if args.staticcheck_paths:
+        args.staticcheck = True
+    if args.staticcheck:
+        ran = True
+        from .staticcheck import (audit_package as _static_package,
+                                  audit_paths as _static_paths,
+                                  audit_served_classes)
+        if args.staticcheck_paths:
+            report, found = _static_paths(args.staticcheck_paths)
+        else:
+            report, found = _static_package()
+        echo(f"staticcheck: {report['files']} file(s), "
+             f"{report['findings']} finding(s), "
+             f"{report['waived']} waived, "
+             f"{len(report['hot_path_functions'])} hot-path function(s)")
+        class_rows = None
+        if not (args.staticcheck_paths or args.no_served_classes):
+            class_rows, cfound = audit_served_classes()
+            found = found + cfound
+            for row in class_rows:
+                echo(f"staticcheck class {row['label']}: "
+                     f"{'lifted' if row['lifted'] else 'OPAQUE'} "
+                     f"({row['engine']}), twin_shares_entry="
+                     f"{row['twin_shares_entry']}, "
+                     f"{row['trace_differences']} trace diff(s), f32 out "
+                     f"{','.join(row['f32_output_dtypes'])}")
+        doc["staticcheck"] = {"ast": report, "classes": class_rows}
         diagnostics += found
 
     circuits = []

@@ -67,8 +67,11 @@ __all__ = ["audit_paths", "audit_package", "audit_source",
 
 #: the quest_tpu subpackages the repo self-audit covers (the concurrent
 #: runtime surface; the analysis package itself is host-single-threaded
-#: except schedfuzz, whose scheduler is its own test subject)
-AUDIT_SUBPACKAGES = ("serve", "deploy", "obs")
+#: except schedfuzz, whose scheduler is its own test subject).  grad and
+#: parallel are swept too: neither owns a lock today (their shared state
+#: is the serve cache's, audited via serve/), so the sweep holds them to
+#: staying that way — a lock-owning class added there is auto-audited
+AUDIT_SUBPACKAGES = ("serve", "deploy", "obs", "grad", "parallel")
 
 _GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_]\w*)")
 _LOCKFREE_RE = re.compile(r"#\s*lock-free:\s*(.*?)\s*$")

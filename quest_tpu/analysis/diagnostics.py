@@ -8,8 +8,11 @@ log line maps 1:1 onto the exception a production run would have died with.
 Findings with no runtime twin (memory projections, eager/compiled drift,
 purity lint) use analysis-only code families: ``A_*`` for circuit/abstract
 analysis, ``H_*`` for optimization hints, ``P_*`` for source purity rules,
-and ``V_*`` for the scheduler translation validator
-(analysis/equivalence.py).
+``V_*`` for the scheduler translation validator (analysis/equivalence.py),
+``T_*`` for the concurrency lock-discipline audit (analysis/concurrency.py),
+``O_*`` for the runtime ledgers (quest_tpu/obs), and ``S_*`` for the
+compile-economics static checker (analysis/staticcheck.py): recompile
+hazards, unlifted gate payloads, hot-path host syncs and f64 promotion.
 """
 
 from __future__ import annotations
@@ -86,6 +89,13 @@ class AnalysisCode:
     UNANNOTATED_SHARED_ATTR = "T_UNANNOTATED_SHARED_ATTR"
     LOCK_FREE_NO_REASON = "T_LOCK_FREE_NO_REASON"
     SCHEDULE_FUZZ_FAILURE = "T_SCHEDULE_FUZZ_FAILURE"
+    # compile-economics static checker (analysis/staticcheck.py) and its
+    # jaxpr-side sibling pass (jaxpr_audit.py trace-diff helpers)
+    UNLIFTED_LITERAL = "S_UNLIFTED_LITERAL"
+    RECOMPILE_HAZARD = "S_RECOMPILE_HAZARD"
+    HOST_SYNC_IN_HOT_PATH = "S_HOST_SYNC_IN_HOT_PATH"
+    X64_PROMOTION = "S_X64_PROMOTION"
+    CLASS_NOT_CLOSED = "S_CLASS_NOT_CLOSED"
 
 
 ANALYSIS_MESSAGES = {
@@ -270,6 +280,45 @@ ANALYSIS_MESSAGES = {
         "forced thread interleaving in which a lock-free read surface "
         "returned an internally inconsistent snapshot or a concurrent "
         "operation raised: a real runtime race, not a static projection.",
+    AnalysisCode.UNLIFTED_LITERAL:
+        "A continuous gate parameter (angle / channel probability) is a "
+        "Python literal at the builder call site: served through an opaque "
+        "class (overlap or pallas engine, where payloads are NOT lifted "
+        "into the param_vector operand) the literal becomes a compiled "
+        "constant and every distinct value compiles its own XLA program — "
+        "the 'cached but not lifted' regression class.  Bind the value "
+        "from data, or waive a deliberately fixed circuit with "
+        "'# unlifted-ok: <reason>'.",
+    AnalysisCode.RECOMPILE_HAZARD:
+        "A jit boundary is keyed so that routine inputs change the compile "
+        "key: a jax.jit wrapper constructed and invoked per call (a fresh "
+        "cache per invocation), or a float literal passed to a declared "
+        "static argument (one compiled program PER VALUE of a continuous "
+        "knob).  Hoist the wrapper / make the argument an operand, or "
+        "waive with '# recompile-ok: <reason>'.",
+    AnalysisCode.HOST_SYNC_IN_HOT_PATH:
+        "A host-synchronising call (.item(), block_until_ready, "
+        "jax.device_get, np.asarray/np.array) executes on the serve/deploy "
+        "submission hot path: if the value is a device array the submitter "
+        "thread blocks on a device transfer, adding device latency to "
+        "EVERY tenant's admission — the worker thread owns device waits, "
+        "the submitter must not.  Move it behind the queue, or waive a "
+        "provably-host value with '# host-sync-ok: <reason>'.",
+    AnalysisCode.X64_PROMOTION:
+        "A float64-forcing dtype flow inside a traced function (a NumPy "
+        "strong-typed scalar mixed into traced arithmetic, or an explicit "
+        ".astype(float64)): under x64 this silently promotes f32 programs "
+        "to f64 before TPU lowering — straight into the XLA:TPU "
+        "X64-rewriter miscompile wall (ROADMAP item 3).  Use weak Python "
+        "scalars / jnp casts tied to the state dtype, or waive a "
+        "deliberate f64 path with '# x64-ok: <reason>'.",
+    AnalysisCode.CLASS_NOT_CLOSED:
+        "Re-tracing this served structural class with a perturbed operand "
+        "vector changed the program itself (a trace constant, literal or "
+        "equation differs, or the perturbed twin missed the cache entry): "
+        "the class is not closed over its parameters, so EVERY request "
+        "with new angles recompiles — one XLA program per request instead "
+        "of one per class (serve/cache.py's core economic invariant).",
 }
 
 

@@ -43,6 +43,15 @@ the loop statically:
 CLI: part of ``--verify-schedule`` (docs/ANALYSIS.md); the CI smoke runs
 it on the scheduled 22q QFT over the 8-virtual-device mesh — the same
 pair bench.py measures.
+
+This module also hosts the jaxpr-side half of pass 8, the compile-economics
+static checker (analysis/staticcheck.py): :func:`trace_lifted_class` /
+:func:`trace_embedded_ops` trace the per-request program a serve cache
+entry actually runs, :func:`diff_trace_constants` diffs two such traces
+constant-by-constant (any difference under an operand perturbation is a
+per-request recompile, ``S_CLASS_NOT_CLOSED``), and
+:func:`scan_x64_promotion` weak-type-scans a trace for f32→f64 promoting
+equations and promoted program outputs (``S_X64_PROMOTION``).
 """
 
 from __future__ import annotations
@@ -56,7 +65,9 @@ from .diagnostics import AnalysisCode, Diagnostic, Severity, diag
 
 __all__ = ["count_jaxpr_collectives", "count_hlo_collectives",
            "count_hlo_async_collectives", "donation_aliased",
-           "audit_dispatch", "audit_schedule_pair", "audit_overlap"]
+           "audit_dispatch", "audit_schedule_pair", "audit_overlap",
+           "trace_lifted_class", "trace_embedded_ops",
+           "diff_trace_constants", "scan_x64_promotion"]
 
 # how many HLO collectives one planner comm event may legitimately lower
 # to: a pairwise exchange spells as an (all-gather, all-reduce) partial-sum
@@ -125,6 +136,146 @@ def make_dispatch_jaxpr(circuit, dtype=None):
     spec = jax.ShapeDtypeStruct((2, 1 << circuit.num_qubits),
                                 dtype or jnp.float32)
     return jax.make_jaxpr(lambda s: _run_ops_routed(s, ops))(spec)
+
+
+# ---------------------------------------------------------------------------
+# pass 8 (staticcheck.py) helpers: per-request trace, constant diff,
+# weak-type scan
+# ---------------------------------------------------------------------------
+
+def trace_lifted_class(num_qubits: int, skeleton, offsets, num_params: int,
+                       dtype=None):
+    """Abstract trace of a LIFTED cache entry's per-request program — the
+    ``(state, params)`` body serve/cache.py compiles once per structural
+    class.  Payloads arrive through the abstract params operand, so the
+    trace is payload-free by construction."""
+    import jax
+    import jax.numpy as jnp
+    from ..circuit import _run_ops_routed
+    spec = jax.ShapeDtypeStruct((2, 1 << num_qubits), dtype or jnp.float64)
+    pav = jax.ShapeDtypeStruct((int(num_params),), jnp.float64)
+    return jax.make_jaxpr(
+        lambda s, p: _run_ops_routed(s, skeleton, p, offsets))(spec, pav)
+
+
+def trace_embedded_ops(num_qubits: int, ops, dtype=None):
+    """Abstract trace of the payload-EMBEDDING program an opaque cache
+    entry (overlap / pallas — ``skeleton is None``) runs per request:
+    state-only signature, gate payloads baked in as trace constants."""
+    import jax
+    import jax.numpy as jnp
+    from ..circuit import _run_ops_routed
+    spec = jax.ShapeDtypeStruct((2, 1 << num_qubits), dtype or jnp.float64)
+    return jax.make_jaxpr(lambda s: _run_ops_routed(s, tuple(ops)))(spec)
+
+
+def _const_key(value) -> tuple | None:
+    """A comparable fingerprint for a numeric constant, None for
+    non-numeric values (functions, dimension descriptors, ...)."""
+    if isinstance(value, (bool, int, float, complex, str)):
+        return ("scalar", repr(value))
+    if isinstance(value, np.ndarray) or np.isscalar(value):
+        arr = np.asarray(value)
+        return ("array", arr.shape, str(arr.dtype), arr.tobytes())
+    if isinstance(value, tuple) and all(
+            isinstance(v, (bool, int, float, complex, str)) for v in value):
+        return ("tuple", repr(value))
+    return None
+
+
+def _trace_rows(jaxpr) -> list[tuple]:
+    """Flatten a (Closed)Jaxpr into comparable rows: one per equation
+    (recursing sub-jaxprs) carrying the primitive name, every Literal
+    invar's fingerprint, and every numeric eqn param's fingerprint."""
+    try:
+        from jax._src import core as _core
+    except ImportError:  # pragma: no cover - jax moved the module
+        from jax import core as _core  # type: ignore[no-redef]
+    rows: list[tuple] = []
+
+    def walk(jx) -> None:
+        for eqn in jx.eqns:
+            lits = tuple(_const_key(v.val) for v in eqn.invars
+                         if isinstance(v, _core.Literal))
+            pkeys = []
+            for k in sorted(eqn.params):
+                key = _const_key(eqn.params[k])
+                if key is not None:
+                    pkeys.append((k, key))
+            rows.append((eqn.primitive.name, lits, tuple(pkeys)))
+            for value in eqn.params.values():
+                for sub in _sub_jaxprs(value):
+                    walk(sub)
+
+    walk(jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr)
+    return rows
+
+
+def diff_trace_constants(j1, j2) -> list[str]:
+    """Diff two traces of what must be ONE compiled program: closed
+    consts, equation sequence, literal invars, numeric eqn params.  Every
+    returned string is a constant (or structure) that changed between the
+    two requests — i.e. a per-request recompile, proven abstractly."""
+    diffs: list[str] = []
+    c1 = [np.asarray(c) for c in getattr(j1, "consts", [])]
+    c2 = [np.asarray(c) for c in getattr(j2, "consts", [])]
+    if len(c1) != len(c2):
+        diffs.append(f"closed-const count {len(c1)} vs {len(c2)}")
+    else:
+        for i, (a, b) in enumerate(zip(c1, c2)):
+            if (a.shape != b.shape or a.dtype != b.dtype
+                    or a.tobytes() != b.tobytes()):
+                diffs.append(
+                    f"closed const #{i} ({a.dtype}{a.shape}) differs")
+    r1, r2 = _trace_rows(j1), _trace_rows(j2)
+    if len(r1) != len(r2):
+        diffs.append(f"equation count {len(r1)} vs {len(r2)}")
+        return diffs
+    for i, (a, b) in enumerate(zip(r1, r2)):
+        if a[0] != b[0]:
+            diffs.append(f"eqn #{i}: primitive {a[0]} vs {b[0]}")
+        elif a[1] != b[1]:
+            diffs.append(f"eqn #{i} ({a[0]}): literal operand differs")
+        elif a[2] != b[2]:
+            diffs.append(f"eqn #{i} ({a[0]}): numeric eqn param differs")
+    return diffs
+
+
+def scan_x64_promotion(jaxpr, expect=None) -> tuple:
+    """Weak-type scan of a trace: find every equation that takes an
+    ``expect``-dtype (default float32) input and produces a float64
+    output — the promotion events — and report the program's output
+    dtypes.  Returns ``(events, out_dtypes)`` where each event is
+    ``(primitive, in_dtypes, out_dtypes)``."""
+    import jax.numpy as jnp
+    expect_dt = np.dtype(expect if expect is not None else jnp.float32)
+    f64 = np.dtype(np.float64)
+
+    def _dt(v):
+        aval = getattr(v, "aval", None)
+        dt = getattr(aval, "dtype", None)
+        return np.dtype(dt) if dt is not None else None
+
+    events: list[tuple] = []
+
+    def walk(jx) -> None:
+        for eqn in jx.eqns:
+            ins = [_dt(v) for v in eqn.invars]
+            outs = [_dt(v) for v in eqn.outvars]
+            if (any(o == f64 for o in outs if o is not None)
+                    and any(i == expect_dt for i in ins if i is not None)):
+                events.append((eqn.primitive.name,
+                               [str(i) for i in ins if i is not None],
+                               [str(o) for o in outs if o is not None]))
+            for value in eqn.params.values():
+                for sub in _sub_jaxprs(value):
+                    walk(sub)
+
+    inner = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+    walk(inner)
+    out_dtypes = [d for d in (_dt(v) for v in inner.outvars)
+                  if d is not None]
+    return events, out_dtypes
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,782 @@
+"""Pass 8: compile-economics static checker over the serving surface.
+
+The product at fleet scale is the compile-cache hit rate (ROADMAP item 7):
+ONE XLA program per structural class, payloads riding in the lifted
+``param_vector`` operand.  The two costliest regressions in this repo's
+history broke exactly that invariant and were found only by benchmarks —
+overlap classes "cached but not lifted" recompiling per angle set, and
+weak-type f64 promotion walking f32 programs into the XLA:TPU X64-rewriter
+miscompile wall.  Both were statically detectable.  This pass (the ``S_*``
+family) makes them machine-checked, the way pass 7 (concurrency.py) made
+the lock discipline checkable.
+
+**Layer 1 — AST audit** (:func:`audit_paths` / :func:`audit_package`, same
+skeleton as concurrency.py), four rules:
+
+- ``S_UNLIFTED_LITERAL`` — a continuous gate parameter (rotation angle,
+  channel probability) written as a Python float literal at a builder
+  call site (``c.ry(q, 0.37)``).  Through a LIFTED class the literal is
+  harmless (it lands in the operand vector), but through an opaque class
+  (overlap / pallas engines — ``CacheEntry.skeleton is None``) it becomes
+  a compiled constant and every distinct value compiles its own program.
+  Statically the engine is unknowable, so the rule demands data-bound
+  parameters or a reasoned waiver: ``# unlifted-ok: <reason>``.
+- ``S_RECOMPILE_HAZARD`` — jit boundaries keyed so routine inputs change
+  the compile key: a ``jax.jit`` wrapper constructed AND invoked inside a
+  function body (fresh cache per call; the AOT ``jax.jit(f).lower(...)``
+  chain is exempt), or a float literal / unhashable literal passed to a
+  declared static argument of a jit boundary defined in the same module
+  (one program per knob value).  Waiver: ``# recompile-ok: <reason>``.
+- ``S_HOST_SYNC_IN_HOT_PATH`` — ``.item()``, ``block_until_ready``,
+  ``jax.device_get``, ``np.asarray``/``np.array`` in a function reachable
+  (intra-module, ``self.``-call and bare-call edges) from a submission
+  root: any method/function named in :data:`HOT_PATH_ROOTS` or annotated
+  ``# hot-path``.  The submitter thread must never block on a device
+  transfer — the worker thread owns device latency (serve/service.py's
+  split).  Waiver: ``# host-sync-ok: <reason>``.
+- ``S_X64_PROMOTION`` — inside a jit-decorated function, traced-parameter
+  arithmetic mixed with a strong-typed ``np.*`` value (NumPy scalars and
+  arrays promote f32 operands to f64 under x64; weak Python literals and
+  ``np.pi``-style plain floats do not), or an explicit
+  ``.astype(float64)`` on a traced parameter.  Waiver: ``# x64-ok:
+  <reason>``.
+
+Waiver reasons are REQUIRED, exactly like ``# lock-free:``: an annotation
+with an empty reason does not waive.
+
+**Layer 2 — traced-class audit** (:func:`audit_served_classes`): for every
+structural class a serve workload registers, take its cache entry twice —
+once for the request circuit, once for an operand-perturbed twin — and
+trace the program the cache will actually run per request
+(jaxpr_audit.trace_lifted_class / trace_embedded_ops).  The jaxprs are
+diffed constant-by-constant (jaxpr_audit.diff_trace_constants): ANY
+difference is a per-request recompile proven at trace time,
+``S_CLASS_NOT_CLOSED`` — the lifted program's trace is payload-free by
+construction and passes; an opaque class embeds payloads as constants and
+fails.  A weak-type scan of the f32-state trace
+(jaxpr_audit.scan_x64_promotion) pins ``S_X64_PROMOTION`` on the actual
+program: an f32 request whose RESULT leaves the program as f64 has been
+promoted before TPU lowering.
+
+A refutation corpus (:data:`CORPUS`, :func:`corpus_report`) keeps the
+checker honest: every rule must flag its seeded-bad snippet and stay
+silent on the fixed twin (tests/test_staticcheck.py and the CI lint job
+both assert it).  CLI: ``python -m quest_tpu.analysis --staticcheck``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .diagnostics import AnalysisCode, Diagnostic, Severity, diag
+
+__all__ = ["audit_paths", "audit_package", "audit_source",
+           "audit_served_classes", "corpus_report", "CORPUS",
+           "HOT_PATH_ROOTS"]
+
+#: function/method names that anchor the submission-side hot path; the
+#: reachability scan also roots at any def annotated ``# hot-path``
+HOT_PATH_ROOTS = frozenset((
+    "submit", "submit_gradient", "submit_batch", "route", "dispatch",
+))
+
+#: builder methods taking continuous parameters, mapped to the positions
+#: (0-based in the call's positional args) and keyword names that carry
+#: them — the operands the param_vector lift exists for (circuit.py)
+_CONTINUOUS_ARGS = {
+    "phase_shift": ((1,), ("angle",)),
+    "rx": ((1,), ("angle",)),
+    "ry": ((1,), ("angle",)),
+    "rz": ((1,), ("angle",)),
+    "multi_rotate_z": ((1,), ("angle",)),
+    "multi_rotate_pauli": ((2,), ("angle",)),
+    "compact_unitary": ((1, 2), ("alpha", "beta")),
+    "dephase": ((1,), ("prob",)),
+    "two_qubit_dephase": ((2,), ("prob",)),
+    "depolarise": ((1,), ("prob",)),
+    "damp": ((1,), ("prob",)),
+    "mix_pauli": ((1, 2, 3), ("prob_x", "prob_y", "prob_z")),
+}
+
+_UNLIFTED_RE = re.compile(r"#\s*unlifted-ok:\s*(.*?)\s*$")
+_RECOMPILE_RE = re.compile(r"#\s*recompile-ok:\s*(.*?)\s*$")
+_HOSTSYNC_RE = re.compile(r"#\s*host-sync-ok:\s*(.*?)\s*$")
+_X64_RE = re.compile(r"#\s*x64-ok:\s*(.*?)\s*$")
+_HOTPATH_RE = re.compile(r"#\s*hot-path\b")
+
+_WAIVERS = {
+    AnalysisCode.UNLIFTED_LITERAL: _UNLIFTED_RE,
+    AnalysisCode.RECOMPILE_HAZARD: _RECOMPILE_RE,
+    AnalysisCode.HOST_SYNC_IN_HOT_PATH: _HOSTSYNC_RE,
+    AnalysisCode.X64_PROMOTION: _X64_RE,
+}
+
+#: jit entry points (dotted call names)
+_JIT_NAMES = frozenset(("jax.jit", "jit"))
+_PARTIAL_NAMES = frozenset(("partial", "functools.partial"))
+
+#: host-synchronising dotted calls (`.item()` is matched structurally)
+_SYNC_DOTTED = frozenset((
+    "jax.block_until_ready", "jax.device_get",
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+))
+
+#: ``np.*`` attributes that are plain Python floats (weak-typed — they do
+#: NOT promote f32 arithmetic) and so are exempt from the x64 rule
+_NP_WEAK_CONSTS = frozenset((
+    "np.pi", "np.e", "np.inf", "np.nan", "np.euler_gamma",
+    "numpy.pi", "numpy.e", "numpy.inf", "numpy.nan", "numpy.euler_gamma",
+))
+
+
+def _dotted(node: ast.AST) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+class _Annotations:
+    """Per-file comment annotations by line number (concurrency.py's
+    convention: the statement's first line or the directly preceding
+    pure-comment line)."""
+
+    def __init__(self, source: str):
+        self.lines = source.splitlines()
+
+    def _line(self, lineno: int | None) -> str:
+        if lineno is None or not 1 <= lineno <= len(self.lines):
+            return ""
+        return self.lines[lineno - 1]
+
+    def _match(self, pattern: re.Pattern, lineno: int | None):
+        m = pattern.search(self._line(lineno))
+        if m is None and lineno is not None:
+            prev = self._line(lineno - 1).strip()
+            if prev.startswith("#"):
+                m = pattern.search(prev)
+        return m
+
+    def waiver(self, code: str, lineno: int | None) -> str | None:
+        """The reason string of the code's waiver comment ('' when present
+        but unreasoned — which does NOT waive), None when absent."""
+        m = self._match(_WAIVERS[code], lineno)
+        return m.group(1) if m else None
+
+    def hot_path(self, lineno: int | None) -> bool:
+        return self._match(_HOTPATH_RE, lineno) is not None
+
+
+def _literal_only(node: ast.AST) -> bool:
+    """True for an expression built ONLY from numeric literals (unary sign
+    and arithmetic allowed) — no Names, no Calls, so provably not bound
+    from data."""
+    if isinstance(node, ast.Constant):
+        return (isinstance(node.value, (int, float))
+                and not isinstance(node.value, bool))
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op,
+                                                    (ast.UAdd, ast.USub)):
+        return _literal_only(node.operand)
+    if isinstance(node, ast.BinOp):
+        return _literal_only(node.left) and _literal_only(node.right)
+    return False
+
+
+def _has_float(node: ast.AST) -> bool:
+    return any(isinstance(n, ast.Constant) and isinstance(n.value, float)
+               for n in ast.walk(node))
+
+
+def _mentions(node: ast.AST, names) -> str | None:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id in names:
+            return n.id
+    return None
+
+
+def _np_strong(node: ast.AST) -> str | None:
+    """Dotted name of a strong-typed ``np.*`` value inside ``node`` (a call
+    like np.float64/np.sqrt or an array attribute), None if the expression
+    only touches weak float constants like np.pi."""
+    for n in ast.walk(node):
+        d = _dotted(n.func) if isinstance(n, ast.Call) else (
+            _dotted(n) if isinstance(n, ast.Attribute) else "")
+        if (d.startswith(("np.", "numpy."))
+                and d not in _NP_WEAK_CONSTS):
+            return d
+    return None
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    """True for ``jax.jit(...)`` and ``partial(jax.jit, ...)``."""
+    d = _dotted(call.func)
+    if d in _JIT_NAMES:
+        return True
+    return (d in _PARTIAL_NAMES and call.args
+            and _dotted(call.args[0]) in _JIT_NAMES)
+
+
+def _static_names(call: ast.Call, argnames: list) -> set:
+    """Static parameter names declared by a jit(...) / partial(jax.jit,...)
+    call, resolved against the wrapped function's argument names."""
+    statics: set = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            statics |= {e.value for e in elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)}
+        elif kw.arg == "static_argnums":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if (isinstance(e, ast.Constant) and isinstance(e.value, int)
+                        and 0 <= e.value < len(argnames)):
+                    statics.add(argnames[e.value])
+    return statics
+
+
+class _JitBoundary:
+    def __init__(self, argnames, statics, node):
+        self.argnames = argnames        # positional parameter names, in order
+        self.statics = statics          # subset declared static
+        self.node = node                # the FunctionDef (x64 rule scope)
+
+
+def _collect_jit_boundaries(tree: ast.Module) -> dict:
+    """name -> _JitBoundary for jit-wrapped functions defined in this
+    module: decorator form (@jax.jit / @partial(jax.jit, ...)) and
+    assignment form (g = jax.jit(f, ...))."""
+    defs = {n.name: n for n in tree.body if isinstance(n, ast.FunctionDef)}
+    out: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                statics: set | None = None
+                if isinstance(dec, ast.Call) and _is_jit_call(dec):
+                    statics = _static_names(
+                        dec, [a.arg for a in node.args.args])
+                elif _dotted(dec) in _JIT_NAMES:
+                    statics = set()
+                if statics is not None:
+                    out[node.name] = _JitBoundary(
+                        [a.arg for a in node.args.args], statics, node)
+        elif (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and _is_jit_call(node.value)):
+            call = node.value
+            wrapped = None
+            if _dotted(call.func) in _JIT_NAMES and call.args:
+                wrapped = call.args[0]
+            elif _dotted(call.func) in _PARTIAL_NAMES and len(call.args) > 1:
+                wrapped = call.args[1]
+            if isinstance(wrapped, ast.Name) and wrapped.id in defs:
+                fn = defs[wrapped.id]
+                argnames = [a.arg for a in fn.args.args]
+                out[node.targets[0].id] = _JitBoundary(
+                    argnames, _static_names(call, argnames), fn)
+    return out
+
+
+class _FileAudit:
+    """One module's Layer-1 findings."""
+
+    def __init__(self, filename: str, source: str):
+        self.filename = filename
+        self.ann = _Annotations(source)
+        self.tree = ast.parse(source, filename=filename)
+        self.diagnostics: list[Diagnostic] = []
+        self.waived = 0
+        self.hot_path: list[str] = []
+
+    def _emit(self, code: str, lineno: int, detail: str) -> None:
+        reason = self.ann.waiver(code, lineno)
+        if reason:
+            self.waived += 1
+            return
+        if reason == "":
+            detail += " (waiver present but UNREASONED — refused)"
+        self.diagnostics.append(diag(code, Severity.ERROR,
+                                     file=self.filename, line=lineno,
+                                     detail=detail))
+
+    # -- rule 1: literal continuous gate parameters -----------------------
+    def check_unlifted_literals(self) -> None:
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _CONTINUOUS_ARGS):
+                continue
+            positions, kwnames = _CONTINUOUS_ARGS[node.func.attr]
+            candidates = [node.args[i] for i in positions
+                          if i < len(node.args)]
+            candidates += [kw.value for kw in node.keywords
+                           if kw.arg in kwnames]
+            for arg in candidates:
+                if _literal_only(arg) and _has_float(arg):
+                    self._emit(
+                        AnalysisCode.UNLIFTED_LITERAL, node.lineno,
+                        f"literal {ast.unparse(arg)} passed to "
+                        f".{node.func.attr}() — bind from data so the "
+                        f"param_vector lift can carry it")
+
+    # -- rule 2: recompile-keyed jit boundaries ---------------------------
+    def check_recompile_hazards(self) -> None:
+        boundaries = _collect_jit_boundaries(self.tree)
+        # (a) jit wrapper constructed and invoked inside a function body
+        for fn in ast.walk(self.tree):
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Call)
+                        and _is_jit_call(node.func)):
+                    self._emit(
+                        AnalysisCode.RECOMPILE_HAZARD, node.lineno,
+                        "jax.jit wrapper constructed AND invoked per call "
+                        "— a fresh compile cache every invocation; hoist "
+                        "the wrapper to module/attribute scope")
+        # (b) float / unhashable literal fed to a declared static argument
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in boundaries):
+                continue
+            b = boundaries[node.func.id]
+            bound: list[tuple] = []
+            for i, arg in enumerate(node.args):
+                if i < len(b.argnames) and b.argnames[i] in b.statics:
+                    bound.append((b.argnames[i], arg))
+            bound += [(kw.arg, kw.value) for kw in node.keywords
+                      if kw.arg in b.statics]
+            for pname, arg in bound:
+                if _literal_only(arg) and _has_float(arg):
+                    self._emit(
+                        AnalysisCode.RECOMPILE_HAZARD, node.lineno,
+                        f"float literal {ast.unparse(arg)} passed to "
+                        f"STATIC argument '{pname}' of {node.func.id}() — "
+                        "one compiled program per value of a continuous "
+                        "knob; make it an operand")
+                elif isinstance(arg, (ast.List, ast.Dict, ast.Set)):
+                    self._emit(
+                        AnalysisCode.RECOMPILE_HAZARD, node.lineno,
+                        f"unhashable literal passed to STATIC argument "
+                        f"'{pname}' of {node.func.id}() — the jit cache "
+                        "key cannot hash it")
+
+    # -- rule 3: host syncs reachable from submission roots ---------------
+    def check_host_syncs(self) -> None:
+        # function table: (class name or "", def name) -> FunctionDef
+        table: dict = {}
+        for node in self.tree.body:
+            if isinstance(node, ast.FunctionDef):
+                table[("", node.name)] = node
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, ast.FunctionDef):
+                        table[(node.name, sub.name)] = sub
+        roots = [key for key, fn in table.items()
+                 if key[1] in HOT_PATH_ROOTS or self.ann.hot_path(fn.lineno)]
+        # BFS over intra-module call edges, remembering the root
+        reach: dict = {key: key[1] for key in roots}
+        frontier = list(roots)
+        while frontier:
+            cls, name = frontier.pop()
+            for node in ast.walk(table[(cls, name)]):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = None
+                if (isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "self"
+                        and (cls, node.func.attr) in table):
+                    callee = (cls, node.func.attr)
+                elif (isinstance(node.func, ast.Name)
+                        and ("", node.func.id) in table):
+                    callee = ("", node.func.id)
+                if callee is not None and callee not in reach:
+                    reach[callee] = reach[(cls, name)]
+                    frontier.append(callee)
+        for (cls, name), root in sorted(reach.items()):
+            self.hot_path.append(
+                f"{cls + '.' if cls else ''}{name} (via {root})")
+            for node in ast.walk(table[(cls, name)]):
+                if not isinstance(node, ast.Call):
+                    continue
+                sync = None
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "item" and not node.args):
+                    sync = ".item()"
+                elif (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "block_until_ready"):
+                    sync = "block_until_ready"
+                elif _dotted(node.func) in _SYNC_DOTTED:
+                    sync = _dotted(node.func)
+                if sync:
+                    self._emit(
+                        AnalysisCode.HOST_SYNC_IN_HOT_PATH, node.lineno,
+                        f"{sync} in {cls + '.' if cls else ''}{name}, "
+                        f"reachable from hot-path root '{root}' — the "
+                        "submitter thread must not wait on a device value")
+
+    # -- rule 4: f64-forcing flows inside traced functions ----------------
+    def check_x64_promotion(self) -> None:
+        for b in _collect_jit_boundaries(self.tree).values():
+            traced = {a for a in b.argnames if a not in b.statics
+                      and a != "self"}
+            for node in ast.walk(b.node):
+                if isinstance(node, ast.BinOp):
+                    for side, other in ((node.left, node.right),
+                                        (node.right, node.left)):
+                        strong = _np_strong(other)
+                        if strong and _mentions(side, traced):
+                            self._emit(
+                                AnalysisCode.X64_PROMOTION, node.lineno,
+                                f"traced value mixed with strong-typed "
+                                f"{strong} — under x64 this promotes f32 "
+                                "programs to f64; use a weak Python "
+                                "scalar or a jnp cast tied to the state "
+                                "dtype")
+                            break
+                elif (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "astype" and node.args):
+                    tgt = node.args[0]
+                    named = (_dotted(tgt).endswith("float64")
+                             or (isinstance(tgt, ast.Constant)
+                                 and tgt.value == "float64"))
+                    if named and _mentions(node.func.value, traced):
+                        self._emit(
+                            AnalysisCode.X64_PROMOTION, node.lineno,
+                            ".astype(float64) on a traced parameter — "
+                            "explicit promotion before TPU lowering")
+
+    def run(self) -> None:
+        self.check_unlifted_literals()
+        self.check_recompile_hazards()
+        self.check_host_syncs()
+        self.check_x64_promotion()
+
+
+def _audit_sources(sources: list[tuple]) -> tuple[dict, list[Diagnostic]]:
+    """Audit ``[(filename, source), ...]``.  Returns (report, diagnostics)."""
+    diagnostics: list[Diagnostic] = []
+    waived = 0
+    hot_path: list[str] = []
+    by_code: dict = {}
+    for filename, source in sources:
+        audit = _FileAudit(filename, source)
+        audit.run()
+        diagnostics += audit.diagnostics
+        waived += audit.waived
+        hot_path += [f"{filename}: {h}" for h in audit.hot_path]
+        for d in audit.diagnostics:
+            by_code[d.code] = by_code.get(d.code, 0) + 1
+    report = {
+        "files": len(sources),
+        "findings": len(diagnostics),
+        "waived": waived,
+        "by_code": dict(sorted(by_code.items())),
+        "hot_path_functions": hot_path,
+    }
+    return report, diagnostics
+
+
+def audit_source(source: str, filename: str = "<string>") -> list[Diagnostic]:
+    """Audit one module's source text (the refutation-corpus entry point)."""
+    _report, diagnostics = _audit_sources([(filename, source)])
+    return diagnostics
+
+
+def audit_paths(paths) -> tuple[dict, list[Diagnostic]]:
+    """Audit ``.py`` files / directory trees."""
+    files: list[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            for root, _dirs, names in os.walk(path):
+                files.extend(os.path.join(root, f) for f in sorted(names)
+                             if f.endswith(".py"))
+        else:
+            files.append(path)
+    sources = []
+    for f in files:
+        with open(f, "r", encoding="utf-8") as fh:
+            sources.append((f, fh.read()))
+    return _audit_sources(sources)
+
+
+def audit_package() -> tuple[dict, list[Diagnostic]]:
+    """Audit the whole installed quest_tpu tree plus the repo's examples/
+    directory (the ``--staticcheck`` CLI target and the CI gate)."""
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = [pkg_root]
+    examples = os.path.join(os.path.dirname(pkg_root), "examples")
+    if os.path.isdir(examples):
+        paths.append(examples)
+    return audit_paths(paths)
+
+
+# ---------------------------------------------------------------------------
+# Layer 2: the traced-served-class audit (jaxpr diff + weak-type scan)
+# ---------------------------------------------------------------------------
+
+def audit_served_classes(workloads=None, *, options=None, dtype=None,
+                         label_prefix: str = "") -> tuple:
+    """Prove each served structural class is CLOSED over its parameters.
+
+    Per ``(label, circuit[, perturbed-twin])`` workload entry: take the
+    class's cache entry for the request AND for an operand-perturbed twin
+    (built from the structural skeleton when no twin is supplied), trace
+    the per-request program the cache will actually run
+    (``(state, params)`` for a lifted entry; payload-embedding state-only
+    program for an opaque one), and diff the two traces constant by
+    constant.  Any difference — or a twin landing on a different cache
+    entry — is ``S_CLASS_NOT_CLOSED`` (ERROR): a per-request recompile
+    proven without compiling anything.  The f32-state trace is also
+    weak-type-scanned; a program whose RESULT dtype is promoted past f32
+    is ``S_X64_PROMOTION`` (ERROR) pinned on the actual served program.
+
+    Returns ``(reports, diagnostics)``."""
+    import jax.numpy as jnp
+
+    from .. import circuit as _circ
+    from ..serve.cache import CacheOptions, CompileCache, circuit_from_params
+    from .jaxpr_audit import (diff_trace_constants, scan_x64_promotion,
+                              trace_embedded_ops, trace_lifted_class)
+
+    if workloads is None:
+        from .serve_audit import default_workload
+        workloads = default_workload()
+    if options is None:
+        options = CacheOptions()
+    if dtype is None:
+        dtype = jnp.float64
+    cache = CompileCache()  # isolated: the audit must not warm serving caches
+    reports: list[dict] = []
+    out: list[Diagnostic] = []
+    for item in workloads:
+        label, circuit = item[0], item[1]
+        twin = item[2] if len(item) > 2 else None
+        label = f"{label_prefix}{label}"
+        n = circuit.num_qubits
+        ops = circuit.key()
+        entry = cache.entry_for(ops, n, options)
+        lifted = entry.skeleton is not None
+        report = {"label": label, "num_qubits": n, "ops": len(ops),
+                  "engine": options.engine, "overlap": bool(options.overlap),
+                  "lifted": lifted}
+
+        # operand-perturbed twin ops (an independent request of the class)
+        if twin is not None:
+            twin_ops = twin.key()
+        else:
+            skeleton = tuple(_circ.structural_op(op) for op in ops)
+            offsets, total = [], 0
+            for op in ops:
+                offsets.append(total)
+                total += _circ.op_param_count(op)
+            if total:
+                pvec = _circ.param_vector(ops)
+                twin_ops = circuit_from_params(
+                    n, skeleton, tuple(offsets), pvec + 0.25).key()
+            else:
+                twin_ops = ops  # parameter-free class: nothing to perturb
+        entry2 = cache.entry_for(twin_ops, n, options)
+        report["twin_shares_entry"] = entry2 is entry
+        if entry2 is not entry:
+            out.append(diag(
+                AnalysisCode.CLASS_NOT_CLOSED, Severity.ERROR,
+                detail=(f"{label}: an operand-perturbed twin missed the "
+                        "class's cache entry — the structural key is "
+                        "unstable, one entry per tenant")))
+
+        # trace the per-request program for both requests and diff
+        if lifted:
+            j1 = trace_lifted_class(n, entry.skeleton, entry.offsets,
+                                    entry.num_params, dtype=dtype)
+            j2 = trace_lifted_class(n, entry2.skeleton, entry2.offsets,
+                                    entry2.num_params, dtype=dtype)
+        else:
+            j1 = trace_embedded_ops(n, ops, dtype=dtype)
+            j2 = trace_embedded_ops(n, twin_ops, dtype=dtype)
+        diffs = diff_trace_constants(j1, j2)
+        report["trace_differences"] = len(diffs)
+        if diffs:
+            out.append(diag(
+                AnalysisCode.CLASS_NOT_CLOSED, Severity.ERROR,
+                detail=(f"{label}: re-tracing with a perturbed operand "
+                        f"vector changed the program ({diffs[0]}"
+                        + (f"; {len(diffs)} differences in all"
+                           if len(diffs) > 1 else "")
+                        + ") — every request with new angles recompiles")))
+
+        # weak-type scan of the f32 request's trace
+        if lifted:
+            jf = trace_lifted_class(n, entry.skeleton, entry.offsets,
+                                    entry.num_params, dtype=jnp.float32)
+        else:
+            jf = trace_embedded_ops(n, ops, dtype=jnp.float32)
+        events, out_dtypes = scan_x64_promotion(jf, expect=jnp.float32)
+        report["f32_promotion_eqns"] = len(events)
+        report["f32_output_dtypes"] = sorted({str(d) for d in out_dtypes})
+        promoted = [d for d in out_dtypes if str(d) == "float64"]
+        if promoted:
+            out.append(diag(
+                AnalysisCode.X64_PROMOTION, Severity.ERROR,
+                detail=(f"{label}: an f32 request's program RETURNS "
+                        "float64 — the class was promoted before TPU "
+                        f"lowering ({len(events)} promoting equation(s))")))
+        reports.append(report)
+    return reports, out
+
+
+# ---------------------------------------------------------------------------
+# the refutation corpus: every rule must flag its seeded bug and pass the
+# fixed twin (tests/test_staticcheck.py + the CI lint job)
+# ---------------------------------------------------------------------------
+
+CORPUS = (
+    {
+        "name": "literal_angle",
+        "code": AnalysisCode.UNLIFTED_LITERAL,
+        "bad": (
+            "def build_probe(num_qubits):\n"
+            "    from quest_tpu import Circuit\n"
+            "    c = Circuit(num_qubits)\n"
+            "    for q in range(num_qubits):\n"
+            "        c.ry(q, 0.37)\n"
+            "    return c\n"
+        ),
+        "good": (
+            "def build_probe(num_qubits, angles):\n"
+            "    from quest_tpu import Circuit\n"
+            "    c = Circuit(num_qubits)\n"
+            "    for q in range(num_qubits):\n"
+            "        c.ry(q, angles[q])\n"
+            "    return c\n"
+        ),
+    },
+    {
+        "name": "per_call_jit",
+        "code": AnalysisCode.RECOMPILE_HAZARD,
+        "bad": (
+            "import jax\n"
+            "\n"
+            "def run_once(state):\n"
+            "    return jax.jit(lambda s: s * 2.0)(state)\n"
+        ),
+        "good": (
+            "import jax\n"
+            "\n"
+            "_step = jax.jit(lambda s: s * 2.0)\n"
+            "\n"
+            "def run_once(state):\n"
+            "    return _step(state)\n"
+        ),
+    },
+    {
+        "name": "float_static_arg",
+        "code": AnalysisCode.RECOMPILE_HAZARD,
+        "bad": (
+            "import jax\n"
+            "from functools import partial\n"
+            "\n"
+            "@partial(jax.jit, static_argnames=('angle',))\n"
+            "def rotate(state, angle):\n"
+            "    return state * angle\n"
+            "\n"
+            "def serve_request(state):\n"
+            "    return rotate(state, 0.37)\n"
+        ),
+        "good": (
+            "import jax\n"
+            "\n"
+            "@jax.jit\n"
+            "def rotate(state, angle):\n"
+            "    return state * angle\n"
+            "\n"
+            "def serve_request(state):\n"
+            "    return rotate(state, 0.37)\n"
+        ),
+    },
+    {
+        "name": "submit_host_sync",
+        "code": AnalysisCode.HOST_SYNC_IN_HOT_PATH,
+        "bad": (
+            "import numpy as np\n"
+            "\n"
+            "class Service:\n"
+            "    def submit(self, state):\n"
+            "        return self._enqueue(state)\n"
+            "\n"
+            "    def _enqueue(self, state):\n"
+            "        host = np.asarray(state)\n"
+            "        self._queue.append(host)\n"
+        ),
+        "good": (
+            "import numpy as np\n"
+            "\n"
+            "class Service:\n"
+            "    def submit(self, state):\n"
+            "        self._queue.append(state)\n"
+            "\n"
+            "    def _drain(self, state):\n"
+            "        host = np.asarray(state)\n"
+            "        return host\n"
+        ),
+    },
+    {
+        "name": "np_scalar_in_trace",
+        "code": AnalysisCode.X64_PROMOTION,
+        "bad": (
+            "import jax\n"
+            "import numpy as np\n"
+            "\n"
+            "@jax.jit\n"
+            "def scale(state):\n"
+            "    return state * np.float64(2.0)\n"
+        ),
+        "good": (
+            "import jax\n"
+            "\n"
+            "@jax.jit\n"
+            "def scale(state):\n"
+            "    return state * 2.0\n"
+        ),
+    },
+)
+
+
+def corpus_report() -> tuple[list, list[Diagnostic]]:
+    """Run every corpus pair through the auditor.  Returns (rows,
+    diagnostics): a row per entry and an ERROR diagnostic for every
+    mutation the checker failed to flag (or fixed twin it wrongly
+    flagged) — the checker refuting itself."""
+    rows: list[dict] = []
+    out: list[Diagnostic] = []
+    for entry in CORPUS:
+        bad = audit_source(entry["bad"], f"<corpus:{entry['name']}:bad>")
+        good = audit_source(entry["good"], f"<corpus:{entry['name']}:good>")
+        hit = any(d.code == entry["code"] for d in bad)
+        clean = not good
+        rows.append({"name": entry["name"], "code": entry["code"],
+                     "bad_flagged": hit, "good_clean": clean})
+        if not hit:
+            out.append(diag(entry["code"], Severity.ERROR,
+                            detail=(f"corpus '{entry['name']}': the seeded "
+                                    "bug was NOT flagged — the checker "
+                                    "lost this rule")))
+        if not clean:
+            out.append(diag(good[0].code, Severity.ERROR,
+                            detail=(f"corpus '{entry['name']}': the FIXED "
+                                    "twin was flagged — false positive "
+                                    f"({good[0].message})")))
+    return rows, out

@@ -484,6 +484,7 @@ def _chain_circuits(n: int) -> dict:
     suite["swap_chain"] = sw
     if n >= 13:
         mrz = Circuit(n)
+        # unlifted-ok: calibration probe — one fixed angle, compiled once
         mrz.multi_rotate_z(tuple(range(12)), 0.37)
         suite["mrz_wide"] = mrz
     return suite
@@ -597,9 +598,9 @@ def _measure_pallas(n: int, repeats: int, iters: int, rows: dict,
     for q in range(dn):
         dc.unitary(q, _haar_unitary(rng))
     for q in range(0, dn, 2):
-        dc.damp(q, 0.05)
+        dc.damp(q, 0.05)        # unlifted-ok: calibration probe channel
     for q in range(1, dn, 2):
-        dc.depolarise(q, 0.05)
+        dc.depolarise(q, 0.05)  # unlifted-ok: calibration probe channel
     measure("super_block", "pallas_epoch_super", dc)
     return values
 

@@ -319,6 +319,7 @@ class QuESTService:
                 raise ValueError(
                     "overlap services take parameters embedded in the "
                     "circuit: the pipelined executor compiles payloads in")
+            # host-sync-ok: params are host scalars by the submit contract
             pvec = np.asarray(params, np.float64).ravel()
             if pvec.shape != (expected,):
                 raise ValueError(
@@ -326,6 +327,7 @@ class QuESTService:
                     f"structural class takes {expected}")
         state0 = None
         if initial_state is not None:
+            # host-sync-ok: initial states are host data by the contract
             state0 = np.asarray(initial_state)
             if state0.shape != (2, 1 << circuit.num_qubits):
                 raise ValueError(
@@ -405,6 +407,7 @@ class QuESTService:
                 MESSAGES[ErrorCode.MISMATCHING_PAULI_HAMIL_QUREG_NUM_QUBITS],
                 "submit_gradient")
         masks = _gradadj.hamil_masks(hamiltonian)
+        # host-sync-ok: Hamiltonian coefficients are host floats by contract
         coeffs = np.asarray(hamiltonian.term_coeffs, np.float64).ravel()
         if coeffs.shape != (len(masks),):
             raise ValueError(
@@ -414,6 +417,7 @@ class QuESTService:
             raise TypeError(
                 "submit_gradient requires the parameter vector (the "
                 "request's angles for the shared ansatz)")
+        # host-sync-ok: params are host scalars by the submit contract
         pvec = np.asarray(params, np.float64).ravel()
         if pvec.shape != (circuit.num_params,):
             raise ValueError(
@@ -421,6 +425,7 @@ class QuESTService:
                 f"{circuit.num_params}")
         state0 = None
         if initial_state is not None:
+            # host-sync-ok: initial states are host data by the contract
             state0 = np.asarray(initial_state)
             if state0.shape != (2, 1 << circuit.num_qubits):
                 if state0.shape == (2, 1 << (2 * circuit.num_qubits)):

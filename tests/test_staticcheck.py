@@ -1,0 +1,297 @@
+"""Compile-economics static checker (analysis/staticcheck.py + the
+jaxpr_audit trace-diff helpers): every S_* rule gets its refutation-corpus
+pair (the seeded bug must be flagged, the fixed twin must stay silent),
+the waiver comments must waive only WITH a reason, the repo self-audit
+must be clean, and the Layer-2 jaxpr diff must prove S_CLASS_NOT_CLOSED
+on a deliberately payload-embedding (opaque/pallas) class while the
+lifted equivalent of the SAME circuit passes.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from quest_tpu.analysis import staticcheck as sc
+from quest_tpu.analysis.diagnostics import AnalysisCode, Severity
+from quest_tpu.circuit import Circuit
+
+
+def codes(diags):
+    return [d.code for d in diags]
+
+
+def audit(src):
+    return sc.audit_source(src, "fixture.py")
+
+
+# ---------------------------------------------------------------------------
+# the refutation corpus: each rule flags its seeded bug, passes the twin
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("entry", sc.CORPUS, ids=[e["name"] for e in sc.CORPUS])
+def test_corpus_bad_flagged(entry):
+    found = audit(entry["bad"])
+    assert entry["code"] in codes(found)
+    assert all(d.severity == Severity.ERROR for d in found)
+
+
+@pytest.mark.parametrize("entry", sc.CORPUS, ids=[e["name"] for e in sc.CORPUS])
+def test_corpus_good_clean(entry):
+    assert audit(entry["good"]) == []
+
+
+def test_corpus_report_self_consistent():
+    rows, diags = sc.corpus_report()
+    assert diags == []
+    assert len(rows) == len(sc.CORPUS)
+    assert all(r["bad_flagged"] and r["good_clean"] for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# waivers: a reasoned comment waives, an unreasoned one is refused
+# ---------------------------------------------------------------------------
+
+_LITERAL_ANGLE = (
+    "def probe(c):\n"
+    "    c.ry(0, 0.37){comment}\n"
+)
+
+
+def test_reasoned_waiver_silences():
+    src = _LITERAL_ANGLE.format(
+        comment="  # unlifted-ok: fixed probe angle, compiled once")
+    assert audit(src) == []
+
+
+def test_unreasoned_waiver_is_refused():
+    src = _LITERAL_ANGLE.format(comment="  # unlifted-ok:")
+    found = audit(src)
+    assert codes(found) == [AnalysisCode.UNLIFTED_LITERAL]
+    assert "UNREASONED" in found[0].message
+
+
+def test_waiver_on_preceding_comment_line():
+    src = ("def probe(c):\n"
+           "    # unlifted-ok: fixed probe angle\n"
+           "    c.ry(0, 0.37)\n")
+    assert audit(src) == []
+
+
+def test_wrong_family_waiver_does_not_waive():
+    src = _LITERAL_ANGLE.format(comment="  # host-sync-ok: not this rule")
+    assert codes(audit(src)) == [AnalysisCode.UNLIFTED_LITERAL]
+
+
+# ---------------------------------------------------------------------------
+# S_UNLIFTED_LITERAL edges
+# ---------------------------------------------------------------------------
+
+def test_int_literal_wire_args_not_flagged():
+    # wires and control indices are structural ints, not payloads
+    assert audit("def f(c):\n    c.cnot(0, 1)\n    c.rx(2, 1)\n") == []
+
+
+def test_literal_arithmetic_flagged_but_names_exempt():
+    flagged = audit("def f(c):\n    c.rz(0, 2.0 * 0.5)\n")
+    assert codes(flagged) == [AnalysisCode.UNLIFTED_LITERAL]
+    # an expression mentioning a NAME is data-bound: not provably literal
+    assert audit("def f(c, theta):\n    c.rz(0, 2.0 * theta)\n") == []
+
+
+def test_keyword_angle_flagged():
+    found = audit("def f(c):\n    c.phase_shift(3, angle=0.25)\n")
+    assert codes(found) == [AnalysisCode.UNLIFTED_LITERAL]
+
+
+# ---------------------------------------------------------------------------
+# S_RECOMPILE_HAZARD edges
+# ---------------------------------------------------------------------------
+
+def test_aot_lower_chain_not_flagged():
+    src = ("import jax\n"
+           "def build(spec):\n"
+           "    return jax.jit(lambda s: s * 2.0).lower(spec).compile()\n")
+    assert audit(src) == []
+
+
+def test_int_static_arg_not_flagged():
+    src = ("import jax\n"
+           "from functools import partial\n"
+           "@partial(jax.jit, static_argnames=('n',))\n"
+           "def grow(state, n):\n"
+           "    return state\n"
+           "def use(state):\n"
+           "    return grow(state, 4)\n")
+    assert audit(src) == []
+
+
+def test_unhashable_static_arg_flagged():
+    src = ("import jax\n"
+           "from functools import partial\n"
+           "@partial(jax.jit, static_argnames=('wires',))\n"
+           "def apply(state, wires):\n"
+           "    return state\n"
+           "def use(state):\n"
+           "    return apply(state, [1, 2])\n")
+    assert codes(audit(src)) == [AnalysisCode.RECOMPILE_HAZARD]
+
+
+def test_static_argnums_resolved_to_float_arg():
+    src = ("import jax\n"
+           "from functools import partial\n"
+           "@partial(jax.jit, static_argnums=(1,))\n"
+           "def rot(state, angle):\n"
+           "    return state\n"
+           "def use(state):\n"
+           "    return rot(state, 0.5)\n")
+    assert codes(audit(src)) == [AnalysisCode.RECOMPILE_HAZARD]
+
+
+# ---------------------------------------------------------------------------
+# S_HOST_SYNC_IN_HOT_PATH edges
+# ---------------------------------------------------------------------------
+
+def test_hot_path_annotation_roots_custom_function():
+    src = ("import numpy as np\n"
+           "# hot-path\n"
+           "def admit(req):\n"
+           "    return np.asarray(req)\n")
+    assert codes(audit(src)) == [AnalysisCode.HOST_SYNC_IN_HOT_PATH]
+
+
+def test_worker_side_sync_not_flagged():
+    src = ("import jax\n"
+           "class Service:\n"
+           "    def submit(self, req):\n"
+           "        self._queue.append(req)\n"
+           "    def _execute(self, req):\n"
+           "        return jax.block_until_ready(req)\n")
+    assert audit(src) == []
+
+
+def test_item_call_on_hot_path_flagged():
+    src = ("class Router:\n"
+           "    def route(self, scores):\n"
+           "        return scores.argmin().item()\n")
+    assert codes(audit(src)) == [AnalysisCode.HOST_SYNC_IN_HOT_PATH]
+
+
+# ---------------------------------------------------------------------------
+# S_X64_PROMOTION edges
+# ---------------------------------------------------------------------------
+
+def test_np_pi_is_weak_and_exempt():
+    src = ("import jax\n"
+           "import numpy as np\n"
+           "@jax.jit\n"
+           "def phase(state):\n"
+           "    return state * np.pi\n")
+    assert audit(src) == []
+
+
+def test_astype_float64_on_traced_param_flagged():
+    src = ("import jax\n"
+           "import jax.numpy as jnp\n"
+           "@jax.jit\n"
+           "def widen(state):\n"
+           "    return state.astype(jnp.float64)\n")
+    assert codes(audit(src)) == [AnalysisCode.X64_PROMOTION]
+
+
+def test_np_call_outside_jit_not_flagged():
+    src = ("import numpy as np\n"
+           "def host_side(x):\n"
+           "    return x * np.float64(2.0)\n")
+    assert audit(src) == []
+
+
+# ---------------------------------------------------------------------------
+# the repo self-audit and the CLI wiring
+# ---------------------------------------------------------------------------
+
+def test_repo_self_audit_is_clean():
+    report, found = sc.audit_package()
+    errors = [d for d in found if d.severity >= Severity.ERROR]
+    assert errors == [], "\n".join(d.format() for d in errors)
+    # the known, deliberately-waived sites stay waived (examples demo
+    # angles, calibration probes, submit-contract np.asarray casts)
+    assert report["waived"] >= 13
+    assert any("service.py" in h and "submit" in h
+               for h in report["hot_path_functions"])
+
+
+def test_cli_staticcheck_paths_gate(tmp_path):
+    from quest_tpu.analysis.__main__ import main
+    bad = tmp_path / "bad.py"
+    bad.write_text(sc.CORPUS[0]["bad"])
+    good = tmp_path / "good.py"
+    good.write_text(sc.CORPUS[0]["good"])
+    assert main(["--staticcheck-paths", str(bad)]) == 1
+    assert main(["--staticcheck-paths", str(good)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# Layer 2: the traced-served-class audit (jaxpr diff)
+# ---------------------------------------------------------------------------
+
+def _toy(angle: float) -> Circuit:
+    c = Circuit(4)
+    for q in range(4):
+        c.ry(q, angle + 0.1 * q)
+    c.cnot(0, 1)
+    return c
+
+
+def test_lifted_class_is_closed():
+    reports, diags = sc.audit_served_classes(
+        [("toy4", _toy(0.3), _toy(0.9))])
+    assert diags == []
+    (r,) = reports
+    assert r["lifted"] and r["twin_shares_entry"]
+    assert r["trace_differences"] == 0
+    assert r["f32_output_dtypes"] == ["float32"]
+
+
+def test_opaque_class_fires_class_not_closed():
+    from quest_tpu.serve.cache import CacheOptions
+    reports, diags = sc.audit_served_classes(
+        [("toy4", _toy(0.3), _toy(0.9))],
+        options=CacheOptions(engine="pallas"))
+    assert AnalysisCode.CLASS_NOT_CLOSED in codes(diags)
+    (r,) = reports
+    assert not r["lifted"]
+    assert r["trace_differences"] > 0
+
+
+def test_structural_twin_mismatch_is_key_instability():
+    twin = _toy(0.3)
+    twin.h(3)  # a structurally DIFFERENT circuit posing as the twin
+    reports, diags = sc.audit_served_classes([("toy4", _toy(0.3), twin)])
+    assert AnalysisCode.CLASS_NOT_CLOSED in codes(diags)
+    assert reports[0]["twin_shares_entry"] is False
+
+
+def test_trace_diff_helpers_directly():
+    import jax.numpy as jnp
+    from quest_tpu.analysis.jaxpr_audit import (diff_trace_constants,
+                                                scan_x64_promotion,
+                                                trace_embedded_ops)
+    j1 = trace_embedded_ops(4, _toy(0.3).key())
+    j2 = trace_embedded_ops(4, _toy(0.9).key())
+    assert diff_trace_constants(j1, j1) == []
+    assert diff_trace_constants(j1, j2) != []
+    events, out_dtypes = scan_x64_promotion(
+        trace_embedded_ops(4, _toy(0.3).key(), dtype=jnp.float32))
+    assert events == []
+    assert all(str(d) == "float32" for d in out_dtypes)
+
+
+def test_scan_x64_promotion_catches_promoted_program():
+    import jax
+    import numpy as np
+    from quest_tpu.analysis.jaxpr_audit import scan_x64_promotion
+    spec = jax.ShapeDtypeStruct((4,), "float32")
+    promoted = jax.make_jaxpr(lambda s: s * np.float64(2.0))(spec)
+    events, out_dtypes = scan_x64_promotion(promoted)
+    assert events
+    assert any(str(d) == "float64" for d in out_dtypes)
